@@ -1,0 +1,36 @@
+"""freedm_tpu — a TPU-native distributed grid intelligence framework.
+
+A ground-up JAX/XLA re-design of the FREEDM DGI reference
+(``vmuthuk2/FREEDM``, mounted read-only at ``/root/reference``): a smart-grid
+control system in which N per-SST broker processes run leader election, a
+Chandy-Lamport consistent snapshot, distributed power load balancing, and
+gradient Volt-VAR control backed by a 3-phase distribution power-flow solver.
+
+Instead of N C++/Boost processes gossiping over UDP
+(reference: ``Broker/src/CBroker.cpp``, ``CProtocolSR.cpp``), each DGI node
+maps to a row of a TPU mesh: group membership, snapshots and supply/demand
+auctions become XLA collectives over ICI, and the embedded Armadillo power
+flow (``Broker/src/vvc/DPF_return7.cpp``) becomes a batched, sharded
+ladder-sweep / Newton-Raphson solve on the MXU.
+
+Layout (mirrors SURVEY.md §7):
+
+- :mod:`freedm_tpu.core`      — config, timings, logging, broker, scheduler
+  (reference: CGlobalConfiguration, CTimings, CLogger, CBroker)
+- :mod:`freedm_tpu.grid`      — feeder/grid data model & cases
+  (reference: vvc/load_system_data.cpp, Dl_new.mat)
+- :mod:`freedm_tpu.pf`        — power-flow kernels: ladder sweep, Ybus,
+  Newton-Raphson (reference: vvc/DPF_return7.cpp, form_Yabc.cpp, form_J.cpp)
+- :mod:`freedm_tpu.parallel`  — mesh, collectives, physical topology
+  (reference: gm/ election, sc/ snapshot, CPhysicalTopology)
+- :mod:`freedm_tpu.modules`   — DGI algorithm modules: gm, sc, lb, vvc
+  (reference: Broker/src/{gm,sc,lb,vvc})
+- :mod:`freedm_tpu.devices`   — device tensor, builders, adapters
+  (reference: Broker/src/device)
+- :mod:`freedm_tpu.dcn`       — external/host transport, clock sync, plant
+  server (reference: CProtocolSR, CClockSynchronizer, pscad-interface-master)
+"""
+
+__version__ = "0.1.0"
+
+from freedm_tpu.core.config import GlobalConfig, Timings  # noqa: F401

@@ -1,0 +1,2 @@
+from freedm_tpu.core.config import GlobalConfig, Timings, NULL_COMMAND, MAX_PACKET_SIZE, parse_cfg  # noqa: F401
+from freedm_tpu.core.logging import get_logger  # noqa: F401

@@ -1,0 +1,127 @@
+"""Hierarchical leveled logging.
+
+TPU-native stand-in for the reference's ``CLogger`` registry
+(``Broker/src/CLogger.{hpp,cpp}``): every source file gets a named logger
+with 9 verbosity levels — 0 Fatal, 1 Alert, 2 Error, 3 Warn, 4 Status,
+5 Notice, 6 Info, 7 Debug, 8 Trace (reference:
+``Broker/config/samples/logger.cfg:8-18``) — a global default level, and
+per-logger overrides loaded from ``logger.cfg``. ``--list-loggers`` parity
+is provided by :func:`list_loggers`.
+
+Implemented on top of :mod:`logging` so handlers/formatters compose with the
+rest of the Python ecosystem; DGI level *L* maps to stdlib level
+``50 - 5*L`` so Fatal(0)=CRITICAL(50) and Trace(8)=10 (DEBUG).
+"""
+
+from __future__ import annotations
+
+import logging as _pylog
+import sys
+from pathlib import Path
+from typing import Dict, Iterable, Union
+
+from freedm_tpu.core.config import parse_cfg
+
+#: DGI verbosity names, index = DGI level (logger.cfg:8-18 in the reference).
+LEVELS = ("FATAL", "ALERT", "ERROR", "WARN", "STATUS", "NOTICE", "INFO", "DEBUG", "TRACE")
+
+_REGISTRY: Dict[str, "DgiLogger"] = {}
+_DEFAULT_LEVEL = 5  # Notice, like the sample freedm.cfg's verbose=5
+
+
+def _to_stdlib(level: int) -> int:
+    return max(1, 50 - 5 * int(level))
+
+
+class DgiLogger:
+    """A named logger with DGI 0-8 leveling.
+
+    Usage mirrors the reference's per-file ``CLocalLogger Logger(__FILE__)``:
+    module code creates one at import time via :func:`get_logger`.
+    """
+
+    def __init__(self, name: str, level: int = _DEFAULT_LEVEL):
+        self.name = name
+        self._py = _pylog.getLogger(f"freedm_tpu.{name}")
+        self.set_level(level)
+
+    def set_level(self, level: int) -> None:
+        self.level = int(level)
+        self._py.setLevel(_to_stdlib(level))
+
+    def _log(self, lvl: int, *parts) -> None:
+        if lvl <= self.level:
+            self._py.log(_to_stdlib(lvl), " ".join(str(p) for p in parts))
+
+    def fatal(self, *p):
+        self._log(0, *p)
+
+    def alert(self, *p):
+        self._log(1, *p)
+
+    def error(self, *p):
+        self._log(2, *p)
+
+    def warn(self, *p):
+        self._log(3, *p)
+
+    def status(self, *p):
+        self._log(4, *p)
+
+    def notice(self, *p):
+        self._log(5, *p)
+
+    def info(self, *p):
+        self._log(6, *p)
+
+    def debug(self, *p):
+        self._log(7, *p)
+
+    def trace(self, *p):
+        self._log(8, *p)
+
+
+def get_logger(name: str) -> DgiLogger:
+    if name not in _REGISTRY:
+        # Pass the *current* global level — the class default is bound at
+        # definition time and would miss earlier set_global_level() calls.
+        _REGISTRY[name] = DgiLogger(name, _DEFAULT_LEVEL)
+    return _REGISTRY[name]
+
+
+def set_global_level(level: int) -> None:
+    """Set the default verbosity for all loggers (reference: ``verbose=``)."""
+    global _DEFAULT_LEVEL
+    _DEFAULT_LEVEL = int(level)
+    for lg in _REGISTRY.values():
+        lg.set_level(level)
+
+
+def configure_from_file(path: Union[str, Path]) -> None:
+    """Apply per-logger overrides from a ``logger.cfg``.
+
+    Format matches the reference (``Broker/config/samples/logger.cfg``):
+    ``name = level`` lines, with the special key ``default`` setting the
+    global level first.
+    """
+    cfg = parse_cfg(path)
+    if "default" in cfg:
+        set_global_level(int(cfg["default"][-1]))
+    for key, vals in cfg.items():
+        if key == "default":
+            continue
+        get_logger(key).set_level(int(vals[-1]))
+
+
+def list_loggers() -> Iterable[str]:
+    """``--list-loggers`` parity (reference: PosixMain.cpp)."""
+    return sorted(_REGISTRY)
+
+
+def basic_config(stream=sys.stderr) -> None:
+    """Install a plain handler once, for CLI entry points."""
+    root = _pylog.getLogger("freedm_tpu")
+    if not root.handlers:
+        h = _pylog.StreamHandler(stream)
+        h.setFormatter(_pylog.Formatter("%(asctime)s %(name)s %(levelname)s: %(message)s"))
+        root.addHandler(h)

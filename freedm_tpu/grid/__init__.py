@@ -1,0 +1,2 @@
+from freedm_tpu.grid.feeder import Feeder, from_branch_table, load_dl_mat, DL_COLS  # noqa: F401
+from freedm_tpu.grid import cases  # noqa: F401

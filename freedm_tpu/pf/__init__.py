@@ -1,0 +1,9 @@
+from freedm_tpu.pf.ladder import (  # noqa: F401
+    LadderResult,
+    make_ladder_solver,
+    v_polar,
+    branch_power_kva,
+    substation_power_kva,
+    load_power_kva,
+    total_loss_kw,
+)

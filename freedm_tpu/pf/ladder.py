@@ -1,0 +1,224 @@
+"""Unbalanced 3-phase radial power flow — the ladder (forward/backward
+sweep) method, TPU-first.
+
+Functional equivalent of the reference's ``DPF_return7``
+(``Broker/src/vvc/DPF_return7.cpp:8-263``): iterate
+
+1. load currents   ``I_L = conj(S_load / V)``            (…:104-131)
+2. backward sweep  branch currents accumulate rootward   (…:133-161)
+3. forward sweep   voltage drops accumulate leafward     (…:163-196)
+
+until the substation branch current stops changing (``eps = 1e-4``,
+``mxitr = 20``, …:13-15,198-218).
+
+Two TPU-first departures from the reference's design:
+
+* **Sweeps are matmuls.**  The reference walks the branch list
+  sequentially twice per iteration, relying on a careful row ordering with
+  zero-row lateral separators.  Here both sweeps are dense matmuls against
+  the feeder's precompiled ``subtree`` incidence matrix
+  (:mod:`freedm_tpu.grid.feeder`)::
+
+      I_b  = subtree  @ I_L                      (backward sweep)
+      V    = V0 - subtreeᵀ @ (ℓ·Z·I_b)           (forward sweep)
+
+  — MXU work, batchable with ``jax.vmap`` over scenarios and shardable
+  over the branch dimension.
+
+* **No complex dtype.**  All phasors are (re, im) real pairs
+  (:mod:`freedm_tpu.utils.cplx`); TPU hardware has no complex unit and a
+  complex matmul is 4 real matmuls regardless, so we write them explicitly.
+
+The fixed-point loop is a ``lax.while_loop`` (or a fixed-length
+``lax.scan`` in the differentiable variant used by the VVC gradient,
+replacing the reference's hand-coded adjoint
+``VoltVarCtrl.cpp:1222-1309``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.grid.feeder import Feeder
+from freedm_tpu.utils import cplx
+from freedm_tpu.utils.cplx import C
+
+
+class LadderResult(NamedTuple):
+    """Power-flow solution, all per-unit unless noted.
+
+    Mirrors the information content of the reference's ``VPQ`` struct
+    (``Broker/src/vvc/fun_return.h``): polar voltages, branch and load
+    powers; plus convergence telemetry the reference only printed.
+    """
+
+    v_node: C  # [nn, 3]: node voltages, node 0 = substation
+    i_branch: C  # [nb, 3]: branch currents
+    i_load: C  # [nb, 3]: load currents at to-nodes
+    iterations: jax.Array  # [] int32
+    converged: jax.Array  # [] bool
+    residual: jax.Array  # [] float: final substation-current change
+
+
+def make_ladder_solver(
+    feeder: Feeder,
+    eps: float = 1e-4,
+    max_iter: int = 20,
+    dtype: Optional[jnp.dtype] = None,
+):
+    """Compile ladder-sweep solvers for a feeder.
+
+    Returns ``(solve, solve_fixed)``:
+
+    - ``solve(s_load_kva, v_source_pu=None) -> LadderResult`` — runs to the
+      reference's convergence criterion under ``lax.while_loop``.
+    - ``solve_fixed(s_load_kva, v_source_pu=None) -> LadderResult`` — always
+      runs ``max_iter`` sweeps under ``lax.scan``; reverse-mode
+      differentiable (used for VVC gradients).
+
+    Both are jit-compiled and accept loads in kW + j·kvar (Dl column
+    convention) as a complex array or a :class:`~freedm_tpu.utils.cplx.C`
+    pair; pass a ``C`` with a leading scenario axis under ``jax.vmap`` for
+    batched solves.
+    """
+    rdtype = dtype or (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+
+    sub = jnp.asarray(feeder.subtree, dtype=rdtype)
+    mask = jnp.asarray(feeder.phase_mask, dtype=rdtype)
+    z = cplx.as_c(feeder.z_pu, dtype=rdtype)  # [nb, 3, 3]
+    root = jnp.asarray((feeder.parent < 0).astype(np.float64), dtype=rdtype)  # [nb]
+    s_base = feeder.s_base_per_phase_kva
+    default_v0 = feeder.v_source_pu
+
+    # 120°-displaced source phasors (DPF_return7.cpp:86-90).
+    unit = cplx.as_c(
+        np.array([1.0, np.exp(-2j * np.pi / 3), np.exp(2j * np.pi / 3)]), dtype=rdtype
+    )
+
+    def _sweep(v: C, s_pu: C, v0: C):
+        """One ladder iteration: V[nb,3] -> (V', I_b, I_L)."""
+        live = v.abs2() > 0
+        safe_v = v.where(live, 1.0)
+        i_load = (s_pu / safe_v).conj().where(live)
+        i_branch = cplx.matmul(sub, i_load)
+        drop = cplx.einsum("bq,bqp->bp", i_branch, z)
+        v_new = (v0[None, :] - cplx.matmul(sub.T, drop)) * mask
+        return v_new, i_branch, i_load
+
+    def _root_err(i_branch: C, i_prev: C):
+        d = (i_branch - i_prev).abs() * root[:, None]
+        return jnp.max(d).astype(rdtype)
+
+    def _v0(v_source_pu):
+        vs = default_v0 if v_source_pu is None else v_source_pu
+        return unit * jnp.asarray(vs, dtype=rdtype)
+
+    def _finish(v0: C, v: C, i_branch: C, i_load: C, it, err):
+        v_node = C(
+            jnp.concatenate([v0.re[None, :], v.re], axis=0),
+            jnp.concatenate([v0.im[None, :], v.im], axis=0),
+        )
+        return LadderResult(
+            v_node=v_node,
+            i_branch=i_branch,
+            i_load=i_load,
+            iterations=jnp.asarray(it, jnp.int32),
+            converged=err < eps,
+            residual=err,
+        )
+
+    @jax.jit
+    def _solve(s_pu: C, v_source_pu=None):
+        v0 = _v0(v_source_pu)
+        v_init = v0[None, :] * mask
+        nb = mask.shape[0]
+        zero = cplx.zeros((nb, 3), rdtype)
+
+        def cond(carry):
+            _, _, _, it, err = carry
+            return jnp.logical_and(it < max_iter, err >= eps)
+
+        def body(carry):
+            v, i_prev, _, it, _ = carry
+            v_new, i_branch, i_load = _sweep(v, s_pu, v0)
+            err = _root_err(i_branch, i_prev)
+            return (v_new, i_branch, i_load, it + 1, err)
+
+        init = (v_init, zero, zero, jnp.int32(0), jnp.asarray(jnp.inf, rdtype))
+        v, i_branch, i_load, it, err = jax.lax.while_loop(cond, body, init)
+        return _finish(v0, v, i_branch, i_load, it, err)
+
+    @jax.jit
+    def _solve_fixed(s_pu: C, v_source_pu=None):
+        v0 = _v0(v_source_pu)
+        v_init = v0[None, :] * mask
+        nb = mask.shape[0]
+        zero = cplx.zeros((nb, 3), rdtype)
+
+        def body(carry, _):
+            # Everything rides in the carry (no stacked scan outputs): only
+            # the final sweep's currents are needed, and stacking
+            # [max_iter, nb, 3] histories would cost O(max_iter) memory on
+            # large feeders.
+            v, _, _, _ = carry
+            v_new, i_branch, i_load = _sweep(v, s_pu, v0)
+            err = _root_err(i_branch, carry[1])
+            return (v_new, i_branch, i_load, err), None
+
+        init = (v_init, zero, zero, jnp.asarray(jnp.inf, rdtype))
+        (v, i_branch, i_load, err), _ = jax.lax.scan(body, init, None, length=max_iter)
+        return _finish(v0, v, i_branch, i_load, max_iter, err)
+
+    def _to_pu(s_load_kva) -> C:
+        s = cplx.as_c(s_load_kva, dtype=rdtype)
+        return s / s_base
+
+    def solve(s_load_kva, v_source_pu=None) -> LadderResult:
+        return _solve(_to_pu(s_load_kva), v_source_pu)
+
+    def solve_fixed(s_load_kva, v_source_pu=None) -> LadderResult:
+        return _solve_fixed(_to_pu(s_load_kva), v_source_pu)
+
+    return solve, solve_fixed
+
+
+# ---------------------------------------------------------------------------
+# Derived quantities (reference: DPF_return7.cpp:222-258 result formatting).
+# ---------------------------------------------------------------------------
+
+
+def v_polar(result: LadderResult):
+    """(|V| pu, angle degrees) per node/phase — the reference's ``Vpolar``."""
+    mag = result.v_node.abs()
+    ang = jnp.degrees(result.v_node.angle())
+    return mag, jnp.where(mag > 0, ang, 0.0)
+
+
+def branch_power_kva(feeder: Feeder, result: LadderResult) -> C:
+    """[nb, 3] kVA flowing into each branch's receiving node — the
+    reference's ``PQb`` body rows (``Sb = (bkva/3)·V ∘ conj(I_inj)``)."""
+    return (result.v_node[1:] * result.i_branch.conj()) * feeder.s_base_per_phase_kva
+
+
+def substation_power_kva(feeder: Feeder, result: LadderResult) -> C:
+    """[3] kVA leaving the substation (reference ``PQb`` row 0)."""
+    root = jnp.asarray(feeder.parent < 0)
+    i_root = result.i_branch.where(root[:, None]).sum(axis=0)
+    return (result.v_node[0] * i_root.conj()) * feeder.s_base_per_phase_kva
+
+
+def load_power_kva(feeder: Feeder, result: LadderResult) -> C:
+    """[nb, 3] kVA drawn by each load (reference ``PQL``)."""
+    return (result.v_node[1:] * result.i_load.conj()) * feeder.s_base_per_phase_kva
+
+
+def total_loss_kw(feeder: Feeder, result: LadderResult) -> jax.Array:
+    """Total real losses = substation injection − total load (the VVC
+    objective; reference ``VoltVarCtrl.cpp:1157-1164``)."""
+    p_sub = jnp.sum(substation_power_kva(feeder, result).re)
+    p_load = jnp.sum(load_power_kva(feeder, result).re)
+    return p_sub - p_load

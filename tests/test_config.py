@@ -1,0 +1,69 @@
+"""Config stack tests: cfg parsing, Timings strictness (reference: CTimings
+hard-fails on missing keys), GlobalConfig from freedm.cfg-format files."""
+
+import pytest
+
+from freedm_tpu.core import GlobalConfig, Timings, parse_cfg
+
+REF_TIMINGS = "/root/reference/Broker/config/timings.cfg"
+
+
+def test_parse_reference_timings_cfg():
+    t = Timings.from_file(REF_TIMINGS)
+    assert t.gm_phase_time == 530
+    assert t.sc_phase_time == 320
+    assert t.lb_phase_time == 4100
+    assert t.lb_round_time == 3000
+    assert t.lb_request_timeout == 140
+    assert t.csrc_resend_time == 60
+    assert t.dev_rtds_delay == 50
+    # Full published round: 530+320+4100+4100 = 9050 ms (BASELINE.md).
+    assert t.round_length_ms() == 9050
+
+
+def test_timings_strict_missing_key(tmp_path):
+    p = tmp_path / "t.cfg"
+    p.write_text("GM_PHASE_TIME = 100\n")
+    with pytest.raises(ValueError, match="missing required"):
+        Timings.from_file(p)
+    t = Timings.from_file(p, strict=False)
+    assert t.gm_phase_time == 100
+    assert t.sc_phase_time == 320  # default retained
+
+
+def test_timings_unknown_key(tmp_path):
+    p = tmp_path / "t.cfg"
+    p.write_text("BOGUS_TIME = 5\n")
+    with pytest.raises(ValueError, match="unknown timing"):
+        Timings.from_file(p, strict=False)
+
+
+def test_global_config_from_file(tmp_path):
+    p = tmp_path / "freedm.cfg"
+    p.write_text(
+        """
+# comment
+address=0.0.0.0
+port=51870
+add-host=alpha.freedm:51870
+add-host=beta.freedm:51870
+verbose=5
+migration-step = 2
+malicious-behavior = 1
+mqtt-subscribe=SST
+mqtt-subscribe=DESD
+"""
+    )
+    cfg = GlobalConfig.from_file(p, hostname="gamma.freedm")
+    assert cfg.uuid == "gamma.freedm:51870"
+    assert cfg.add_host == ["alpha.freedm:51870", "beta.freedm:51870"]
+    assert cfg.migration_step == 2.0
+    assert cfg.malicious_behavior is True
+    assert cfg.mqtt_subscribe == ["SST", "DESD"]
+
+
+def test_parse_cfg_malformed(tmp_path):
+    p = tmp_path / "bad.cfg"
+    p.write_text("no equals sign here\n")
+    with pytest.raises(ValueError, match="malformed"):
+        parse_cfg(p)

@@ -1,0 +1,97 @@
+"""Feeder data-model tests: Dl parsing, relabeling, subtree compilation."""
+
+import numpy as np
+import pytest
+
+from freedm_tpu.grid import cases, from_branch_table, load_dl_mat
+
+
+def test_9bus_structure():
+    f = cases.vvc_9bus()
+    assert f.n_branches == 8
+    assert f.n_nodes == 9
+    # Main: 0-1-2-3-4-5, lateral: 1-6-7-8 (load_system_data.cpp topology).
+    assert f.from_node.tolist() == [0, 1, 2, 3, 4, 1, 6, 7]
+    assert f.parent.tolist() == [-1, 0, 1, 2, 3, 0, 5, 6]
+    assert f.levels == 5  # longest chain 0→1→2→3→4→5 has depth 4
+    # Subtree of the transformer branch (0) contains every branch.
+    assert f.subtree[0].sum() == 8
+    # Subtree of branch feeding node 6 (index 5) = branches 5,6,7.
+    assert f.subtree[5].tolist() == [0, 0, 0, 0, 0, 1, 1, 1]
+    # Path to node 8 = branches 0,5,6,7 (column of subtree).
+    assert f.subtree[:, 7].tolist() == [1, 0, 0, 0, 0, 1, 1, 1]
+    assert f.phase_mask.min() == 1.0  # all phases present
+
+
+def test_transformer_branch_decoupled():
+    f = cases.vvc_9bus()
+    z0 = f.z_pu[0]
+    assert np.count_nonzero(z0 - np.diag(np.diag(z0))) == 0  # diagonal
+    z1 = f.z_pu[1]
+    assert abs(z1[0, 1]) > 0  # feeder lines have mutual coupling
+
+
+def test_duplicate_rbus_rejected():
+    dl = np.zeros((2, 13))
+    dl[0] = [1, 0, 1, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0]
+    dl[1] = [2, 0, 1, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0]
+    with pytest.raises(ValueError, match="duplicate receiving bus"):
+        from_branch_table(dl, cases.Z_CODES_9BUS)
+
+
+def test_unknown_sbus_rejected():
+    dl = np.zeros((1, 13))
+    dl[0] = [1, 7, 1, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0]
+    with pytest.raises(ValueError, match="source bus"):
+        from_branch_table(dl, cases.Z_CODES_9BUS)
+
+
+def test_dl_roundtrip():
+    f = cases.vvc_9bus()
+    dl = f.to_dl()
+    f2 = from_branch_table(dl, np.stack([f.z_pu[i] * f.z_base_ohm for i in range(8)]))
+    # Same topology after round-trip (z codes re-expanded per branch).
+    assert f2.parent.tolist() == f.parent.tolist()
+    np.testing.assert_allclose(f2.s_load, f.s_load)
+
+
+def test_load_reference_dl_new():
+    f = load_dl_mat("/root/reference/Broker/Dl_new.mat")
+    assert f.n_branches == 33
+    assert f.levels > 5  # deep feeder with laterals
+    # Non-contiguous laterals relabeled: every parent valid.
+    assert (f.parent >= -1).all() and (f.parent < f.n_branches).all()
+
+
+def test_out_of_order_rows():
+    """A child row listed before its parent must still compile correctly
+    (regression: depth/phase-mask propagation once assumed parent-first)."""
+    z = cases.Z_CODES_9BUS
+    dl = np.zeros((3, 13))
+    dl[0] = [1, 5, 7, 1, 1, 1, 10, 0, 10, 0, 10, 0, 0]  # child of node 5
+    dl[1] = [2, 0, 5, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0]  # root branch
+    dl[2] = [3, 7, 9, 1, 1, 1, 5, 0, 5, 0, 5, 0, 0]  # grandchild
+    f = from_branch_table(dl, z)
+    assert f.phase_mask.min() == 1.0  # every phase reachable
+    assert f.depth.tolist() == [1, 0, 2]
+    assert f.levels == 3
+
+
+def test_cycle_rejected():
+    z = cases.Z_CODES_9BUS
+    dl = np.zeros((3, 13))
+    dl[0] = [1, 0, 1, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0]
+    dl[1] = [2, 3, 2, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0]  # 3 -> 2
+    dl[2] = [3, 2, 3, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0]  # 2 -> 3 (cycle)
+    with pytest.raises(ValueError, match="cycle or disconnected"):
+        from_branch_table(dl, z)
+
+
+def test_synthetic_radial_deterministic():
+    f1 = cases.synthetic_radial(256, seed=7)
+    f2 = cases.synthetic_radial(256, seed=7)
+    assert f1.n_branches == 256
+    np.testing.assert_array_equal(f1.parent, f2.parent)
+    np.testing.assert_allclose(f1.s_load, f2.s_load)
+    # Subtree of the first branch spans everything fed through it.
+    assert f1.subtree.sum(axis=1).max() <= 256

@@ -1,0 +1,153 @@
+"""Ladder power-flow solver tests.
+
+Oracles: analytic 2-bus solutions, power-balance identities, and the
+convergence envelope of the reference solver (eps=1e-4 within 20 sweeps on
+its own 9-bus feeder, ``Broker/src/vvc/DPF_return7.cpp:13-15``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from freedm_tpu.grid import cases, from_branch_table, load_dl_mat
+from freedm_tpu.pf import (
+    branch_power_kva,
+    load_power_kva,
+    make_ladder_solver,
+    substation_power_kva,
+    total_loss_kw,
+    v_polar,
+)
+from freedm_tpu.utils import cplx
+from freedm_tpu.utils.cplx import C
+
+REF_DL_MAT = "/root/reference/Broker/Dl_new.mat"
+
+
+def test_9bus_converges_within_reference_envelope():
+    feeder = cases.vvc_9bus()
+    solve, _ = make_ladder_solver(feeder, eps=1e-4, max_iter=20)
+    res = solve(feeder.s_load)
+    assert bool(res.converged)
+    assert int(res.iterations) <= 20
+    mag, _ = v_polar(res)
+    # All phases present on this feeder; voltages in a sane band.
+    assert np.all(np.asarray(mag) > 0.9)
+    assert np.all(np.asarray(mag) < 1.1)
+
+
+def test_9bus_power_balance():
+    feeder = cases.vvc_9bus()
+    solve, _ = make_ladder_solver(feeder)
+    res = solve(feeder.s_load)
+    p_sub = float(np.sum(np.asarray(substation_power_kva(feeder, res).re)))
+    p_load = float(np.sum(np.asarray(load_power_kva(feeder, res).re)))
+    loss = float(total_loss_kw(feeder, res))
+    # Loss identity and small positive losses for a net-load feeder.
+    assert loss == pytest.approx(p_sub - p_load, abs=1e-9)
+    assert 0 < loss < 50
+    # Loads recovered: constant-power model must draw what the table says.
+    np.testing.assert_allclose(p_load, feeder.s_load.real.sum(), rtol=1e-3)
+
+
+def test_zero_load_gives_flat_voltage():
+    feeder = cases.vvc_9bus()
+    solve, _ = make_ladder_solver(feeder)
+    res = solve(np.zeros((feeder.n_branches, 3), dtype=complex))
+    mag, _ = v_polar(res)
+    np.testing.assert_allclose(np.asarray(mag), feeder.v_source_pu, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(res.i_branch.abs()), 0.0, atol=1e-12)
+
+
+def test_two_bus_analytic():
+    """V1 solves V1 = V0 - Z·conj(S/V1); compare against numpy fixed point."""
+    z_codes = np.eye(3)[None] * (0.01 + 0.03j)  # ohms, decoupled phases
+    p_kw, q_kvar = 300.0, 100.0
+    dl = np.array([[1, 0, 1, 1, 1.0, 1, p_kw, q_kvar, p_kw, q_kvar, p_kw, q_kvar, 0]])
+    feeder = from_branch_table(dl, z_codes, base_kva=1000.0, base_kv=12.47, v_source_pu=1.0)
+    solve, _ = make_ladder_solver(feeder, eps=1e-10, max_iter=50)
+    res = solve(feeder.s_load)
+    assert bool(res.converged)
+
+    zb = 1000.0 * 12.47**2 / 1000.0
+    z_pu = (0.01 + 0.03j) / zb
+    s_pu = (p_kw + 1j * q_kvar) / (1000.0 / 3.0)
+    v = 1.0 + 0j
+    for _ in range(200):
+        v = 1.0 - z_pu * np.conj(s_pu / v)
+    got = res.v_node.to_numpy()[1, 0]  # phase a
+    np.testing.assert_allclose(got, v, rtol=1e-8)
+
+
+def test_missing_phase_masks_voltage():
+    # Branch 2 carries only phase a (codes: 3-phase, then single-phase).
+    z3 = np.full((3, 3), 0.3 + 0.9j) + np.eye(3) * (0.6 + 1.4j)
+    z1 = np.zeros((3, 3), dtype=complex)
+    z1[0, 0] = 0.9 + 2.3j
+    dl = np.array(
+        [
+            [1, 0, 1, 1, 1.0, 1, 10, 2, 10, 2, 10, 2, 0],
+            [2, 1, 2, 2, 1.0, 1, 5, 1, 5, 1, 5, 1, 0],
+        ]
+    )
+    feeder = from_branch_table(dl, np.stack([z3, z1]))
+    assert feeder.phase_mask.tolist() == [[1, 1, 1], [1, 0, 0]]
+    solve, _ = make_ladder_solver(feeder)
+    res = solve(feeder.s_load)
+    v = res.v_node.to_numpy()
+    assert abs(v[2, 1]) == 0 and abs(v[2, 2]) == 0
+    assert abs(v[2, 0]) > 0.9
+
+
+def test_reference_dl_new_mat_loads_and_converges():
+    """The Dl format ships line-code indices without the impedance library
+    (see load_dl_mat), so this checks loader + solver plumbing on the
+    reference's own saved table, at a loading feasible for the synthesized
+    generic line codes."""
+    feeder = load_dl_mat(REF_DL_MAT)
+    assert feeder.n_branches == 33  # 33 real branches among the 41 rows
+    solve, _ = make_ladder_solver(feeder, max_iter=60)
+    res = solve(0.5 * feeder.s_load)
+    assert bool(res.converged)
+    assert float(jnp.min(res.v_node.abs())) > 0.5
+
+
+def test_vmap_over_scenarios():
+    feeder = cases.vvc_9bus()
+    _, solve_fixed = make_ladder_solver(feeder, max_iter=25)
+    scales = np.linspace(0.2, 1.2, 8)
+    loads = cplx.as_c(scales[:, None, None] * feeder.s_load)
+    batched = jax.vmap(solve_fixed)(loads)
+    assert batched.v_node.shape == (8, feeder.n_nodes, 3)
+    # Heavier load -> lower minimum voltage, monotonically.
+    vmin = np.asarray(jnp.min(batched.v_node.abs(), axis=(1, 2)))
+    assert np.all(np.diff(vmin) < 0)
+
+
+def test_fixed_solver_matches_while_loop():
+    feeder = cases.vvc_9bus()
+    solve, solve_fixed = make_ladder_solver(feeder, eps=1e-12, max_iter=40)
+    r1 = solve(feeder.s_load)
+    r2 = solve_fixed(feeder.s_load)
+    np.testing.assert_allclose(r1.v_node.to_numpy(), r2.v_node.to_numpy(), atol=1e-10)
+
+
+def test_gradient_matches_finite_difference():
+    """d loss / d Q via autodiff through the fixed-iteration solver —
+    the jax.grad replacement for the reference's hand-built adjoint
+    (VoltVarCtrl.cpp:1222-1309)."""
+    feeder = cases.vvc_9bus()
+    _, solve_fixed = make_ladder_solver(feeder, max_iter=30)
+    p0 = jnp.asarray(feeder.s_load.real)
+
+    def loss_of_q(q):
+        return total_loss_kw(feeder, solve_fixed(C(p0, q)))
+
+    q = jnp.zeros((feeder.n_branches, 3))
+    g = jax.grad(loss_of_q)(q)
+    h = 1e-3
+    for idx in [(1, 0), (4, 2), (6, 1)]:
+        e = jnp.zeros_like(q).at[idx].set(h)
+        fd = (loss_of_q(q + e) - loss_of_q(q - e)) / (2 * h)
+        np.testing.assert_allclose(np.asarray(g[idx]), np.asarray(fd), rtol=1e-4, atol=1e-7)

@@ -1,0 +1,35 @@
+"""CLogger-equivalent tests: 9 levels, global default, per-logger override."""
+
+from freedm_tpu.core import logging as dlog
+
+
+def test_levels_table():
+    assert dlog.LEVELS == (
+        "FATAL",
+        "ALERT",
+        "ERROR",
+        "WARN",
+        "STATUS",
+        "NOTICE",
+        "INFO",
+        "DEBUG",
+        "TRACE",
+    )
+
+
+def test_global_level_applies_to_later_loggers():
+    dlog.set_global_level(8)
+    lg = dlog.get_logger("made-after-global-set")
+    assert lg.level == 8
+    dlog.set_global_level(5)
+    assert lg.level == 5  # retroactive too
+
+
+def test_configure_from_file(tmp_path):
+    p = tmp_path / "logger.cfg"
+    p.write_text("default = 4\nCBroker = 8\n")
+    dlog.configure_from_file(p)
+    assert dlog.get_logger("CBroker").level == 8
+    assert dlog.get_logger("other").level == 4
+    assert "CBroker" in dlog.list_loggers()
+    dlog.set_global_level(5)
