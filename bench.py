@@ -1,0 +1,65 @@
+"""Headline benchmark: AC power-flow solves/sec (BASELINE.md north star).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline envelope (BASELINE.md): the reference runs one 9-bus 3-phase
+ladder power flow per 3000 ms VVC round per process
+(``Broker/config/timings.cfg``, ``Broker/src/vvc/DPF_return7.cpp``), i.e.
+~0.33 solves/sec. North-star target: >=10k-bus at <10 ms/iteration on
+TPU. We report batched 9-bus solves/sec (the reference's own workload,
+vmapped) so vs_baseline = achieved / 0.33.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+from freedm_tpu.grid.cases import vvc_9bus
+from freedm_tpu.pf import ladder
+from freedm_tpu.utils import cplx
+
+# Reference cadence: one 9-bus DPF per VVC_ROUND_TIME=3000ms round
+# (Broker/config/timings.cfg:14-18) per broker process.
+BASELINE_SOLVES_PER_SEC = 1000.0 / 3000.0
+
+
+def main() -> None:
+    feeder = vvc_9bus()
+    solve, _ = ladder.make_ladder_solver(feeder)
+
+    batch = 1024
+    rng = np.random.default_rng(0)
+    scale = rng.uniform(0.7, 1.3, size=(batch, 1, 1))
+    s = np.asarray(feeder.s_load)[None] * scale
+    s_load = cplx.as_c(s)
+
+    batched = jax.jit(jax.vmap(lambda s: solve(s)))
+    # Warm-up / compile.
+    jax.block_until_ready(batched(s_load))
+
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = batched(s_load)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    solves_per_sec = reps * batch / dt
+    print(
+        json.dumps(
+            {
+                "metric": "ac_power_flow_solves_per_sec_9bus",
+                "value": round(solves_per_sec, 1),
+                "unit": "solves/sec",
+                "vs_baseline": round(solves_per_sec / BASELINE_SOLVES_PER_SEC, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

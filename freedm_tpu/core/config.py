@@ -19,9 +19,38 @@ close over inside jitted programs.
 from __future__ import annotations
 
 import dataclasses
+import typing
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, List, Optional, Union
+
+# Boolean spellings accepted by boost::program_options' value<bool>
+# (the reference parses e.g. ``malicious-behavior = no``).
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off", ""}
+
+
+def _convert(hint, name: str, vals: List[str]):
+    """Convert raw config strings to a field's annotated type."""
+    origin = typing.get_origin(hint)
+    if origin is Union:  # Optional[T] -> T (None never appears in a file)
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        hint, origin = args[0], typing.get_origin(args[0])
+    if origin in (list, List):
+        return list(vals)
+    raw = vals[-1]
+    if hint is bool:
+        low = raw.lower()
+        if low in _TRUE:
+            return True
+        if low in _FALSE:
+            return False
+        raise ValueError(f"invalid boolean for {name!r}: {raw!r}")
+    if hint is int:
+        return int(raw)
+    if hint is float:
+        return float(raw)
+    return raw
 
 
 # Sentinel for "no command" on a device signal.
@@ -192,22 +221,13 @@ class GlobalConfig:
     @classmethod
     def from_file(cls, path: Union[str, Path], **overrides) -> "GlobalConfig":
         cfg = parse_cfg(path)
+        hints = typing.get_type_hints(cls)
         fields = {f.name: f for f in dataclasses.fields(cls)}
         kwargs: dict = {}
         for key, vals in cfg.items():
             name = key.replace("-", "_").lower()
             if name not in fields:
                 continue  # unknown keys tolerated, like program_options' allow_unregistered
-            f = fields[name]
-            if f.type in ("List[str]", "list[str]") or name in ("add_host", "mqtt_subscribe"):
-                kwargs[name] = list(vals)
-            elif f.type in ("bool",) or name in ("malicious_behavior", "check_invariant"):
-                kwargs[name] = vals[-1] not in ("0", "false", "False", "")
-            elif name in ("port", "factory_port", "verbose", "clock_skew_us", "mesh_nodes", "mesh_batch"):
-                kwargs[name] = int(vals[-1])
-            elif name in ("migration_step",):
-                kwargs[name] = float(vals[-1])
-            else:
-                kwargs[name] = vals[-1]
+            kwargs[name] = _convert(hints[name], name, vals)
         kwargs.update(overrides)
         return cls(**kwargs)

@@ -93,9 +93,10 @@ class Feeder:
         """Precompute subtree incidence, phase masks and depths.
 
         Branch rows may arrive in any order (a child row before its
-        parent's), so depth/mask propagation runs in BFS order from the
-        substation-fed roots; a row set that isn't a forest rooted at the
-        substation (cycle or disconnected island) is rejected.
+        parent's), so depth/mask propagation runs in a parent-before-child
+        (DFS preorder) traversal from the substation-fed roots; a row set
+        that isn't a forest rooted at the substation (cycle or
+        disconnected island) is rejected.
         """
         nb = self.n_branches
         parent = self.parent
@@ -233,7 +234,7 @@ def load_dl_mat(path, z_codes: Optional[np.ndarray] = None, **kwargs) -> Feeder:
     ``load_system_data.cpp:44-58``); pass ``z_codes`` explicitly, or a
     generic overhead-line library sized to the table is synthesized.
     """
-    dl = np.loadtxt(path)
+    dl = np.loadtxt(path, ndmin=2)
     if z_codes is None:
         from freedm_tpu.grid.cases import default_z_codes
 
