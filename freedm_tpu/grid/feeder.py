@@ -9,13 +9,17 @@ for the TPU:
   at node 0, and every non-root node is identified with its unique incoming
   branch (radial ⇒ bijection), so per-node and per-branch quantities share
   one axis;
-* the tree structure is *compiled once* (host-side, numpy) into a dense
-  ``subtree`` incidence matrix: ``subtree[i, j] = 1`` iff branch ``j`` lies
-  in the subtree hanging below branch ``i``.  The backward current sweep of
-  the reference's ladder power flow (``DPF_return7.cpp:133-161``) is then a
-  single matmul ``I_branch = subtree @ I_load``, and the forward voltage
-  sweep (``DPF_return7.cpp:163-196``) is ``V = V0 - subtreeᵀ @ drop`` —
-  both MXU-shaped instead of a sequential tree walk.
+* the tree structure is *compiled once* (host-side, numpy): parent
+  pointers, depths, phase masks, and — for small feeders — a dense
+  ``subtree`` incidence matrix (``subtree[i, j] = 1`` iff branch ``j``
+  lies in the subtree hanging below branch ``i``).  The backward current
+  sweep of the reference's ladder power flow (``DPF_return7.cpp:133-161``)
+  is then a single matmul ``I_branch = subtree @ I_load`` and the forward
+  voltage sweep (``DPF_return7.cpp:163-196``) is ``V = V0 - subtreeᵀ @
+  drop`` — both MXU-shaped instead of a sequential tree walk.  Feeders
+  above ~2k branches skip the O(n²) matrix; their sweeps run as
+  pointer-jumping rounds over the parent array
+  (:mod:`freedm_tpu.pf.sweeps`).
 
 Per-phase impedances come from a line-code library ``z_codes`` (ohms per
 unit length, 3×3 complex blocks), exactly the information content of the
@@ -89,7 +93,7 @@ class Feeder:
         # Reference scales loads by bkva/3 (DPF_return7.cpp:49).
         return self.base_kva / 3.0
 
-    def compile(self) -> "Feeder":
+    def compile(self, dense_subtree: Optional[bool] = None) -> "Feeder":
         """Precompute subtree incidence, phase masks and depths.
 
         Branch rows may arrive in any order (a child row before its
@@ -97,6 +101,12 @@ class Feeder:
         (DFS preorder) traversal from the substation-fed roots; a row set
         that isn't a forest rooted at the substation (cycle or
         disconnected island) is rejected.
+
+        ``dense_subtree`` controls whether the O(n²) subtree incidence
+        matrix is materialized (the matmul sweep path); ``None`` builds it
+        only for feeders small enough that O(n²) is MXU-friendly — larger
+        feeders use the pointer-jumping sweeps
+        (:mod:`freedm_tpu.pf.sweeps`), which need only ``parent``/``depth``.
         """
         nb = self.n_branches
         parent = self.parent
@@ -130,14 +140,22 @@ class Feeder:
                 mask[i] = branch_has_phase[i] * mask[parent[i]]
             else:
                 mask[i] = branch_has_phase[i]
-        # subtree[i, j]: walk j's ancestor chain, marking every ancestor incl. j.
-        sub = np.zeros((nb, nb), dtype=np.float32)
-        for j in range(nb):
-            k = j
-            while k >= 0:
-                sub[k, j] = 1.0
-                k = parent[k]
-        self.subtree = sub
+        if dense_subtree is None:
+            from freedm_tpu.pf.sweeps import DENSE_MAX_BRANCHES
+
+            dense_subtree = nb <= DENSE_MAX_BRANCHES
+        if dense_subtree:
+            # subtree[i, j]: walk j's ancestor chain, marking every
+            # ancestor incl. j.
+            sub = np.zeros((nb, nb), dtype=np.float32)
+            for j in range(nb):
+                k = j
+                while k >= 0:
+                    sub[k, j] = 1.0
+                    k = parent[k]
+            self.subtree = sub
+        else:
+            self.subtree = None
         self.phase_mask = mask
         self.depth = depth
         self.levels = int(depth.max()) + 1 if nb else 0

@@ -13,17 +13,18 @@ until the substation branch current stops changing (``eps = 1e-4``,
 
 Two TPU-first departures from the reference's design:
 
-* **Sweeps are matmuls.**  The reference walks the branch list
-  sequentially twice per iteration, relying on a careful row ordering with
-  zero-row lateral separators.  Here both sweeps are dense matmuls against
-  the feeder's precompiled ``subtree`` incidence matrix
-  (:mod:`freedm_tpu.grid.feeder`)::
+* **Sweeps are linear operators, not tree walks.**  The reference walks
+  the branch list sequentially twice per iteration, relying on a careful
+  row ordering with zero-row lateral separators.  Here both sweeps go
+  through :mod:`freedm_tpu.pf.sweeps`, which realizes them either as
+  dense matmuls against the precompiled ``subtree`` incidence matrix
+  (small feeders — MXU work, batchable with ``jax.vmap``)::
 
       I_b  = subtree  @ I_L                      (backward sweep)
       V    = V0 - subtreeᵀ @ (ℓ·Z·I_b)           (forward sweep)
 
-  — MXU work, batchable with ``jax.vmap`` over scenarios and shardable
-  over the branch dimension.
+  or as O(log depth) pointer-jumping gather/scatter rounds (large
+  feeders, where O(n²) memory is prohibitive).
 
 * **No complex dtype.**  All phasors are (re, im) real pairs
   (:mod:`freedm_tpu.utils.cplx`); TPU hardware has no complex unit and a
@@ -44,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from freedm_tpu.grid.feeder import Feeder
+from freedm_tpu.pf.sweeps import make_sweeps
 from freedm_tpu.utils import cplx
 from freedm_tpu.utils.cplx import C
 
@@ -69,6 +71,7 @@ def make_ladder_solver(
     eps: float = 1e-4,
     max_iter: int = 20,
     dtype: Optional[jnp.dtype] = None,
+    sweep_method: Optional[str] = None,
 ):
     """Compile ladder-sweep solvers for a feeder.
 
@@ -84,10 +87,14 @@ def make_ladder_solver(
     convention) as a complex array or a :class:`~freedm_tpu.utils.cplx.C`
     pair; pass a ``C`` with a leading scenario axis under ``jax.vmap`` for
     batched solves.
+
+    ``sweep_method`` selects the tree-sweep realization ("dense",
+    "doubling", or ``None`` to auto-select; see
+    :mod:`freedm_tpu.pf.sweeps`).
     """
     rdtype = dtype or (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
 
-    sub = jnp.asarray(feeder.subtree, dtype=rdtype)
+    backward, forward = make_sweeps(feeder, rdtype, sweep_method)
     mask = jnp.asarray(feeder.phase_mask, dtype=rdtype)
     z = cplx.as_c(feeder.z_pu, dtype=rdtype)  # [nb, 3, 3]
     root = jnp.asarray((feeder.parent < 0).astype(np.float64), dtype=rdtype)  # [nb]
@@ -104,9 +111,9 @@ def make_ladder_solver(
         live = v.abs2() > 0
         safe_v = v.where(live, 1.0)
         i_load = (s_pu / safe_v).conj().where(live)
-        i_branch = cplx.matmul(sub, i_load)
+        i_branch = backward(i_load)
         drop = cplx.einsum("bq,bqp->bp", i_branch, z)
-        v_new = (v0[None, :] - cplx.matmul(sub.T, drop)) * mask
+        v_new = (v0[None, :] - forward(drop)) * mask
         return v_new, i_branch, i_load
 
     def _root_err(i_branch: C, i_prev: C):
@@ -132,7 +139,8 @@ def make_ladder_solver(
         )
 
     @jax.jit
-    def _solve(s_pu: C, v_source_pu=None):
+    def _solve(s_kva: C, v_source_pu=None):
+        s_pu = s_kva / s_base
         v0 = _v0(v_source_pu)
         v_init = v0[None, :] * mask
         nb = mask.shape[0]
@@ -153,7 +161,8 @@ def make_ladder_solver(
         return _finish(v0, v, i_branch, i_load, it, err)
 
     @jax.jit
-    def _solve_fixed(s_pu: C, v_source_pu=None):
+    def _solve_fixed(s_kva: C, v_source_pu=None):
+        s_pu = s_kva / s_base
         v0 = _v0(v_source_pu)
         v_init = v0[None, :] * mask
         nb = mask.shape[0]
@@ -173,15 +182,11 @@ def make_ladder_solver(
         (v, i_branch, i_load, err), _ = jax.lax.scan(body, init, None, length=max_iter)
         return _finish(v0, v, i_branch, i_load, max_iter, err)
 
-    def _to_pu(s_load_kva) -> C:
-        s = cplx.as_c(s_load_kva, dtype=rdtype)
-        return s / s_base
-
     def solve(s_load_kva, v_source_pu=None) -> LadderResult:
-        return _solve(_to_pu(s_load_kva), v_source_pu)
+        return _solve(cplx.as_c(s_load_kva, dtype=rdtype), v_source_pu)
 
     def solve_fixed(s_load_kva, v_source_pu=None) -> LadderResult:
-        return _solve_fixed(_to_pu(s_load_kva), v_source_pu)
+        return _solve_fixed(cplx.as_c(s_load_kva, dtype=rdtype), v_source_pu)
 
     return solve, solve_fixed
 

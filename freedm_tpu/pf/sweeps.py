@@ -1,0 +1,133 @@
+"""Tree-sweep operators for radial power flow.
+
+The ladder method's two sweeps (reference ``DPF_return7.cpp:133-196``) are
+linear operators determined by the feeder tree:
+
+- **backward**: ``I_branch[i] = Σ_{j ∈ subtree(i)} I_load[j]`` — subtree
+  sums (rootward accumulation of load currents);
+- **forward**: ``path[i] = Σ_{k ∈ ancestors(i) ∪ {i}} drop[k]`` — root-to-
+  node path sums (leafward accumulation of voltage drops).
+
+Two interchangeable TPU realizations:
+
+- :func:`dense_sweeps` — matmuls against the precompiled ``[nb, nb]``
+  subtree incidence matrix.  MXU-shaped; ideal for small feeders batched
+  over many scenarios (the reference's own 9-bus case), but O(n²) memory.
+- :func:`doubling_sweeps` — pointer-jumping (parallel prefix over the
+  tree): ``ceil(log2(levels))`` rounds of gather / scatter-add over
+  ``[nb, 3]`` arrays.  O(n log n) work, O(n) memory — the 10k-bus path
+  (SURVEY.md §7 hard part (i): no dense/sparse factorization needed at
+  all for radial networks).
+
+Both are pure jittable functions of :class:`~freedm_tpu.utils.cplx.C`
+operands and vmap/shard transparently.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.grid.feeder import Feeder
+from freedm_tpu.utils import cplx
+from freedm_tpu.utils.cplx import C
+
+SweepFn = Callable[[C], C]
+
+# Above this branch count the dense [nb, nb] subtree matrix is not built
+# (10k buses would need ~400 MB) and sweeps use pointer doubling.
+DENSE_MAX_BRANCHES = 2048
+
+
+def dense_sweeps(feeder: Feeder, dtype) -> Tuple[SweepFn, SweepFn]:
+    """Sweeps as matmuls against the subtree incidence matrix."""
+    if feeder.subtree is None:
+        raise ValueError("feeder compiled without a dense subtree matrix")
+    sub = jnp.asarray(feeder.subtree, dtype=dtype)
+
+    def backward(i_load: C) -> C:
+        return cplx.matmul(sub, i_load)
+
+    def forward(drop: C) -> C:
+        return cplx.matmul(sub.T, drop)
+
+    return backward, forward
+
+
+def doubling_sweeps(feeder: Feeder, dtype) -> Tuple[SweepFn, SweepFn]:
+    """Sweeps by pointer jumping — O(log depth) gather/scatter rounds.
+
+    Let ``P`` be the parent-pointer adjacency (``P[i, j] = 1`` iff
+    ``parent[j] == i``).  The subtree operator is ``Σ_k P^k`` and the path
+    operator its transpose.  With ``jump`` initially the parent pointer:
+
+        val ← val + P^(2^m)·val     (scatter-add into the 2^m-th ancestor)
+        jump ← jump∘jump            (pointer doubling)
+
+    after ``ceil(log2(levels))`` rounds ``val`` holds subtree sums.  The
+    forward sweep is the same recursion with a *gather from* the ancestor
+    instead of a scatter-add into it (so it needs no conflict resolution
+    at all).  Rounds are unrolled at trace time — `levels` is static.
+    """
+    nb = feeder.n_branches
+    # Sentinel slot nb: roots point there; it points to itself and its
+    # value is dropped (scatter) or zero (gather).
+    parent = np.where(feeder.parent < 0, nb, feeder.parent).astype(np.int32)
+    jump0 = jnp.asarray(np.concatenate([parent, [nb]]))
+    rounds = max(1, math.ceil(math.log2(max(feeder.levels, 2))))
+
+    def _rounds(val: C, combine) -> C:
+        # Pad with the sentinel row once; slice it off at the end.
+        pad = cplx.zeros((1,) + val.shape[1:], dtype)
+        val = C(
+            jnp.concatenate([val.re, pad.re], axis=0),
+            jnp.concatenate([val.im, pad.im], axis=0),
+        )
+        jump = jump0
+        for _ in range(rounds):
+            val = combine(val, jump)
+            jump = jump[jump]
+        return val[:nb]
+
+    def _scatter(val: C, jump) -> C:
+        add = lambda x: x.at[jump].add(x, mode="drop")  # noqa: E731
+        out = C(add(val.re), add(val.im))
+        # The sentinel row accumulated root contributions; re-zero it so
+        # later rounds don't leak it back.
+        zero = jnp.zeros((1,) + val.shape[1:], dtype)
+        return C(out.re.at[nb].set(zero[0]), out.im.at[nb].set(zero[0]))
+
+    def _gather(val: C, jump) -> C:
+        return C(val.re + val.re[jump], val.im + val.im[jump])
+
+    def backward(i_load: C) -> C:
+        return _rounds(i_load, _scatter)
+
+    def forward(drop: C) -> C:
+        return _rounds(drop, _gather)
+
+    return backward, forward
+
+
+def make_sweeps(
+    feeder: Feeder, dtype, method: Optional[str] = None
+) -> Tuple[SweepFn, SweepFn]:
+    """Pick the sweep realization: ``method`` in {"dense", "doubling", None}.
+
+    ``None`` auto-selects: dense whenever the incidence matrix was
+    materialized (``Feeder.compile`` already applies the size threshold,
+    and an explicit ``compile(dense_subtree=True)`` is respected),
+    doubling otherwise.
+    """
+    if method == "dense":
+        return dense_sweeps(feeder, dtype)
+    if method == "doubling":
+        return doubling_sweeps(feeder, dtype)
+    if method is not None:
+        raise ValueError(f"unknown sweep method: {method!r}")
+    if feeder.subtree is not None:
+        return dense_sweeps(feeder, dtype)
+    return doubling_sweeps(feeder, dtype)

@@ -1,0 +1,83 @@
+"""Sweep-operator parity: pointer-jumping vs dense incidence matmul.
+
+The doubling sweeps are the 10k-bus path (SURVEY.md §7); the dense sweeps
+are the small-feeder MXU path already validated against the reference's
+``DPF_return7`` behavior in test_ladder.py. Equality of the two operators
+on arbitrary trees transfers that validation to the scalable path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from freedm_tpu.grid import cases
+from freedm_tpu.pf import ladder, sweeps
+from freedm_tpu.utils import cplx
+
+
+def _rand_c(rng, shape):
+    return cplx.as_c(rng.normal(size=shape) + 1j * rng.normal(size=shape))
+
+
+@pytest.mark.parametrize(
+    "feeder",
+    [
+        cases.vvc_9bus(),
+        cases.synthetic_radial(200, seed=1),
+        cases.synthetic_radial(64, seed=2, lateral_prob=0.0),  # pure trunk: depth = n
+        cases.synthetic_radial(64, seed=3, lateral_prob=1.0),  # star-ish: shallow
+    ],
+    ids=["9bus", "rand200", "trunk64", "shallow64"],
+)
+def test_doubling_matches_dense(feeder, rng):
+    dtype = jnp.float64
+    b_dense, f_dense = sweeps.dense_sweeps(feeder, dtype)
+    b_dbl, f_dbl = sweeps.doubling_sweeps(feeder, dtype)
+    x = _rand_c(rng, (feeder.n_branches, 3))
+    np.testing.assert_allclose(b_dbl(x).re, b_dense(x).re, atol=1e-10)
+    np.testing.assert_allclose(b_dbl(x).im, b_dense(x).im, atol=1e-10)
+    np.testing.assert_allclose(f_dbl(x).re, f_dense(x).re, atol=1e-10)
+    np.testing.assert_allclose(f_dbl(x).im, f_dense(x).im, atol=1e-10)
+
+
+def test_doubling_vmaps(rng):
+    feeder = cases.synthetic_radial(100, seed=4)
+    dtype = jnp.float64
+    b_dense, _ = sweeps.dense_sweeps(feeder, dtype)
+    b_dbl, _ = sweeps.doubling_sweeps(feeder, dtype)
+    x = _rand_c(rng, (5, feeder.n_branches, 3))
+    got = jax.vmap(b_dbl)(x)
+    want = jax.vmap(b_dense)(x)
+    np.testing.assert_allclose(got.re, want.re, atol=1e-10)
+    np.testing.assert_allclose(got.im, want.im, atol=1e-10)
+
+
+def test_ladder_solution_identical_across_methods():
+    feeder = cases.synthetic_radial(300, seed=5)
+    solve_dense, _ = ladder.make_ladder_solver(feeder, sweep_method="dense")
+    solve_dbl, _ = ladder.make_ladder_solver(feeder, sweep_method="doubling")
+    r1 = solve_dense(feeder.s_load)
+    r2 = solve_dbl(feeder.s_load)
+    assert bool(r1.converged) and bool(r2.converged)
+    np.testing.assert_allclose(r2.v_node.re, r1.v_node.re, atol=1e-10)
+    np.testing.assert_allclose(r2.v_node.im, r1.v_node.im, atol=1e-10)
+
+
+def test_large_feeder_uses_doubling_and_balances_power():
+    # 5k-bus: compiled without a dense subtree matrix; the auto-selected
+    # solver must converge and satisfy conservation: substation injection
+    # = total load + total series losses. (2 kW/bus keeps the feeder
+    # inside its loadability limit — heavier loading is genuine voltage
+    # collapse, where the ladder method diverges by construction.)
+    feeder = cases.synthetic_radial(5000, seed=6, pv_frac=0.1, load_kw=2.0)
+    assert feeder.subtree is None
+    solve, _ = ladder.make_ladder_solver(feeder)
+    res = solve(feeder.s_load)
+    assert bool(res.converged), float(res.residual)
+    p_sub = float(jnp.sum(ladder.substation_power_kva(feeder, res).re))
+    p_load = float(jnp.sum(ladder.load_power_kva(feeder, res).re))
+    loss = float(ladder.total_loss_kw(feeder, res))
+    assert loss == pytest.approx(p_sub - p_load, abs=1e-6)
+    # Losses are a small positive fraction of the feeder throughput.
+    assert 0 < loss < 0.2 * abs(p_sub)
