@@ -1,2 +1,3 @@
 from freedm_tpu.grid.feeder import Feeder, from_branch_table, load_dl_mat, DL_COLS  # noqa: F401
-from freedm_tpu.grid import cases  # noqa: F401
+from freedm_tpu.grid.bus import BusSystem, ybus_dense, PQ, PV, SLACK  # noqa: F401
+from freedm_tpu.grid import cases, matpower  # noqa: F401

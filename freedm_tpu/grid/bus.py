@@ -1,0 +1,158 @@
+"""Generic (meshed) bus/branch network model and Ybus assembly.
+
+The reference's power-system data model is radial-only: the VVC module's
+``Dl`` branch table plus per-phase Ybus assembly in
+``Broker/src/vvc/form_Yabc.cpp`` (259 LoC of hand-rolled admittance
+stamping) feeding the ladder solver.  The north star (BASELINE.json
+configs #4-5) additionally requires *meshed transmission* cases — IEEE
+118-class N-1 contingency batches — which a ladder sweep cannot solve.
+This module provides the general positive-sequence model those cases
+need; :mod:`freedm_tpu.pf.newton` solves it.
+
+Design:
+
+* arrays-of-columns, not objects: a :class:`BusSystem` is a pytree of
+  numpy arrays sized ``[n_bus]`` / ``[n_branch]`` with MATPOWER-standard
+  branch parameters (series r+jx, total charging b, off-nominal tap,
+  phase shift);
+* Ybus is assembled **inside jit** from the branch table and a branch
+  ``status`` vector (:func:`ybus_dense`), so an N-1 contingency batch is
+  just ``vmap`` over status masks — no per-contingency host re-assembly
+  (the reference re-forms Ybus on the host every VVC round);
+* dense ``[n, n]`` admittance as a :class:`~freedm_tpu.utils.cplx.C`
+  pair: at transmission sizes (118-2k buses) dense linear algebra on the
+  MXU beats sparse bookkeeping, and scenario batching amortizes it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.utils import cplx
+from freedm_tpu.utils.cplx import C
+
+# Bus types (MATPOWER convention minus isolated).
+PQ = 0
+PV = 1
+SLACK = 2
+
+
+@dataclass(frozen=True)
+class BusSystem:
+    """A positive-sequence bus/branch network, per unit on ``base_mva``."""
+
+    # Buses ------------------------------------------------------------------
+    bus_type: np.ndarray  # [n] int: PQ=0, PV=1, SLACK=2
+    p_inj: np.ndarray  # [n] float: scheduled P injection (gen - load), pu
+    q_inj: np.ndarray  # [n] float: scheduled Q injection at PQ buses, pu
+    v_set: np.ndarray  # [n] float: voltage setpoint at PV/SLACK buses, pu
+    g_shunt: np.ndarray  # [n] float: bus shunt conductance, pu
+    b_shunt: np.ndarray  # [n] float: bus shunt susceptance, pu
+
+    # Branches ---------------------------------------------------------------
+    from_bus: np.ndarray  # [m] int
+    to_bus: np.ndarray  # [m] int
+    r: np.ndarray  # [m] float: series resistance, pu
+    x: np.ndarray  # [m] float: series reactance, pu
+    b_chg: np.ndarray  # [m] float: total line-charging susceptance, pu
+    tap: np.ndarray  # [m] float: off-nominal tap ratio (1.0 = none)
+    shift: np.ndarray  # [m] float: phase-shift angle, radians
+
+    base_mva: float = 100.0
+
+    @property
+    def n_bus(self) -> int:
+        return int(self.bus_type.shape[0])
+
+    @property
+    def n_branch(self) -> int:
+        return int(self.from_bus.shape[0])
+
+    @property
+    def slack(self) -> int:
+        return int(np.argmax(self.bus_type == SLACK))
+
+    def validate(self) -> "BusSystem":
+        if np.sum(self.bus_type == SLACK) != 1:
+            raise ValueError("exactly one slack bus required")
+        n = self.n_bus
+        for ends in (self.from_bus, self.to_bus):
+            if ends.size and (ends.min() < 0 or ends.max() >= n):
+                raise ValueError("branch endpoints out of range")
+        if np.any(self.x == 0):
+            raise ValueError("zero branch reactance")
+        return self
+
+    def with_injections(self, p_inj=None, q_inj=None) -> "BusSystem":
+        kw = {}
+        if p_inj is not None:
+            kw["p_inj"] = np.asarray(p_inj)
+        if q_inj is not None:
+            kw["q_inj"] = np.asarray(q_inj)
+        return replace(self, **kw)
+
+
+def branch_admittances(sys: BusSystem, status=None, dtype=None):
+    """Per-branch two-port admittance terms ``(yff, yft, ytf, ytt)``.
+
+    Standard branch model (MATPOWER convention):
+
+        Yff = (ys + j·b/2) / tap²     Yft = -ys / (tap·e^{-jθ})
+        Ytf = -ys / (tap·e^{+jθ})     Ytt =  ys + j·b/2
+
+    scaled by the 0/1 in-service ``status`` vector.  Shared by
+    :func:`ybus_dense` and :func:`freedm_tpu.pf.newton.branch_flows` so
+    the branch model lives in exactly one place.
+    """
+    dtype = dtype or (jnp.float64 if jnp.zeros(0).dtype == jnp.float64 else jnp.float32)
+    z = cplx.as_c(sys.r + 1j * sys.x, dtype=dtype)
+    ys = C(jnp.ones_like(z.re), jnp.zeros_like(z.re)) / z
+    bc2 = C(jnp.zeros_like(z.re), jnp.asarray(sys.b_chg, dtype) / 2.0)
+    tap = jnp.asarray(sys.tap, dtype)
+    tap_shift = cplx.polar(tap, jnp.asarray(sys.shift, dtype))  # tap·e^{jθ}
+
+    if status is None:
+        on = jnp.ones(sys.n_branch, dtype)
+    else:
+        on = jnp.asarray(status, dtype)
+
+    yff = (ys + bc2) / (tap * tap) * on
+    ytt = (ys + bc2) * on
+    yft = -(ys / tap_shift.conj()) * on
+    ytf = -(ys / tap_shift) * on
+    return yff, yft, ytf, ytt
+
+
+def ybus_dense(sys: BusSystem, status: Optional[jnp.ndarray] = None, dtype=None) -> C:
+    """Assemble the dense ``[n, n]`` bus admittance matrix, jit-compatible.
+
+    ``status`` is a ``[m]`` 0/1 branch in-service vector (traced, so N-1
+    batches vmap over it).  Same information content as the reference's
+    per-phase stamping in ``form_Yabc.cpp``, generalized with taps/shifts
+    and vectorized.
+    """
+    dtype = dtype or (jnp.float64 if jnp.zeros(0).dtype == jnp.float64 else jnp.float32)
+    n = sys.n_bus
+    f = jnp.asarray(sys.from_bus)
+    t = jnp.asarray(sys.to_bus)
+    yff, yft, ytf, ytt = branch_admittances(sys, status=status, dtype=dtype)
+
+    def stamp(part):
+        yf, yt, yft_, ytf_ = part
+        m = jnp.zeros((n, n), dtype)
+        m = m.at[f, f].add(yf)
+        m = m.at[t, t].add(yt)
+        m = m.at[f, t].add(yft_)
+        m = m.at[t, f].add(ytf_)
+        return m
+
+    y_re = stamp((yff.re, ytt.re, yft.re, ytf.re))
+    y_im = stamp((yff.im, ytt.im, yft.im, ytf.im))
+    sh = cplx.as_c(sys.g_shunt + 1j * sys.b_shunt, dtype=dtype)
+    y_re = y_re + jnp.diag(sh.re)
+    y_im = y_im + jnp.diag(sh.im)
+    return C(y_re, y_im)

@@ -7,3 +7,9 @@ from freedm_tpu.pf.ladder import (  # noqa: F401
     load_power_kva,
     total_loss_kw,
 )
+from freedm_tpu.pf.newton import (  # noqa: F401
+    NewtonResult,
+    make_newton_solver,
+    branch_flows,
+)
+from freedm_tpu.pf.sweeps import make_sweeps, dense_sweeps, doubling_sweeps  # noqa: F401

@@ -138,49 +138,54 @@ def make_ladder_solver(
             residual=err,
         )
 
+    # The dense sweep matmuls accumulate up to n currents per entry; the
+    # MXU's default reduced-precision passes would cost ~1% there, so
+    # trace at HIGHEST (free for the doubling path, which has no matmuls).
     @jax.jit
     def _solve(s_kva: C, v_source_pu=None):
-        s_pu = s_kva / s_base
-        v0 = _v0(v_source_pu)
-        v_init = v0[None, :] * mask
-        nb = mask.shape[0]
-        zero = cplx.zeros((nb, 3), rdtype)
+        with jax.default_matmul_precision("highest"):
+            s_pu = s_kva / s_base
+            v0 = _v0(v_source_pu)
+            v_init = v0[None, :] * mask
+            nb = mask.shape[0]
+            zero = cplx.zeros((nb, 3), rdtype)
 
-        def cond(carry):
-            _, _, _, it, err = carry
-            return jnp.logical_and(it < max_iter, err >= eps)
+            def cond(carry):
+                _, _, _, it, err = carry
+                return jnp.logical_and(it < max_iter, err >= eps)
 
-        def body(carry):
-            v, i_prev, _, it, _ = carry
-            v_new, i_branch, i_load = _sweep(v, s_pu, v0)
-            err = _root_err(i_branch, i_prev)
-            return (v_new, i_branch, i_load, it + 1, err)
+            def body(carry):
+                v, i_prev, _, it, _ = carry
+                v_new, i_branch, i_load = _sweep(v, s_pu, v0)
+                err = _root_err(i_branch, i_prev)
+                return (v_new, i_branch, i_load, it + 1, err)
 
-        init = (v_init, zero, zero, jnp.int32(0), jnp.asarray(jnp.inf, rdtype))
-        v, i_branch, i_load, it, err = jax.lax.while_loop(cond, body, init)
-        return _finish(v0, v, i_branch, i_load, it, err)
+            init = (v_init, zero, zero, jnp.int32(0), jnp.asarray(jnp.inf, rdtype))
+            v, i_branch, i_load, it, err = jax.lax.while_loop(cond, body, init)
+            return _finish(v0, v, i_branch, i_load, it, err)
 
     @jax.jit
     def _solve_fixed(s_kva: C, v_source_pu=None):
-        s_pu = s_kva / s_base
-        v0 = _v0(v_source_pu)
-        v_init = v0[None, :] * mask
-        nb = mask.shape[0]
-        zero = cplx.zeros((nb, 3), rdtype)
+        with jax.default_matmul_precision("highest"):
+            s_pu = s_kva / s_base
+            v0 = _v0(v_source_pu)
+            v_init = v0[None, :] * mask
+            nb = mask.shape[0]
+            zero = cplx.zeros((nb, 3), rdtype)
 
-        def body(carry, _):
-            # Everything rides in the carry (no stacked scan outputs): only
-            # the final sweep's currents are needed, and stacking
-            # [max_iter, nb, 3] histories would cost O(max_iter) memory on
-            # large feeders.
-            v, _, _, _ = carry
-            v_new, i_branch, i_load = _sweep(v, s_pu, v0)
-            err = _root_err(i_branch, carry[1])
-            return (v_new, i_branch, i_load, err), None
+            def body(carry, _):
+                # Everything rides in the carry (no stacked scan outputs):
+                # only the final sweep's currents are needed, and stacking
+                # [max_iter, nb, 3] histories would cost O(max_iter)
+                # memory on large feeders.
+                v, _, _, _ = carry
+                v_new, i_branch, i_load = _sweep(v, s_pu, v0)
+                err = _root_err(i_branch, carry[1])
+                return (v_new, i_branch, i_load, err), None
 
-        init = (v_init, zero, zero, jnp.asarray(jnp.inf, rdtype))
-        (v, i_branch, i_load, err), _ = jax.lax.scan(body, init, None, length=max_iter)
-        return _finish(v0, v, i_branch, i_load, max_iter, err)
+            init = (v_init, zero, zero, jnp.asarray(jnp.inf, rdtype))
+            (v, i_branch, i_load, err), _ = jax.lax.scan(body, init, None, length=max_iter)
+            return _finish(v0, v, i_branch, i_load, max_iter, err)
 
     def solve(s_load_kva, v_source_pu=None) -> LadderResult:
         return _solve(cplx.as_c(s_load_kva, dtype=rdtype), v_source_pu)

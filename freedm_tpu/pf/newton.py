@@ -1,0 +1,189 @@
+"""Batched Newton-Raphson AC power flow on the bus admittance matrix.
+
+The north-star solver (BASELINE.json): replaces the reference's hand-built
+adjoint pipeline — ``form_Ftheta``/``form_Fv``/``form_J`` plus an explicit
+``inv(Jᵀ)`` (``Broker/src/vvc/VoltVarCtrl.cpp:1222-1245``) — with a
+functional NR iteration whose Jacobian comes from ``jax.jacfwd`` and whose
+gradients (for Volt-VAR control) come from ``jax.grad`` through the
+fixed-iteration variant.
+
+TPU-first choices:
+
+* **Masked full-size formulation, no index gymnastics.**  Classic NR
+  deletes slack/PV rows from the unknown vector, giving data-dependent
+  sizes that XLA cannot tile.  Here the state is always ``[2n]``
+  (θ ‖ V); rows for pinned quantities are replaced by trivial equations
+  (``θ_slack − θ_ref = 0``, ``V_pv − V_set = 0``) whose Jacobian entries
+  are identity — static shapes, vmap/pjit-transparent, same solution.
+* **Everything is traced**: injections, branch status, and start point
+  are solver *arguments*, so a 1024-scenario Monte-Carlo batch or a
+  118-way N-1 contingency screen is one ``vmap`` (Ybus re-assembles
+  per-lane on device; reference re-forms it on host each round).
+* **Dense [2n, 2n] Jacobian solve on the MXU.**  At transmission sizes
+  (10²-10³ buses) batched dense LU beats sparse bookkeeping on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from freedm_tpu.grid.bus import PQ, SLACK, BusSystem, branch_admittances, ybus_dense
+from freedm_tpu.utils import cplx
+from freedm_tpu.utils.cplx import C
+
+
+class NewtonResult(NamedTuple):
+    """Power-flow solution in per-unit."""
+
+    v: jax.Array  # [n] voltage magnitudes
+    theta: jax.Array  # [n] voltage angles, radians
+    p: jax.Array  # [n] realized P injections (incl. slack)
+    q: jax.Array  # [n] realized Q injections (incl. PV/slack)
+    iterations: jax.Array  # [] int32
+    converged: jax.Array  # [] bool
+    mismatch: jax.Array  # [] float: max |free-equation residual|
+
+
+def make_newton_solver(
+    sys: BusSystem,
+    tol: Optional[float] = None,
+    max_iter: int = 10,
+    dtype: Optional[jnp.dtype] = None,
+):
+    """Compile NR solvers for a bus system.
+
+    Returns ``(solve, solve_fixed)``:
+
+    - ``solve(p_inj, q_inj, status, v0, theta0)`` — iterate under
+      ``lax.while_loop`` until the max power mismatch (pu) drops below
+      ``tol`` or ``max_iter`` is hit.
+    - ``solve_fixed(...)`` — always runs ``max_iter`` Newton steps under
+      ``lax.scan``; reverse-mode differentiable (NR is self-correcting, so
+      d(solution)/d(inputs) through the last iterations equals the
+      implicit-function derivative to convergence-level accuracy).
+
+    All arguments are optional overrides of the system's stored values and
+    are traced — ``vmap`` over any of them for scenario/contingency
+    batches.
+
+    ``tol=None`` picks a dtype-appropriate default: 1e-8 in float64,
+    3e-5 in float32 (the TPU default, where 1e-8 is below the mismatch
+    noise floor and would never report convergence).
+    """
+    rdtype = dtype or (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    if tol is None:
+        tol = 1e-8 if rdtype == jnp.float64 else 3e-5
+    n = sys.n_bus
+
+    bus_type = jnp.asarray(sys.bus_type)
+    th_free = (bus_type != SLACK).astype(rdtype)  # θ unknown
+    v_free = (bus_type == PQ).astype(rdtype)  # V unknown
+    free = jnp.concatenate([th_free, v_free])
+    v_set = jnp.asarray(sys.v_set, rdtype)
+    p_sched0 = jnp.asarray(sys.p_inj, rdtype)
+    q_sched0 = jnp.asarray(sys.q_inj, rdtype)
+
+    def _s_calc(y: C, theta, v):
+        vc = cplx.polar(v, theta)
+        i = C(y.re @ vc.re - y.im @ vc.im, y.re @ vc.im + y.im @ vc.re)
+        s = vc * i.conj()
+        return s.re, s.im
+
+    def _residual(x, y: C, p_sched, q_sched):
+        theta, v = x[:n], x[n:]
+        p_calc, q_calc = _s_calc(y, theta, v)
+        f_p = jnp.where(th_free > 0, p_calc - p_sched, theta)
+        f_q = jnp.where(v_free > 0, q_calc - q_sched, v - v_set)
+        return jnp.concatenate([f_p, f_q])
+
+    def _newton_step(x, y, p_sched, q_sched):
+        f = _residual(x, y, p_sched, q_sched)
+        jac = jax.jacfwd(_residual)(x, y, p_sched, q_sched)
+        dx = jnp.linalg.solve(jac, -f)
+        return x + dx, jnp.max(jnp.abs(f * free))
+
+    def _prep(p_inj, q_inj, status, v0, theta0):
+        y = ybus_dense(sys, status=status, dtype=rdtype)
+        p_sched = p_sched0 if p_inj is None else jnp.asarray(p_inj, rdtype)
+        q_sched = q_sched0 if q_inj is None else jnp.asarray(q_inj, rdtype)
+        v_init = jnp.where(v_free > 0, 1.0, v_set).astype(rdtype) if v0 is None else jnp.asarray(v0, rdtype)
+        th_init = jnp.zeros(n, rdtype) if theta0 is None else jnp.asarray(theta0, rdtype)
+        x = jnp.concatenate([th_init, v_init])
+        return x, y, p_sched, q_sched
+
+    def _finish(x, y, p_sched, q_sched, it, err):
+        theta, v = x[:n], x[n:]
+        p_calc, q_calc = _s_calc(y, theta, v)
+        return NewtonResult(
+            v=v,
+            theta=theta,
+            p=p_calc,
+            q=q_calc,
+            iterations=jnp.asarray(it, jnp.int32),
+            converged=err < tol,
+            mismatch=err,
+        )
+
+    # NR is precision-critical: the TPU MXU's default reduced-precision
+    # matmul passes corrupt the batched blocked LU inside
+    # jnp.linalg.solve (observed: residual 1e0 vs 1e-4 at highest) and
+    # would cap the Ybus matvec accuracy. Trace everything at HIGHEST —
+    # at [2n, 2n] Jacobian sizes the extra passes are negligible.
+    @jax.jit
+    def solve(p_inj=None, q_inj=None, status=None, v0=None, theta0=None):
+        with jax.default_matmul_precision("highest"):
+            x, y, ps, qs = _prep(p_inj, q_inj, status, v0, theta0)
+
+            def cond(carry):
+                _, it, err = carry
+                return jnp.logical_and(it < max_iter, err >= tol)
+
+            def body(carry):
+                x, it, _ = carry
+                x_new, err = _newton_step(x, y, ps, qs)
+                return (x_new, it + 1, err)
+
+            x, it, _ = jax.lax.while_loop(
+                cond, body, (x, jnp.int32(0), jnp.asarray(jnp.inf, rdtype))
+            )
+            # Post-update mismatch (the loop's err is pre-update).
+            err = jnp.max(jnp.abs(_residual(x, y, ps, qs) * free))
+            return _finish(x, y, ps, qs, it, err)
+
+    @jax.jit
+    def solve_fixed(p_inj=None, q_inj=None, status=None, v0=None, theta0=None):
+        with jax.default_matmul_precision("highest"):
+            x, y, ps, qs = _prep(p_inj, q_inj, status, v0, theta0)
+
+            def body(x, _):
+                x_new, _ = _newton_step(x, y, ps, qs)
+                return x_new, None
+
+            x, _ = jax.lax.scan(body, x, None, length=max_iter)
+            err = jnp.max(jnp.abs(_residual(x, y, ps, qs) * free))
+            return _finish(x, y, ps, qs, max_iter, err)
+
+    return solve, solve_fixed
+
+
+def branch_flows(sys: BusSystem, result: NewtonResult, status=None, dtype=None) -> tuple[C, C]:
+    """Complex power flows ``(S_from, S_to)`` per branch, pu.
+
+    Information content of the reference's per-branch ``PQb`` output
+    (``DPF_return7.cpp:222-258``), generalized to meshed networks.
+    """
+    rdtype = dtype or result.v.dtype
+    f = jnp.asarray(sys.from_bus)
+    t = jnp.asarray(sys.to_bus)
+    yff, yft, ytf, ytt = branch_admittances(sys, status=status, dtype=rdtype)
+
+    vc = cplx.polar(result.v, result.theta)
+    vf, vt = vc[f], vc[t]
+    i_f = yff * vf + yft * vt
+    i_t = ytf * vf + ytt * vt
+    s_f = vf * i_f.conj()
+    s_t = vt * i_t.conj()
+    return s_f, s_t

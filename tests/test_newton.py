@@ -1,0 +1,221 @@
+"""Newton-Raphson solver correctness.
+
+Oracles, strongest first:
+
+1. **Reverse construction** — pick a random voltage profile, compute the
+   exact injections it implies (numpy complex, independent math), and
+   require NR to recover the profile.  Catches any systematic modeling
+   error in Ybus or the mismatch equations.
+2. **Ladder cross-check** — on a phase-decoupled radial feeder, phase a
+   of the (independently validated) ladder solver must agree with the
+   single-phase NR solution in the same per-unit system.
+3. Conservation and batching properties.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from freedm_tpu.grid import cases, matpower
+from freedm_tpu.grid.bus import PQ, PV, SLACK, BusSystem, ybus_dense
+from freedm_tpu.grid.feeder import from_branch_table
+from freedm_tpu.pf import ladder
+from freedm_tpu.pf.newton import branch_flows, make_newton_solver
+
+
+def _np_ybus(sys, status=None):
+    y = ybus_dense(sys, status=status)
+    return np.asarray(y.re) + 1j * np.asarray(y.im)
+
+
+def test_recovers_constructed_solution(rng):
+    sys = cases.synthetic_mesh(30, seed=7)
+    n = sys.n_bus
+    # Construct a ground-truth operating point.
+    # Stay in the normal operating region so the flat start converges to
+    # this solution (AC power flow has multiple branches; a wild profile
+    # would be a different, equally valid fixed point).
+    v_true = 1.0 + rng.uniform(-0.03, 0.03, n)
+    th_true = rng.uniform(-0.08, 0.08, n)
+    th_true[sys.slack] = 0.0
+    vc = v_true * np.exp(1j * th_true)
+    s = vc * np.conj(_np_ybus(sys) @ vc)
+
+    bus_type = sys.bus_type
+    sys2 = BusSystem(
+        **{
+            **sys.__dict__,
+            "p_inj": s.real,
+            "q_inj": s.imag,
+            "v_set": np.where(bus_type != PQ, v_true, 1.0),
+        }
+    )
+    solve, _ = make_newton_solver(sys2, tol=1e-10)
+    res = solve()
+    assert bool(res.converged), float(res.mismatch)
+    np.testing.assert_allclose(np.asarray(res.v), v_true, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(res.theta), th_true, atol=1e-8)
+    # Realized injections at *all* buses match the constructed ones
+    # (slack/PV included, since the profile is exactly feasible).
+    np.testing.assert_allclose(np.asarray(res.p), s.real, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(res.q), s.imag, atol=1e-8)
+
+
+def test_matches_ladder_on_decoupled_radial():
+    # Balanced loads + diagonal impedances => phases decouple and phase a
+    # of the 3-phase ladder equals a single-phase NR solve in the same
+    # per-unit system (V_LN base, per-phase power base).
+    edges = [(0, 1), (1, 2), (2, 3), (1, 4)]
+    loads_kw = {1: 30.0, 2: 50.0, 3: -20.0, 4: 40.0}
+    dl = np.zeros((len(edges), 13))
+    for i, (f, t) in enumerate(edges):
+        p = loads_kw[t]
+        q = 0.3 * p
+        dl[i] = [i + 1, f, t, 1, 1.0, 1, p, q, p, q, p, q, 0]
+    z_code = np.eye(3) * (0.9 + 1.1j)
+    feeder = from_branch_table(dl, z_code[None], base_kva=1000.0, base_kv=12.47, v_source_pu=1.02)
+    solve_l, _ = ladder.make_ladder_solver(feeder, eps=1e-12, max_iter=60)
+    res_l = solve_l(feeder.s_load)
+    assert bool(res_l.converged)
+
+    nb = feeder.n_branches
+    n = nb + 1
+    s_pu = feeder.s_load_pu()  # per-phase pu
+    z_pu = feeder.z_pu[:, 0, 0]
+    sys = BusSystem(
+        bus_type=np.array([SLACK] + [PQ] * nb),
+        p_inj=np.concatenate([[0.0], -s_pu[:, 0].real]),  # load = -injection
+        q_inj=np.concatenate([[0.0], -s_pu[:, 0].imag]),
+        v_set=np.full(n, 1.02),
+        g_shunt=np.zeros(n),
+        b_shunt=np.zeros(n),
+        from_bus=feeder.from_node.astype(np.int64),
+        to_bus=np.arange(1, n, dtype=np.int64),
+        r=z_pu.real,
+        x=z_pu.imag,
+        b_chg=np.zeros(nb),
+        tap=np.ones(nb),
+        shift=np.zeros(nb),
+    ).validate()
+    solve_n, _ = make_newton_solver(sys, tol=1e-12)
+    res_n = solve_n()
+    assert bool(res_n.converged)
+
+    v_l, ang_l = ladder.v_polar(res_l)
+    np.testing.assert_allclose(np.asarray(res_n.v), np.asarray(v_l[:, 0]), atol=1e-8)
+    np.testing.assert_allclose(
+        np.asarray(res_n.theta), np.deg2rad(np.asarray(ang_l[:, 0])), atol=1e-8
+    )
+
+
+def test_slack_balances_and_flows_conserve():
+    sys = cases.synthetic_mesh(50, seed=8)
+    solve, _ = make_newton_solver(sys)
+    res = solve()
+    assert bool(res.converged)
+    # PQ buses realize their schedule; PV buses their P and V.
+    pq = sys.bus_type == PQ
+    pv = sys.bus_type == PV
+    np.testing.assert_allclose(np.asarray(res.p)[pq], sys.p_inj[pq], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(res.q)[pq], sys.q_inj[pq], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(res.p)[pv], sys.p_inj[pv], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(res.v)[pv], sys.v_set[pv], atol=1e-9)
+    # Total injections = losses >= 0 (no shunts in this case).
+    s_f, s_t = branch_flows(sys, res)
+    loss = np.asarray((s_f + s_t).re).sum()
+    assert loss >= 0
+    assert np.asarray(res.p).sum() == pytest.approx(loss, abs=1e-6)
+    # Bus injections equal the sum of incident branch flows.
+    p_from_flows = np.zeros(sys.n_bus)
+    np.add.at(p_from_flows, sys.from_bus, np.asarray(s_f.re))
+    np.add.at(p_from_flows, sys.to_bus, np.asarray(s_t.re))
+    np.testing.assert_allclose(p_from_flows, np.asarray(res.p), atol=1e-6)
+
+
+def test_vmap_scenarios_and_contingencies():
+    sys = cases.synthetic_mesh(40, seed=9)
+    solve, _ = make_newton_solver(sys)
+
+    scales = jnp.linspace(0.5, 1.1, 8)
+    batch = jax.vmap(lambda s: solve(p_inj=sys.p_inj * s, q_inj=sys.q_inj * s))(scales)
+    assert bool(jnp.all(batch.converged)), np.asarray(batch.mismatch)
+    assert batch.v.shape == (8, sys.n_bus)
+
+    # N-1 over the chords (ring stays intact => network stays connected).
+    m = sys.n_branch
+    n_ring = sys.n_bus
+    outages = []
+    for k in range(n_ring, m):
+        st = np.ones(m)
+        st[k] = 0.0
+        outages.append(st)
+    outages = jnp.asarray(np.stack(outages))
+    nminus1 = jax.vmap(lambda st: solve(status=st))(outages)
+    assert bool(jnp.all(nminus1.converged))
+    # Outages actually change the solution.
+    base = solve()
+    dv = jnp.max(jnp.abs(nminus1.v - base.v[None, :]))
+    assert float(dv) > 1e-9
+
+
+def test_gradient_through_fixed_solver():
+    sys = cases.synthetic_mesh(20, seed=10)
+    _, solve_fixed = make_newton_solver(sys, max_iter=8)
+
+    def loss_fn(q_inj):
+        res = solve_fixed(q_inj=q_inj)
+        s_f, s_t = branch_flows(sys, res)
+        return jnp.sum((s_f + s_t).re)  # total network losses
+
+    g = jax.grad(loss_fn)(jnp.asarray(sys.q_inj))
+    assert g.shape == (sys.n_bus,)
+    assert bool(jnp.all(jnp.isfinite(g)))
+    # Finite-difference check on one coordinate.
+    i = int(np.argmax(np.abs(np.asarray(g))))
+    eps = 1e-6
+    qp = np.asarray(sys.q_inj, dtype=np.float64).copy()
+    qm = qp.copy()
+    qp[i] += eps
+    qm[i] -= eps
+    fd = (float(loss_fn(jnp.asarray(qp))) - float(loss_fn(jnp.asarray(qm)))) / (2 * eps)
+    assert fd == pytest.approx(float(g[i]), rel=1e-4, abs=1e-8)
+
+
+def test_matpower_parser():
+    case = """
+function mpc = case4
+mpc.version = '2';
+mpc.baseMVA = 100;
+mpc.bus = [
+  1 3 0   0  0 0 1 1.00 0 230 1 1.1 0.9;
+  2 2 0   0  0 0 1 1.00 0 230 1 1.1 0.9;
+  3 1 90  30 0 0 1 1.00 0 230 1 1.1 0.9;
+  4 1 50  10 0 5 1 1.00 0 230 1 1.1 0.9;
+];
+mpc.gen = [
+  1 0  0 300 -300 1.02 100 1 250 10;
+  2 80 0 300 -300 1.03 100 1 250 10;
+  3 10 5 300 -300 1.00 100 0 250 10; % out of service
+];
+mpc.branch = [
+  1 2 0.01 0.06 0.02 250 250 250 0    0  1 -360 360;
+  1 3 0.02 0.08 0.01 250 250 250 0    0  1 -360 360;
+  2 4 0.01 0.05 0.02 250 250 250 0.98 2  1 -360 360;
+  3 4 0.03 0.09 0.00 250 250 250 0    0  0 -360 360; % out of service
+];
+"""
+    sys = matpower.from_mpc(matpower.parse_case_text(case))
+    assert sys.n_bus == 4
+    assert sys.n_branch == 3  # out-of-service branch dropped
+    assert sys.bus_type[0] == SLACK and sys.bus_type[1] == PV
+    assert sys.p_inj[1] == pytest.approx(0.8)  # 80 MW gen
+    assert sys.p_inj[2] == pytest.approx(-0.9)  # out-of-service gen ignored
+    assert sys.v_set[0] == pytest.approx(1.02)  # VG overrides bus VM
+    assert sys.v_set[1] == pytest.approx(1.03)
+    assert sys.b_shunt[3] == pytest.approx(0.05)
+    assert sys.tap[2] == pytest.approx(0.98)
+    assert sys.shift[2] == pytest.approx(np.deg2rad(2))
+    solve, _ = make_newton_solver(sys)
+    res = solve()
+    assert bool(res.converged)
