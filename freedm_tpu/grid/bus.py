@@ -108,7 +108,7 @@ def branch_admittances(sys: BusSystem, status=None, dtype=None):
     :func:`ybus_dense` and :func:`freedm_tpu.pf.newton.branch_flows` so
     the branch model lives in exactly one place.
     """
-    dtype = dtype or (jnp.float64 if jnp.zeros(0).dtype == jnp.float64 else jnp.float32)
+    dtype = cplx.default_rdtype(dtype)
     z = cplx.as_c(sys.r + 1j * sys.x, dtype=dtype)
     ys = C(jnp.ones_like(z.re), jnp.zeros_like(z.re)) / z
     bc2 = C(jnp.zeros_like(z.re), jnp.asarray(sys.b_chg, dtype) / 2.0)
@@ -135,7 +135,7 @@ def ybus_dense(sys: BusSystem, status: Optional[jnp.ndarray] = None, dtype=None)
     per-phase stamping in ``form_Yabc.cpp``, generalized with taps/shifts
     and vectorized.
     """
-    dtype = dtype or (jnp.float64 if jnp.zeros(0).dtype == jnp.float64 else jnp.float32)
+    dtype = cplx.default_rdtype(dtype)
     n = sys.n_bus
     f = jnp.asarray(sys.from_bus)
     t = jnp.asarray(sys.to_bus)

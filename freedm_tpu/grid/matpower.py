@@ -78,14 +78,21 @@ def from_mpc(mpc: Dict[str, np.ndarray]) -> BusSystem:
     b_shunt = bus[:, 5] / base_mva
 
     if gen is not None and gen.size:
+        live_gen_buses = set()
         for row in gen:
             if gen.shape[1] > 7 and row[7] <= 0:
                 continue  # out-of-service unit
             i = idx[int(row[0])]
+            live_gen_buses.add(i)
             p_inj[i] += row[1] / base_mva
             q_inj[i] += row[2] / base_mva
             if bus_type[i] != PQ and row[5] > 0:
                 v_set[i] = row[5]  # VG overrides bus VM at PV/slack buses
+        # MATPOWER bustypes semantics: a PV bus with no in-service
+        # generator has nothing to hold its voltage — treat it as PQ.
+        for i in range(n):
+            if bus_type[i] == PV and i not in live_gen_buses:
+                bus_type[i] = PQ
 
     status = branch[:, 10] if branch.shape[1] > 10 else np.ones(len(branch))
     live = status > 0

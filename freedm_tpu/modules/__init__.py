@@ -1,0 +1,1 @@
+from freedm_tpu.modules import vvc  # noqa: F401

@@ -1,0 +1,206 @@
+"""Gradient Volt-VAR control (VVC).
+
+TPU-native replacement for the reference's ``vvc`` module (Yue Shi's
+gradient VVC, ``Broker/src/vvc/VoltVarCtrl.hpp:2-8``), whose master round
+(``vvc_main``, ``Broker/src/vvc/VoltVarCtrl.cpp:324-1766``) is:
+
+1. run a base distribution power flow (``DPF_return7.cpp``),
+2. form the adjoint by hand — ``form_Ftheta``/``form_Fv``/``form_J``,
+   ``λ = −(Jᵀ)⁻¹∂F``, loss gradient ``g_vq = −guᵀλ``
+   (``VoltVarCtrl.cpp:1222-1245, 1307-1309``),
+3. project the Q step by the SST kvar limits,
+4. backtracking step-size search re-running the DPF until the loss stops
+   decreasing (``VoltVarCtrl.cpp:1600-1766``, α-scaled ``cvq``),
+5. broadcast the accepted Q setpoints (``GradientMessage`` S2 vector) and
+   per-node voltage deltas to the slave brokers, which average their
+   assigned rows into ``Sst_a/b/c`` gateway commands
+   (``Broker_s1/src/vvc/VoltVarCtrl.cpp:141-154`` + ``vvc_slave``).
+
+Here the whole pipeline is one jittable function:
+
+* step 2 is ``jax.grad`` through the fixed-iteration ladder solve — the
+  hand-built adjoint (and its explicit Jacobian inverse) disappears;
+* step 4 is a ``lax.while_loop`` whose every trial re-solve is the same
+  compiled power flow;
+* step 5 vanishes on-mesh: the accepted Q vector IS the sharded setpoint
+  array (the master/slave ``GradientMessage``/``xx.mat`` hand-off
+  becomes an array update; a DCN broadcast remains only for federation
+  across slices).
+
+The controller is scenario-batchable with ``vmap`` — 1024 Monte-Carlo
+Volt-VAR rounds cost one batched solve instead of 1024 broker rounds.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.grid.feeder import Feeder
+from freedm_tpu.pf import ladder
+from freedm_tpu.utils import cplx
+from freedm_tpu.utils.cplx import C
+
+
+class VVCConfig(NamedTuple):
+    """Controller knobs.
+
+    Mirrors the reference's hard-coded search constants: initial step
+    ``alpha0`` and halving schedule replace the α-scaling of ``cvq``
+    (``VoltVarCtrl.cpp:1600-1766``); ``q_min/q_max`` are the SST kvar
+    limits the reference projects by (``Qlimit``).
+    """
+
+    q_min_kvar: float = -500.0
+    q_max_kvar: float = 500.0
+    alpha0: float = 1.0
+    backtrack: float = 0.5  # step shrink factor per rejected trial
+    max_backtracks: int = 12
+    pf_iters: int = 20  # fixed ladder iterations per trial solve
+
+
+class VVCStep(NamedTuple):
+    """One accepted VVC round."""
+
+    q_ctrl_kvar: jax.Array  # [nb, 3] accepted Q setpoints (0 where not controlled)
+    loss_before_kw: jax.Array  # [] base-solve losses
+    loss_after_kw: jax.Array  # [] losses at the accepted setpoints
+    alpha: jax.Array  # [] accepted step size (0 if no improving step found)
+    improved: jax.Array  # [] bool: a descent step was accepted
+    grad_kw_per_kvar: jax.Array  # [nb, 3] loss gradient at the start point
+    v_delta_pu: jax.Array  # [nn, 3] voltage magnitude change vs the base solve
+
+
+def make_vvc_controller(
+    feeder: Feeder,
+    ctrl_mask: Optional[np.ndarray] = None,
+    config: VVCConfig = VVCConfig(),
+    dtype: Optional[jnp.dtype] = None,
+):
+    """Build the jitted VVC round function.
+
+    ``ctrl_mask`` is a ``[nb, 3]`` 0/1 array marking controllable
+    node-phases (the reference's SST rows of the S2 vector); default:
+    every live node-phase is controllable.
+
+    Returns ``step(s_load_kva, q_ctrl_kvar) -> VVCStep`` where
+    ``s_load_kva`` is the current load reading (device tensor slice) and
+    ``q_ctrl_kvar`` the setpoints accepted last round.
+    """
+    rdtype = cplx.default_rdtype(dtype)
+    mask = jnp.asarray(
+        feeder.phase_mask if ctrl_mask is None else ctrl_mask, dtype=rdtype
+    )
+    _, solve_fixed = ladder.make_ladder_solver(
+        feeder, max_iter=config.pf_iters, dtype=rdtype
+    )
+
+    def _solve(s_load: C, q_kvar):
+        # Injecting reactive power *reduces* the load's Q draw.
+        return solve_fixed(C(s_load.re, s_load.im - q_kvar * mask))
+
+    def _loss_aux(q_kvar, s_load: C):
+        result = _solve(s_load, q_kvar)
+        return ladder.total_loss_kw(feeder, result), result
+
+    def _loss(q_kvar, s_load: C):
+        return _loss_aux(q_kvar, s_load)[0]
+
+    def _project(q_kvar):
+        return jnp.clip(q_kvar, config.q_min_kvar, config.q_max_kvar) * mask
+
+    # has_aux shares the base power-flow solve between the loss/gradient
+    # pass and the voltage-delta baseline (one solve instead of two).
+    grad_fn = jax.value_and_grad(_loss_aux, has_aux=True)
+
+    @jax.jit
+    def _step(s_load: C, q0, alpha_start) -> VVCStep:
+        (loss0, base), g = grad_fn(q0, s_load)
+        v_base = base.v_node.abs()
+
+        # Backtracking: shrink α until the projected step descends
+        # (reference: re-run DPF per trial, accept on loss decrease,
+        # VoltVarCtrl.cpp:1600-1766).
+        def cond(carry):
+            k, _, _, accepted = carry
+            return jnp.logical_and(k < config.max_backtracks, jnp.logical_not(accepted))
+
+        def body(carry):
+            k, alpha, _, _ = carry
+            q_try = _project(q0 - alpha * g)
+            loss_try = _loss(q_try, s_load)
+            accepted = loss_try < loss0
+            return (
+                k + 1,
+                jnp.where(accepted, alpha, alpha * config.backtrack),
+                jnp.where(accepted, loss_try, loss0),
+                accepted,
+            )
+
+        k, alpha, loss1, accepted = jax.lax.while_loop(
+            cond,
+            body,
+            (jnp.int32(0), alpha_start, loss0, jnp.asarray(False)),
+        )
+
+        q1 = jnp.where(accepted, _project(q0 - alpha * g), q0)
+        after = _solve(s_load, q1)
+        v_after = after.v_node.abs()
+
+        return VVCStep(
+            q_ctrl_kvar=q1,
+            loss_before_kw=loss0,
+            loss_after_kw=jnp.where(accepted, loss1, loss0),
+            alpha=jnp.where(accepted, alpha, jnp.zeros((), rdtype)),
+            improved=accepted,
+            grad_kw_per_kvar=g,
+            v_delta_pu=v_after - v_base,
+        )
+
+    def step(s_load_kva, q_ctrl_kvar, alpha0=None) -> VVCStep:
+        # Complex -> (re, im) conversion stays OUTSIDE jit: a complex
+        # array must never become a jit argument (the TPU backend has no
+        # complex dtype to transfer it as).
+        s_load = cplx.as_c(s_load_kva, dtype=rdtype)
+        alpha_start = jnp.asarray(
+            config.alpha0 if alpha0 is None else alpha0, rdtype
+        )
+        return _step(s_load, jnp.asarray(q_ctrl_kvar, rdtype), alpha_start)
+
+    return step
+
+
+def run_rounds(
+    step, s_load_kva, q0_kvar, n_rounds: int, alpha0: float = 2000.0
+):
+    """Iterate ``n_rounds`` VVC rounds under ``lax.scan`` (host-free loop).
+
+    The accepted step size is warm-started across rounds (doubled after
+    an accepted round, halved after a dry one) — the same adaptivity the
+    reference gets from re-scaling ``cvq`` between rounds.
+
+    Returns the final setpoints and the per-round loss trajectory — the
+    information the reference logs per 3000 ms ``VVCManage`` round
+    (``VoltVarCtrl.cpp:249-271``), produced here in one device program.
+    """
+    s_load = cplx.as_c(s_load_kva)
+
+    def body(carry, _):
+        q, alpha = carry
+        out = step(s_load, q, alpha)
+        alpha_next = jnp.where(out.improved, out.alpha * 2.0, alpha * 0.5)
+        alpha_next = jnp.maximum(alpha_next, 1e-3)
+        return (out.q_ctrl_kvar, alpha_next), (
+            out.loss_after_kw,
+            out.alpha,
+            out.improved,
+        )
+
+    q0 = jnp.asarray(q0_kvar)
+    (q_final, _), (losses, alphas, improved) = jax.lax.scan(
+        body, (q0, jnp.asarray(alpha0, q0.dtype)), None, length=n_rounds
+    )
+    return q_final, losses, alphas, improved

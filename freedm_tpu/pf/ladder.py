@@ -92,7 +92,7 @@ def make_ladder_solver(
     "doubling", or ``None`` to auto-select; see
     :mod:`freedm_tpu.pf.sweeps`).
     """
-    rdtype = dtype or (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    rdtype = cplx.default_rdtype(dtype)
 
     backward, forward = make_sweeps(feeder, rdtype, sweep_method)
     mask = jnp.asarray(feeder.phase_mask, dtype=rdtype)

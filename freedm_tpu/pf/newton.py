@@ -73,7 +73,7 @@ def make_newton_solver(
     3e-5 in float32 (the TPU default, where 1e-8 is below the mismatch
     noise floor and would never report convergence).
     """
-    rdtype = dtype or (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    rdtype = cplx.default_rdtype(dtype)
     if tol is None:
         tol = 1e-8 if rdtype == jnp.float64 else 3e-5
     n = sys.n_bus

@@ -26,6 +26,15 @@ import numpy as np
 ArrayLike = Union[jax.Array, np.ndarray, float]
 
 
+def default_rdtype(dtype=None):
+    """The framework's default real dtype: float64 when x64 is enabled
+    (CPU reference/tests), float32 otherwise (TPU). Pass ``dtype`` to
+    override."""
+    if dtype is not None:
+        return dtype
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
 class C(NamedTuple):
     """A complex tensor as a (re, im) pair of equal-shape real arrays."""
 
@@ -111,8 +120,11 @@ def as_c(x, dtype=None) -> C:
     """Coerce a complex/real array-like (or C) into a :class:`C` pair."""
     if isinstance(x, C):
         return x.astype(dtype) if dtype is not None else x
-    if isinstance(x, (jax.Array, jnp.ndarray)) and not jnp.iscomplexobj(x):
-        re, im = x, jnp.zeros_like(x)
+    if isinstance(x, (jax.Array, jnp.ndarray)):
+        if jnp.iscomplexobj(x):  # only off-TPU; TPU has no complex dtype
+            re, im = jnp.real(x), jnp.imag(x)
+        else:
+            re, im = x, jnp.zeros_like(x)
     else:
         a = np.asarray(x)
         re, im = np.ascontiguousarray(a.real), np.ascontiguousarray(a.imag)

@@ -190,7 +190,7 @@ mpc.baseMVA = 100;
 mpc.bus = [
   1 3 0   0  0 0 1 1.00 0 230 1 1.1 0.9;
   2 2 0   0  0 0 1 1.00 0 230 1 1.1 0.9;
-  3 1 90  30 0 0 1 1.00 0 230 1 1.1 0.9;
+  3 2 90  30 0 0 1 1.00 0 230 1 1.1 0.9; % PV on paper, but its only unit is off
   4 1 50  10 0 5 1 1.00 0 230 1 1.1 0.9;
 ];
 mpc.gen = [
@@ -209,6 +209,7 @@ mpc.branch = [
     assert sys.n_bus == 4
     assert sys.n_branch == 3  # out-of-service branch dropped
     assert sys.bus_type[0] == SLACK and sys.bus_type[1] == PV
+    assert sys.bus_type[2] == PQ  # PV bus with no live unit degrades to PQ
     assert sys.p_inj[1] == pytest.approx(0.8)  # 80 MW gen
     assert sys.p_inj[2] == pytest.approx(-0.9)  # out-of-service gen ignored
     assert sys.v_set[0] == pytest.approx(1.02)  # VG overrides bus VM
