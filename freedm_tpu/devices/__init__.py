@@ -1,0 +1,10 @@
+from freedm_tpu.devices.schema import (  # noqa: F401
+    DeviceType,
+    SignalLayout,
+    DEFAULT_TYPES,
+    compile_layout,
+    parse_device_xml,
+)
+from freedm_tpu.devices.tensor import DeviceTensor  # noqa: F401
+from freedm_tpu.devices.manager import DeviceManager  # noqa: F401
+from freedm_tpu.devices.factory import AdapterFactory, AdapterSpec, parse_adapter_xml  # noqa: F401

@@ -1,0 +1,2 @@
+from freedm_tpu.devices.adapters.base import Adapter, BufferAdapter  # noqa: F401
+from freedm_tpu.devices.adapters.fake import FakeAdapter  # noqa: F401

@@ -1,0 +1,135 @@
+"""Adapter interfaces.
+
+Host-side equivalents of the reference's device adapter hierarchy:
+``IAdapter`` (Start/Stop/GetState/SetCommand + device registration/reveal,
+``Broker/src/device/IAdapter.hpp``) and ``IBufferAdapter`` (shared
+state/command float vectors with signal→index registration and rw-locks,
+``Broker/src/device/IBufferAdapter.hpp:47-72``).
+
+Adapters are the *ingress/egress boundary* of the framework: everything
+on-mesh reads the :class:`~freedm_tpu.devices.tensor.DeviceTensor`; the
+manager pumps adapter buffers into/out of it once per superstep.  The
+``NULL_COMMAND`` sentinel (reference ``IAdapter.hpp``) marks "no command
+issued this round".
+"""
+
+from __future__ import annotations
+
+import threading
+from abc import ABC, abstractmethod
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from freedm_tpu.core.config import NULL_COMMAND
+
+
+class Adapter(ABC):
+    """Abstract device adapter.
+
+    Lifecycle mirrors the reference: construct → ``register_device`` for
+    each owned device → ``start`` → (``get_state``/``set_command`` from
+    the manager) → ``stop``.  Devices stay *hidden* until
+    ``reveal_devices`` flips them live (reference: RegisterDevice /
+    RevealDevices, ``IAdapter.cpp``).
+    """
+
+    def __init__(self) -> None:
+        self._devices: List[str] = []
+        self._revealed = False
+
+    # -- registration -------------------------------------------------------
+    def register_device(self, name: str) -> None:
+        if self._revealed:
+            raise RuntimeError("cannot register after reveal")
+        self._devices.append(name)
+
+    @property
+    def devices(self) -> Tuple[str, ...]:
+        return tuple(self._devices)
+
+    def reveal_devices(self) -> None:
+        self._revealed = True
+
+    @property
+    def revealed(self) -> bool:
+        return self._revealed
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    def stop(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    # -- signal access ------------------------------------------------------
+    @abstractmethod
+    def get_state(self, device: str, signal: str) -> float: ...
+
+    @abstractmethod
+    def set_command(self, device: str, signal: str, value: float) -> None: ...
+
+
+class BufferAdapter(Adapter):
+    """Adapter backed by index-registered state/command buffers.
+
+    The reference's ``IBufferAdapter``: external transports (RTDS, PSCAD
+    tables) exchange *whole buffers* whose entries were bound to
+    (device, signal) pairs by ``adapter.xml`` ``<entry index=...>``
+    tables.  Thread-safe: the transport thread swaps buffers while the
+    manager reads/writes per-signal.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._state_index: Dict[Tuple[str, str], int] = {}
+        self._command_index: Dict[Tuple[str, str], int] = {}
+        self._lock = threading.Lock()
+        self._state: np.ndarray = np.zeros(0, np.float32)
+        self._command: np.ndarray = np.zeros(0, np.float32)
+
+    # -- index registration (adapter.xml entry tables) ----------------------
+    def bind_state(self, device: str, signal: str, index: int) -> None:
+        self._state_index[(device, signal)] = index
+
+    def bind_command(self, device: str, signal: str, index: int) -> None:
+        self._command_index[(device, signal)] = index
+
+    def finalize_bindings(self) -> None:
+        """Size the buffers once all entries are bound.
+
+        Indices must form a dense 0..n-1 range per buffer, like the
+        reference's 1-based ``<entry index>`` checked by CAdapterFactory.
+        """
+        for name, idx in (("state", self._state_index), ("command", self._command_index)):
+            if idx and sorted(idx.values()) != list(range(len(idx))):
+                raise ValueError(f"{name} entry indices are not dense 0..{len(idx) - 1}")
+        self._state = np.zeros(len(self._state_index), np.float32)
+        self._command = np.full(len(self._command_index), NULL_COMMAND, np.float32)
+
+    # -- transport side -----------------------------------------------------
+    def swap_state(self, new_state: np.ndarray) -> np.ndarray:
+        """Install a freshly received state buffer; returns the command
+        buffer to transmit (copy)."""
+        with self._lock:
+            if new_state.shape != self._state.shape:
+                raise ValueError("state buffer size mismatch")
+            self._state = np.asarray(new_state, np.float32).copy()
+            return self._command.copy()
+
+    # -- manager side -------------------------------------------------------
+    def get_state(self, device: str, signal: str) -> float:
+        with self._lock:
+            return float(self._state[self._state_index[(device, signal)]])
+
+    def set_command(self, device: str, signal: str, value: float) -> None:
+        with self._lock:
+            self._command[self._command_index[(device, signal)]] = value
+
+    @property
+    def state_size(self) -> int:
+        return len(self._state_index)
+
+    @property
+    def command_size(self) -> int:
+        return len(self._command_index)

@@ -1,0 +1,29 @@
+"""In-memory adapter for tests and demos.
+
+Reference: ``CFakeAdapter`` (``Broker/src/device/CFakeAdapter.hpp:47-90``)
+— commands take effect as state instantly; no transport.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from freedm_tpu.devices.adapters.base import Adapter
+
+
+class FakeAdapter(Adapter):
+    """Map-backed adapter; ``set_command`` immediately becomes state."""
+
+    def __init__(self, initial: Dict[Tuple[str, str], float] | None = None) -> None:
+        super().__init__()
+        self._values: Dict[Tuple[str, str], float] = dict(initial or {})
+
+    def get_state(self, device: str, signal: str) -> float:
+        return float(self._values.get((device, signal), 0.0))
+
+    def set_command(self, device: str, signal: str, value: float) -> None:
+        self._values[(device, signal)] = float(value)
+
+    # Test hook: drive externally-observed state (e.g. a load change).
+    def set_state(self, device: str, signal: str, value: float) -> None:
+        self._values[(device, signal)] = float(value)

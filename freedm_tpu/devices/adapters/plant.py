@@ -1,0 +1,160 @@
+"""Pure-JAX simulated plant adapter.
+
+The reference tests multi-node control without hardware through two
+rigs: ``CFakeAdapter`` (instant in-memory devices) and the standalone
+``pscad-interface`` table server emulating the simulator side of the
+RTDS protocol (SURVEY.md §2.4, §4).  This adapter replaces both with an
+actual *physics-bearing* plant: a radial feeder solved by the ladder
+power flow each step, with SST/DRER/DESD/Load devices attached to its
+nodes and a frequency droop responding to power imbalance.
+
+Device semantics (signal names from ``device.xml``):
+
+- ``Load.drain``      — node load, kW (random-walks if drift > 0);
+- ``Drer.generation`` — renewable generation, kW;
+- ``Desd.storage``    — storage charge, kWh; commands set charge power;
+- ``Sst.gateway``     — power the node exchanges with the feeder
+  backbone, kW; commanded by LB migrations (SetPStar path,
+  ``lb/LoadBalance.cpp:1000-1075``);
+- ``Omega.frequency`` — system frequency, rad/s: nominal minus droop ×
+  net imbalance (the quantity the reference's LB invariant checks with
+  its hard-coded 376.8 rad/s model, ``lb/LoadBalance.cpp:1237-1277``);
+- ``Fid.state``       — fault-isolation switch, 1 = closed; commands
+  open/close it (drives topology masks in gm).
+
+``step()`` advances the plant one tick; it is host-called but the
+physics inside is the jitted ladder solve, so a plant step costs one
+compiled power flow.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from freedm_tpu.devices.adapters.base import Adapter
+from freedm_tpu.grid.feeder import Feeder
+from freedm_tpu.pf import ladder
+
+NOMINAL_OMEGA = 376.8  # rad/s, the reference's PSCAD model constant
+
+
+class PlantAdapter(Adapter):
+    """Simulated feeder plant with attached grid devices."""
+
+    def __init__(
+        self,
+        feeder: Feeder,
+        placements: Dict[str, Tuple[str, int]],
+        load_drift: float = 0.0,
+        droop: float = 0.02,
+        dt_hours: float = 1.0 / 3600.0,
+        seed: int = 0,
+    ) -> None:
+        """``placements``: device name → (type, feeder branch index)."""
+        super().__init__()
+        self.feeder = feeder
+        self.placements = dict(placements)
+        self.load_drift = load_drift
+        self.droop = droop
+        self.dt_hours = dt_hours
+        self._rng = np.random.default_rng(seed)
+        self._solve, _ = ladder.make_ladder_solver(feeder)
+
+        nb = feeder.n_branches
+        self._load_kw = np.zeros(nb)
+        self._gen_kw = np.zeros(nb)
+        self._gateway_kw = np.zeros(nb)
+        self._storage_kwh = np.zeros(nb)
+        self._charge_kw = np.zeros(nb)
+        self._fid_closed: Dict[str, float] = {}
+        self._omega = NOMINAL_OMEGA
+        self._v_mag: Optional[np.ndarray] = None
+
+        base = np.asarray(feeder.s_load.real).sum(axis=1)
+        for name, (tname, node) in self.placements.items():
+            if tname == "Load":
+                self._load_kw[node] = max(base[node], 0.0)
+            elif tname == "Drer":
+                self._gen_kw[node] = max(-base[node], 0.0) or 10.0
+            elif tname == "Desd":
+                self._storage_kwh[node] = 5.0
+            elif tname == "Fid":
+                self._fid_closed[name] = 1.0
+
+    # -- physics -------------------------------------------------------------
+    def step(self) -> None:
+        """Advance one tick: drift loads, integrate storage, solve PF."""
+        if self.load_drift > 0:
+            live = self._load_kw > 0
+            walk = self._rng.normal(0.0, self.load_drift, self._load_kw.shape)
+            self._load_kw = np.where(live, np.maximum(self._load_kw * (1 + walk), 0.0), 0.0)
+        self._storage_kwh = np.maximum(
+            self._storage_kwh + self._charge_kw * self.dt_hours, 0.0
+        )
+
+        # Net per-node demand seen by the feeder: load - generation -
+        # gateway import + storage charging.
+        net_kw = self._load_kw - self._gen_kw - self._gateway_kw + self._charge_kw
+        s = (net_kw / 3.0)[:, None] * np.ones(3)[None, :] * (1 + 0.3j)
+        res = self._solve(s.astype(np.complex128))
+        self._v_mag = np.asarray(ladder.v_polar(res)[0])
+
+        # Frequency droop on total imbalance (generation+import-load).
+        imbalance = float(self._gen_kw.sum() + self._gateway_kw.sum() - self._load_kw.sum())
+        self._omega = NOMINAL_OMEGA * (1.0 + self.droop * imbalance / max(self.total_load_kw, 1.0))
+
+    @property
+    def total_load_kw(self) -> float:
+        return float(self._load_kw.sum())
+
+    @property
+    def omega(self) -> float:
+        return self._omega
+
+    def voltage_pu(self, node: int) -> float:
+        if self._v_mag is None:
+            return float("nan")
+        live = self._v_mag[node + 1] > 0
+        return float(self._v_mag[node + 1][live].mean()) if live.any() else 0.0
+
+    # -- Adapter surface ------------------------------------------------------
+    def start(self) -> None:
+        self.step()
+
+    def get_state(self, device: str, signal: str) -> float:
+        tname, node = self.placements[device]
+        if (tname, signal) == ("Load", "drain"):
+            return float(self._load_kw[node])
+        if (tname, signal) == ("Drer", "generation"):
+            return float(self._gen_kw[node])
+        if (tname, signal) == ("Desd", "storage"):
+            return float(self._storage_kwh[node])
+        if (tname, signal) == ("Sst", "gateway"):
+            return float(self._gateway_kw[node])
+        if (tname, signal) == ("Omega", "frequency"):
+            return float(self._omega)
+        if (tname, signal) == ("Fid", "state"):
+            return float(self._fid_closed.get(device, 1.0))
+        raise KeyError(f"unknown state signal {signal!r} for {tname} device {device!r}")
+
+    def set_command(self, device: str, signal: str, value: float) -> None:
+        tname, node = self.placements[device]
+        if (tname, signal) == ("Sst", "gateway"):
+            self._gateway_kw[node] = float(value)
+        elif (tname, signal) == ("Desd", "storage"):
+            self._charge_kw[node] = float(value)
+        elif (tname, signal) == ("Fid", "state"):
+            self._fid_closed[device] = 1.0 if value > 0.5 else 0.0
+        else:
+            raise KeyError(f"unknown command signal {signal!r} for {tname} device {device!r}")
+
+    # Test hooks ---------------------------------------------------------------
+    def set_generation(self, device: str, kw: float) -> None:
+        _, node = self.placements[device]
+        self._gen_kw[node] = kw
+
+    def set_load(self, device: str, kw: float) -> None:
+        _, node = self.placements[device]
+        self._load_kw[node] = kw

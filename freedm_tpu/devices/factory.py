@@ -1,0 +1,176 @@
+"""Adapter factory: construct adapters from ``adapter.xml``.
+
+Reference: ``CAdapterFactory`` (``Broker/src/device/CAdapterFactory.cpp``)
+— a singleton owning a second io_service thread that parses
+``adapter.xml``, builds adapters by type string {rtds, pnp, fake,
+opendss} (``:264-274``; mqtt wired but disabled ``:100-107``), registers
+their devices, and runs the PnP TCP session server.
+
+Here the factory is an ordinary object (no singletons) with a
+type-string registry that ships the reference's adapter set — ``fake``,
+``rtds``, ``pnp``, ``opendss``, ``mqtt``, plus the TPU-native ``plant``
+(pure-JAX simulated plant, replacing the pscad-interface rig) — and is
+extensible with user adapter classes.
+
+XML format (reference ``Broker/config/samples/adapter.xml``)::
+
+    <root>
+      <adapter name="simulation" type="rtds">
+        <info><host>...</host><port>...</port></info>
+        <state>  <entry index="1"><type>Sst</type><device>SST1</device>
+                 <signal>gateway</signal></entry> ... </state>
+        <command> ... </command>
+      </adapter>
+    </root>
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+from freedm_tpu.devices.adapters.base import Adapter, BufferAdapter
+from freedm_tpu.devices.adapters.fake import FakeAdapter
+from freedm_tpu.devices.manager import DeviceManager
+from freedm_tpu.devices.schema import read_xml_source
+
+
+@dataclass(frozen=True)
+class EntryBinding:
+    """One ``<entry>`` row: buffer index ↔ (type, device, signal)."""
+
+    index: int  # 0-based (XML is 1-based, like the reference)
+    type_name: str
+    device: str
+    signal: str
+
+
+@dataclass(frozen=True)
+class AdapterSpec:
+    """Parsed ``<adapter>`` element."""
+
+    name: str
+    type: str
+    info: Dict[str, str] = field(default_factory=dict)
+    state: Tuple[EntryBinding, ...] = ()
+    command: Tuple[EntryBinding, ...] = ()
+
+    @property
+    def devices(self) -> Tuple[Tuple[str, str], ...]:
+        """Unique (device, type) pairs across both entry tables."""
+        return tuple(
+            dict.fromkeys((e.device, e.type_name) for e in self.state + self.command)
+        )
+
+
+def parse_adapter_xml(source: Union[str, Path]) -> Tuple[AdapterSpec, ...]:
+    """Parse a reference-format ``adapter.xml`` (path or raw text)."""
+    root = ET.fromstring(read_xml_source(source))
+
+    def entries(parent) -> Tuple[EntryBinding, ...]:
+        if parent is None:
+            return ()
+        out = []
+        for e in parent.findall("entry"):
+            out.append(
+                EntryBinding(
+                    index=int(e.get("index")) - 1,
+                    type_name=e.findtext("type"),
+                    device=e.findtext("device"),
+                    signal=e.findtext("signal"),
+                )
+            )
+        return tuple(out)
+
+    specs = []
+    for node in root.findall("adapter"):
+        info = {c.tag: (c.text or "").strip() for c in node.find("info")} if node.find("info") is not None else {}
+        specs.append(
+            AdapterSpec(
+                name=node.get("name"),
+                type=node.get("type"),
+                info=info,
+                state=entries(node.find("state")),
+                command=entries(node.find("command")),
+            )
+        )
+    if not specs:
+        raise ValueError("no <adapter> entries found")
+    return tuple(specs)
+
+
+AdapterCtor = Callable[[AdapterSpec, DeviceManager], Adapter]
+
+
+class AdapterFactory:
+    """Build, own, and start/stop adapters; register their devices."""
+
+    def __init__(self, manager: DeviceManager):
+        self.manager = manager
+        self.adapters: Dict[str, Adapter] = {}
+        self._registry: Dict[str, AdapterCtor] = {}
+        self.register_type("fake", _make_fake)
+        # Transport-backed adapters are registered lazily by their
+        # modules (rtds/pnp/plant import sockets/jax; see
+        # freedm_tpu.devices.adapters.*).
+
+    def register_type(self, type_name: str, ctor: AdapterCtor) -> None:
+        self._registry[type_name] = ctor
+
+    @property
+    def known_types(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._registry))
+
+    def create_adapter(self, spec: AdapterSpec) -> Adapter:
+        """Construct one adapter, register + reveal its devices.
+
+        Mirrors ``CAdapterFactory::CreateAdapter``: unknown type is a
+        hard error; device registration happens before reveal.
+        """
+        if spec.name in self.adapters:
+            raise ValueError(f"duplicate adapter name {spec.name!r}")
+        try:
+            ctor = self._registry[spec.type]
+        except KeyError:
+            raise ValueError(
+                f"unknown adapter type {spec.type!r} (known: {', '.join(self.known_types)})"
+            ) from None
+        adapter = ctor(spec, self.manager)
+        try:
+            for device, type_name in spec.devices:
+                self.manager.add_device(device, type_name, adapter)
+        except Exception:
+            # Roll back partial registration so a corrected spec can
+            # retry without phantom "duplicate device" errors.
+            self.manager.remove_adapter_devices(adapter)
+            raise
+        if isinstance(adapter, BufferAdapter):
+            for e in spec.state:
+                adapter.bind_state(e.device, e.signal, e.index)
+            for e in spec.command:
+                adapter.bind_command(e.device, e.signal, e.index)
+            adapter.finalize_bindings()
+        adapter.reveal_devices()
+        self.adapters[spec.name] = adapter
+        return adapter
+
+    def create_from_xml(self, source: Union[str, Path]) -> Tuple[Adapter, ...]:
+        return tuple(self.create_adapter(s) for s in parse_adapter_xml(source))
+
+    def start(self) -> None:
+        for a in self.adapters.values():
+            a.start()
+
+    def stop(self) -> None:
+        """Stop adapters and drop their devices (clean teardown,
+        reference ``CAdapterFactory::Stop``)."""
+        for a in self.adapters.values():
+            a.stop()
+            self.manager.remove_adapter_devices(a)
+        self.adapters.clear()
+
+
+def _make_fake(spec: AdapterSpec, manager: DeviceManager) -> Adapter:
+    return FakeAdapter()

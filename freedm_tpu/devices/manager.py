@@ -1,0 +1,162 @@
+"""Device manager: host registry bridging adapters and the device tensor.
+
+Reference: ``CDeviceManager`` (``Broker/src/device/CDeviceManager.hpp:66-76``)
+— a global name→device registry with hidden/revealed lifecycle, type
+queries and net-value aggregation, feeding the DGI modules.
+
+Here the manager owns the *slot map* (device name → row of the padded
+tensor) and two pumps:
+
+- :meth:`snapshot` — read every live device's state signals from its
+  adapter into a fresh :class:`~freedm_tpu.devices.tensor.DeviceTensor`
+  (the per-superstep ingress);
+- :meth:`apply_commands` — write the tensor's non-NULL commands back to
+  the adapters (the per-superstep egress).
+
+Modules never touch adapters: they read/write the tensor on device.
+Dynamic plug-and-play arrival/departure = slot assignment/release with
+the ``alive`` mask; shapes never change (max-padding, SURVEY.md §7 (v)).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.core.config import NULL_COMMAND
+from freedm_tpu.devices import tensor as dt
+from freedm_tpu.devices.adapters.base import Adapter
+from freedm_tpu.devices.schema import SignalLayout, compile_layout
+
+
+@dataclass
+class _Slot:
+    name: str
+    type_name: str
+    adapter: Adapter
+    row: int
+
+
+class DeviceManager:
+    """Slot-mapped device registry over a fixed-capacity tensor."""
+
+    def __init__(self, layout: Optional[SignalLayout] = None, capacity: int = 64):
+        self.layout = layout or compile_layout()
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._slots: Dict[str, _Slot] = {}
+        self._free: List[int] = list(range(capacity))
+
+    # -- registration (CAdapterFactory::CreateDevice path) ------------------
+    def add_device(self, name: str, type_name: str, adapter: Adapter) -> int:
+        """Assign a tensor row; device stays hidden until adapter reveal."""
+        with self._lock:
+            if name in self._slots:
+                raise ValueError(f"duplicate device {name!r}")
+            if type_name not in self.layout.type_ids:
+                raise ValueError(f"unknown device type {type_name!r}")
+            if not self._free:
+                raise RuntimeError("device capacity exhausted")
+            row = heapq.heappop(self._free)  # lowest free slot: rows stay compact
+            self._slots[name] = _Slot(name, type_name, adapter, row)
+            adapter.register_device(name)
+            return row
+
+    def remove_device(self, name: str) -> None:
+        """Release a slot (PnP heartbeat timeout / session close)."""
+        with self._lock:
+            slot = self._slots.pop(name)
+            heapq.heappush(self._free, slot.row)
+
+    def remove_adapter_devices(self, adapter: Adapter) -> None:
+        """Drop every device owned by an adapter (adapter teardown)."""
+        with self._lock:
+            for name in [n for n, s in self._slots.items() if s.adapter is adapter]:
+                heapq.heappush(self._free, self._slots.pop(name).row)
+
+    # -- queries (CDeviceManager surface) ------------------------------------
+    def device_names(self, type_name: Optional[str] = None) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(
+                sorted(
+                    n
+                    for n, s in self._slots.items()
+                    if s.adapter.revealed and (type_name is None or s.type_name == type_name)
+                )
+            )
+
+    def row_of(self, name: str) -> int:
+        with self._lock:
+            return self._slots[name].row
+
+    def get_state(self, name: str, signal: str) -> float:
+        # Resolve the slot under the lock (a PnP-timeout thread may be
+        # removing devices concurrently); call the adapter outside it.
+        with self._lock:
+            s = self._slots[name]
+        return s.adapter.get_state(name, signal)
+
+    def set_command(self, name: str, signal: str, value: float) -> None:
+        with self._lock:
+            s = self._slots[name]
+        s.adapter.set_command(name, signal, value)
+
+    def get_net_value(self, type_name: str, signal: str) -> float:
+        """Host-side sum over revealed devices of a type
+        (``CDeviceManager::GetNetValue``); the jittable equivalent is
+        :func:`freedm_tpu.devices.tensor.net_value` on a snapshot."""
+        total = 0.0
+        for name in self.device_names(type_name):
+            total += self.get_state(name, signal)
+        return total
+
+    # -- tensor pumps --------------------------------------------------------
+    def snapshot(self, dtype=jnp.float32) -> dt.DeviceTensor:
+        """Ingress: read adapters into a fresh device tensor."""
+        lay = self.layout
+        np_dtype = np.dtype(dtype)
+        st = np.zeros((self.capacity, lay.n_signals), np_dtype)
+        tid = np.full(self.capacity, -1, np.int32)
+        alive = np.zeros(self.capacity, np_dtype)
+        with self._lock:
+            slots = list(self._slots.values())
+        for s in slots:
+            if not s.adapter.revealed:
+                continue
+            ti = lay.type_ids[s.type_name]
+            tid[s.row] = ti
+            alive[s.row] = 1.0
+            for sig in lay.types[ti].states:
+                st[s.row, lay.signal_index(sig)] = s.adapter.get_state(s.name, sig)
+        return dt.DeviceTensor(
+            state=jnp.asarray(st, dtype),
+            command=jnp.full((self.capacity, lay.n_signals), NULL_COMMAND, dtype),
+            type_id=jnp.asarray(tid),
+            alive=jnp.asarray(alive, dtype),
+        )
+
+    def apply_commands(self, t: dt.DeviceTensor) -> int:
+        """Egress: push the tensor's non-NULL commands to adapters.
+
+        Returns the number of command writes issued.
+        """
+        lay = self.layout
+        cmd = np.asarray(t.command)
+        written = 0
+        with self._lock:
+            slots = list(self._slots.values())
+        for s in slots:
+            if not s.adapter.revealed:
+                continue
+            ti = lay.type_ids[s.type_name]
+            for sig in lay.types[ti].commands:
+                v = cmd[s.row, lay.signal_index(sig)]
+                if abs(v - NULL_COMMAND) > 0.5:
+                    s.adapter.set_command(s.name, sig, float(v))
+                    written += 1
+        return written

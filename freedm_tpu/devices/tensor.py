@@ -1,0 +1,132 @@
+"""The device tensor: fleet state/commands as padded arrays with masks.
+
+TPU-native replacement for the reference's per-object device registry
+(``CDeviceManager``, ``Broker/src/device/CDeviceManager.hpp:66-76``; each
+``CDevice`` holding signal maps, ``CDevice.hpp:94-104``).  The whole
+fleet is:
+
+    state   [capacity, n_signals]  float
+    command [capacity, n_signals]  float (NULL_COMMAND = "no command")
+    type_id [capacity]             int   (row's device class)
+    alive   [capacity]             0/1   (plug-and-play slots)
+
+Dynamic device arrival (the reference's PnP Hello) becomes flipping an
+``alive`` bit in a max-padded tensor — shapes stay static under jit
+(SURVEY.md §7 hard part (v)).
+
+Aggregations the reference computes by iterating device objects —
+``CDeviceManager::GetNetValue(type, signal)`` summing over devices
+(``CDeviceManager.cpp:296-312``) — are masked reductions here, jittable
+and vmappable over a leading node axis for whole-federation queries.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.core.config import NULL_COMMAND
+from freedm_tpu.devices.schema import SignalLayout
+
+
+class DeviceTensor(NamedTuple):
+    """Fleet snapshot; a pytree — flows through jit/vmap/scan."""
+
+    state: jax.Array  # [cap, ns]
+    command: jax.Array  # [cap, ns], NULL_COMMAND where unset
+    type_id: jax.Array  # [cap] int32 (-1 for empty slots)
+    alive: jax.Array  # [cap] float 0/1
+
+    @property
+    def capacity(self) -> int:
+        return self.state.shape[0]
+
+
+def empty(layout: SignalLayout, capacity: int, dtype=jnp.float32) -> DeviceTensor:
+    ns = layout.n_signals
+    return DeviceTensor(
+        state=jnp.zeros((capacity, ns), dtype),
+        command=jnp.full((capacity, ns), NULL_COMMAND, dtype),
+        type_id=jnp.full((capacity,), -1, jnp.int32),
+        alive=jnp.zeros((capacity,), dtype),
+    )
+
+
+def type_mask(t: DeviceTensor, type_id: int) -> jax.Array:
+    """[cap] 0/1: live rows of the given device class."""
+    return jnp.where(t.type_id == type_id, t.alive, 0.0)
+
+
+def net_value(t: DeviceTensor, type_id: int, signal_idx: int) -> jax.Array:
+    """Sum a signal over live devices of a type.
+
+    Reference: ``CDeviceManager::GetNetValue`` — e.g. net DRER generation
+    or net Load drain feeding the LB SUPPLY/DEMAND decision
+    (``lb/LoadBalance.cpp:382-402``).
+    """
+    return jnp.sum(t.state[:, signal_idx] * type_mask(t, type_id))
+
+
+def count_devices(t: DeviceTensor, type_id: int) -> jax.Array:
+    """Live-device count of a type (``CDeviceManager::DeviceCount``)."""
+    return jnp.sum(type_mask(t, type_id)).astype(jnp.int32)
+
+
+def set_commands(
+    t: DeviceTensor,
+    type_id: int,
+    signal_idx: int,
+    values: jax.Array,
+    rows: Optional[jax.Array] = None,
+) -> DeviceTensor:
+    """Write a command signal on live devices of a type.
+
+    ``values`` is scalar or ``[cap]``; ``rows`` optionally restricts to a
+    0/1 row mask.  Dead or non-matching rows keep their previous command.
+    """
+    sel = type_mask(t, type_id)
+    if rows is not None:
+        sel = sel * rows
+    col = t.command[:, signal_idx]
+    new_col = jnp.where(sel > 0, values, col)
+    return t._replace(command=t.command.at[:, signal_idx].set(new_col))
+
+
+def clear_commands(t: DeviceTensor) -> DeviceTensor:
+    """Reset all commands to NULL_COMMAND (start of a scheduler round)."""
+    return t._replace(command=jnp.full_like(t.command, NULL_COMMAND))
+
+
+def commanded(t: DeviceTensor) -> jax.Array:
+    """[cap, ns] 0/1: entries holding a real command (not NULL)."""
+    return (jnp.abs(t.command - NULL_COMMAND) > 0.5).astype(t.command.dtype)
+
+
+def from_host(
+    layout: SignalLayout,
+    capacity: int,
+    type_names,
+    states: np.ndarray,
+    dtype=jnp.float32,
+) -> DeviceTensor:
+    """Build a padded tensor from host rows (one per device, in order)."""
+    n = len(type_names)
+    if n > capacity:
+        raise ValueError(f"{n} devices exceed capacity {capacity}")
+    t = empty(layout, capacity, dtype)
+    np_dtype = np.dtype(dtype)
+    tid = np.full(capacity, -1, np.int32)
+    alive = np.zeros(capacity, np_dtype)
+    st = np.zeros((capacity, layout.n_signals), np_dtype)
+    for i, name in enumerate(type_names):
+        tid[i] = layout.type_ids[name]
+        alive[i] = 1.0
+        st[i] = states[i]
+    return t._replace(
+        state=jnp.asarray(st, dtype),
+        type_id=jnp.asarray(tid),
+        alive=jnp.asarray(alive, dtype),
+    )

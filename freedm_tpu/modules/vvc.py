@@ -123,32 +123,38 @@ def make_vvc_controller(
 
         # Backtracking: shrink α until the projected step descends
         # (reference: re-run DPF per trial, accept on loss decrease,
-        # VoltVarCtrl.cpp:1600-1766).
+        # VoltVarCtrl.cpp:1600-1766). The trial solve's voltages ride in
+        # the carry so the accepted point needs no re-solve.
         def cond(carry):
-            k, _, _, accepted = carry
+            k, _, _, accepted, _ = carry
             return jnp.logical_and(k < config.max_backtracks, jnp.logical_not(accepted))
 
         def body(carry):
-            k, alpha, _, _ = carry
+            k, alpha, _, _, _ = carry
             q_try = _project(q0 - alpha * g)
-            loss_try = _loss(q_try, s_load)
+            loss_try, res_try = _loss_aux(q_try, s_load)
             accepted = loss_try < loss0
             return (
                 k + 1,
                 jnp.where(accepted, alpha, alpha * config.backtrack),
                 jnp.where(accepted, loss_try, loss0),
                 accepted,
+                res_try.v_node,
             )
 
-        k, alpha, loss1, accepted = jax.lax.while_loop(
+        k, alpha, loss1, accepted, v_trial = jax.lax.while_loop(
             cond,
             body,
-            (jnp.int32(0), alpha_start, loss0, jnp.asarray(False)),
+            (jnp.int32(0), alpha_start, loss0, jnp.asarray(False), base.v_node),
         )
 
         q1 = jnp.where(accepted, _project(q0 - alpha * g), q0)
-        after = _solve(s_load, q1)
-        v_after = after.v_node.abs()
+        # On rejection q1 == q0 whose solution is `base`; on acceptance
+        # the while carry holds the accepted trial's voltages.
+        v_after = C(
+            jnp.where(accepted, v_trial.re, base.v_node.re),
+            jnp.where(accepted, v_trial.im, base.v_node.im),
+        ).abs()
 
         return VVCStep(
             q_ctrl_kvar=q1,
