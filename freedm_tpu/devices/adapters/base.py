@@ -69,6 +69,11 @@ class Adapter(ABC):
     @abstractmethod
     def set_command(self, device: str, signal: str, value: float) -> None: ...
 
+    def can_command(self, device: str, signal: str) -> bool:
+        """Whether this adapter can actuate the signal (transport-backed
+        adapters may expose a device's state without a command path)."""
+        return True
+
 
 class BufferAdapter(Adapter):
     """Adapter backed by index-registered state/command buffers.
@@ -133,3 +138,9 @@ class BufferAdapter(Adapter):
     @property
     def command_size(self) -> int:
         return len(self._command_index)
+
+    def has_state(self, device: str, signal: str) -> bool:
+        return (device, signal) in self._state_index
+
+    def can_command(self, device: str, signal: str) -> bool:
+        return (device, signal) in self._command_index

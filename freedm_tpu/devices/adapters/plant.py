@@ -40,6 +40,26 @@ from freedm_tpu.pf import ladder
 NOMINAL_OMEGA = 376.8  # rad/s, the reference's PSCAD model constant
 
 
+def register_plant_type(factory, feeder: "Feeder", node_of: Dict[str, int], **kwargs) -> None:
+    """Register the ``plant`` adapter type on a factory.
+
+    ``node_of`` maps device names (as they appear in adapter.xml entry
+    tables) to feeder branch indices; extra kwargs forward to
+    :class:`PlantAdapter`.  An adapter.xml ``<adapter type="plant">``
+    then builds a plant over ``feeder`` with its declared devices.
+    """
+
+    def ctor(spec, manager):
+        placements = {}
+        for device, type_name in spec.devices:
+            if device not in node_of:
+                raise ValueError(f"plant adapter {spec.name!r}: no node mapping for {device!r}")
+            placements[device] = (type_name, node_of[device])
+        return PlantAdapter(feeder, placements, **kwargs)
+
+    factory.register_type("plant", ctor)
+
+
 class PlantAdapter(Adapter):
     """Simulated feeder plant with attached grid devices."""
 
@@ -90,13 +110,18 @@ class PlantAdapter(Adapter):
             live = self._load_kw > 0
             walk = self._rng.normal(0.0, self.load_drift, self._load_kw.shape)
             self._load_kw = np.where(live, np.maximum(self._load_kw * (1 + walk), 0.0), 0.0)
+        # An empty battery cannot keep discharging: zero the effective
+        # power of depleted units with a discharge command.
+        eff_charge = np.where(
+            (self._storage_kwh > 0) | (self._charge_kw > 0), self._charge_kw, 0.0
+        )
         self._storage_kwh = np.maximum(
-            self._storage_kwh + self._charge_kw * self.dt_hours, 0.0
+            self._storage_kwh + eff_charge * self.dt_hours, 0.0
         )
 
         # Net per-node demand seen by the feeder: load - generation -
         # gateway import + storage charging.
-        net_kw = self._load_kw - self._gen_kw - self._gateway_kw + self._charge_kw
+        net_kw = self._load_kw - self._gen_kw - self._gateway_kw + eff_charge
         s = (net_kw / 3.0)[:, None] * np.ones(3)[None, :] * (1 + 0.3j)
         res = self._solve(s.astype(np.complex128))
         self._v_mag = np.asarray(ladder.v_polar(res)[0])

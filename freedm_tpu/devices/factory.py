@@ -7,10 +7,12 @@ opendss} (``:264-274``; mqtt wired but disabled ``:100-107``), registers
 their devices, and runs the PnP TCP session server.
 
 Here the factory is an ordinary object (no singletons) with a
-type-string registry that ships the reference's adapter set — ``fake``,
-``rtds``, ``pnp``, ``opendss``, ``mqtt``, plus the TPU-native ``plant``
-(pure-JAX simulated plant, replacing the pscad-interface rig) — and is
-extensible with user adapter classes.
+type-string registry.  Built-in: ``fake``.  Other adapter types register
+explicitly — e.g.
+:func:`freedm_tpu.devices.adapters.plant.register_plant_type` for the
+TPU-native simulated plant (it needs a feeder, which XML cannot carry),
+and the transport adapters (rtds/pnp) via their modules in
+:mod:`freedm_tpu.dcn`.  Unknown types fail loudly with the known list.
 
 XML format (reference ``Broker/config/samples/adapter.xml``)::
 
@@ -112,9 +114,6 @@ class AdapterFactory:
         self.adapters: Dict[str, Adapter] = {}
         self._registry: Dict[str, AdapterCtor] = {}
         self.register_type("fake", _make_fake)
-        # Transport-backed adapters are registered lazily by their
-        # modules (rtds/pnp/plant import sockets/jax; see
-        # freedm_tpu.devices.adapters.*).
 
     def register_type(self, type_name: str, ctor: AdapterCtor) -> None:
         self._registry[type_name] = ctor
@@ -141,20 +140,36 @@ class AdapterFactory:
         try:
             for device, type_name in spec.devices:
                 self.manager.add_device(device, type_name, adapter)
+            if isinstance(adapter, BufferAdapter):
+                for e in spec.state:
+                    adapter.bind_state(e.device, e.signal, e.index)
+                for e in spec.command:
+                    adapter.bind_command(e.device, e.signal, e.index)
+                adapter.finalize_bindings()
+                self._check_state_coverage(spec, adapter)
+            adapter.reveal_devices()
         except Exception:
             # Roll back partial registration so a corrected spec can
             # retry without phantom "duplicate device" errors.
             self.manager.remove_adapter_devices(adapter)
             raise
-        if isinstance(adapter, BufferAdapter):
-            for e in spec.state:
-                adapter.bind_state(e.device, e.signal, e.index)
-            for e in spec.command:
-                adapter.bind_command(e.device, e.signal, e.index)
-            adapter.finalize_bindings()
-        adapter.reveal_devices()
         self.adapters[spec.name] = adapter
         return adapter
+
+    def _check_state_coverage(self, spec: AdapterSpec, adapter: BufferAdapter) -> None:
+        """Every registered device must be able to serve all of its
+        type's state signals, or the per-superstep snapshot pump would
+        die on a missing binding. Loud failure at create time instead
+        (the reference's CDevice::GetState throws at first read)."""
+        layout = self.manager.layout
+        for device, type_name in spec.devices:
+            dtype_ = layout.type_of(type_name)
+            for sig in dtype_.states:
+                if not adapter.has_state(device, sig):
+                    raise ValueError(
+                        f"adapter {spec.name!r}: device {device!r} ({type_name}) "
+                        f"has no <state> entry for signal {sig!r}"
+                    )
 
     def create_from_xml(self, source: Union[str, Path]) -> Tuple[Adapter, ...]:
         return tuple(self.create_adapter(s) for s in parse_adapter_xml(source))

@@ -62,9 +62,12 @@ class DeviceManager:
                 raise ValueError(f"unknown device type {type_name!r}")
             if not self._free:
                 raise RuntimeError("device capacity exhausted")
+            # The adapter call can raise (e.g. registration after
+            # reveal); do it before any state mutation so failure leaves
+            # no phantom slot behind.
+            adapter.register_device(name)
             row = heapq.heappop(self._free)  # lowest free slot: rows stay compact
             self._slots[name] = _Slot(name, type_name, adapter, row)
-            adapter.register_device(name)
             return row
 
     def remove_device(self, name: str) -> None:
@@ -156,7 +159,7 @@ class DeviceManager:
             ti = lay.type_ids[s.type_name]
             for sig in lay.types[ti].commands:
                 v = cmd[s.row, lay.signal_index(sig)]
-                if abs(v - NULL_COMMAND) > 0.5:
+                if abs(v - NULL_COMMAND) > 0.5 and s.adapter.can_command(s.name, sig):
                     s.adapter.set_command(s.name, sig, float(v))
                     written += 1
         return written

@@ -22,6 +22,8 @@ from typing import Dict, List, Tuple, Union
 
 import numpy as np
 
+from freedm_tpu.utils.textio import read_source
+
 
 @dataclass(frozen=True)
 class DeviceType:
@@ -55,10 +57,7 @@ DEFAULT_TYPES: Tuple[DeviceType, ...] = (
 
 def read_xml_source(source: Union[str, Path]) -> str:
     """Accept a path or raw XML text; return the XML text."""
-    text = str(source)
-    if "<" not in text:
-        text = Path(source).read_text()
-    return text
+    return read_source(source, "<")
 
 
 def parse_device_xml(source: Union[str, Path]) -> Tuple[DeviceType, ...]:

@@ -1,0 +1,170 @@
+"""Physical grid topology with FID-gated reachability.
+
+TPU-native replacement for ``CPhysicalTopology``
+(``Broker/src/CPhysicalTopology.cpp``): the reference loads a
+``topology.cfg`` DSL — ``edge v1 v2`` physical lines, ``sst v uuid``
+vertex→DGI mapping, ``fid v1 v2 name`` breaker-controlled edges
+(``Broker/config/samples/topology.cfg``) — and BFS-walks the graph with
+FID-controlled edges broken when their Fault Isolation Device is open or
+unknown (``ReachablePeers``, ``CPhysicalTopology.cpp:92-169``), so cyber
+groups never span an open breaker.
+
+Here the graph compiles to arrays and reachability is computed for **all
+sources at once** inside jit: adjacency gated by the live FID state
+vector, then ``ceil(log2 V)`` rounds of boolean matrix squaring — the
+iterated sparse-matvec plan of SURVEY.md §2.1.  The result feeds
+:func:`freedm_tpu.modules.gm.form_groups` directly.
+
+Vertices not mapped to a DGI node (the reference's DUMMY SSTs) exist in
+the graph but produce no row in the node-level reachability.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.utils.textio import read_source
+
+
+@dataclass(frozen=True)
+class Topology:
+    """Compiled physical topology."""
+
+    vertices: Tuple[str, ...]  # vertex names
+    adj: np.ndarray  # [V, V] 0/1 ungated edges (FID edges excluded)
+    fid_edges: Tuple[Tuple[int, int], ...]  # FID-controlled edges
+    fid_names: Tuple[str, ...]  # FID device name per controlled edge
+    sst_uuid: Dict[str, str]  # vertex -> DGI uuid ("" for DUMMY)
+
+    @property
+    def n_vertices(self) -> int:
+        return len(self.vertices)
+
+    @property
+    def n_fids(self) -> int:
+        return len(self.fid_edges)
+
+    def vertex_index(self, name: str) -> int:
+        return self.vertices.index(name)
+
+    def node_vertices(self, uuids: Tuple[str, ...]) -> np.ndarray:
+        """[len(uuids)] vertex index per DGI uuid (-1 if absent)."""
+        by_uuid = {u: v for v, u in self.sst_uuid.items() if u}
+        return np.array(
+            [self.vertices.index(by_uuid[u]) if u in by_uuid else -1 for u in uuids],
+            dtype=np.int32,
+        )
+
+
+def parse_topology(source: Union[str, Path]) -> Topology:
+    """Parse the reference ``topology.cfg`` DSL (path or raw text).
+
+    Unknown directives are an error, like the reference's loader
+    (``LoadTopology``, ``CPhysicalTopology.cpp:182-260``).
+    """
+    text = read_source(source, "\n")
+    verts: List[str] = []
+    edges: List[Tuple[str, str]] = []
+    fids: List[Tuple[str, str, str]] = []
+    ssts: Dict[str, str] = {}
+
+    def vert(v: str) -> str:
+        if v not in verts:
+            verts.append(v)
+        return v
+
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        if parts[0] == "edge" and len(parts) == 3:
+            edges.append((vert(parts[1]), vert(parts[2])))
+        elif parts[0] == "fid" and len(parts) == 4:
+            fids.append((vert(parts[1]), vert(parts[2]), parts[3]))
+        elif parts[0] == "sst" and len(parts) == 3:
+            uuid = parts[2]
+            ssts[vert(parts[1])] = "" if uuid.startswith("DUMMY") else uuid
+        else:
+            raise ValueError(f"malformed topology line: {raw!r}")
+
+    n = len(verts)
+    vi = {v: i for i, v in enumerate(verts)}
+    # FID directives *gate* an existing or implicit edge; the reference
+    # treats "fid a b NAME" as declaring the controlled edge itself.
+    fid_set = {frozenset((a, b)) for a, b, _ in fids}
+    adj = np.zeros((n, n), np.float32)
+    for a, b in edges:
+        if frozenset((a, b)) in fid_set:
+            continue  # controlled edges live in fid_edges
+        adj[vi[a], vi[b]] = adj[vi[b], vi[a]] = 1.0
+    fid_edges = tuple((vi[a], vi[b]) for a, b, _ in fids)
+    fid_names = tuple(name for _, _, name in fids)
+    return Topology(
+        vertices=tuple(verts),
+        adj=adj,
+        fid_edges=fid_edges,
+        fid_names=fid_names,
+        sst_uuid=ssts,
+    )
+
+
+def make_reachability(topo: Topology):
+    """Compile ``reachable(fid_closed) -> [V, V]`` for a topology.
+
+    ``fid_closed``: [n_fids] values in {1 closed, 0 open}; the reference
+    also breaks edges whose FID state is *unknown* — encode unknown as 0
+    (``ReachablePeers`` drops edges unless the FID is known-closed).
+
+    Jittable; vmap over FID scenarios for contingency studies.
+    """
+    n = topo.n_vertices
+    base = jnp.asarray(topo.adj)
+    if topo.n_fids:
+        fr = jnp.asarray([e[0] for e in topo.fid_edges])
+        to = jnp.asarray([e[1] for e in topo.fid_edges])
+    rounds = max(1, math.ceil(math.log2(max(n, 2))))
+
+    def reachable(fid_closed: jax.Array) -> jax.Array:
+        adj = base
+        if topo.n_fids:
+            closed = jnp.asarray(fid_closed, jnp.float32)
+            adj = adj.at[fr, to].max(closed)
+            adj = adj.at[to, fr].max(closed)
+        reach = jnp.minimum(adj + jnp.eye(n), 1.0)
+        for _ in range(rounds):
+            reach = jnp.minimum(reach @ reach, 1.0)  # distance doubling
+        return reach
+
+    return reachable
+
+
+def node_reachability(
+    topo: Topology, uuids: Tuple[str, ...]
+):
+    """Compile ``(fid_closed) -> [N, N]`` reachability between DGI nodes.
+
+    Rows/columns follow ``uuids`` order; a node without a topology vertex
+    is reachable only from itself (the reference treats missing vertices
+    as isolated). Feed the result to
+    :func:`freedm_tpu.modules.gm.form_groups`.
+    """
+    vidx = topo.node_vertices(uuids)
+    reach_fn = make_reachability(topo)
+    has_vertex = jnp.asarray((vidx >= 0).astype(np.float32))
+    safe = jnp.asarray(np.maximum(vidx, 0))
+
+    def node_reach(fid_closed: jax.Array) -> jax.Array:
+        r = reach_fn(fid_closed)
+        nr = r[safe][:, safe] * has_vertex[:, None] * has_vertex[None, :]
+        n = nr.shape[0]
+        return jnp.maximum(nr, jnp.eye(n))
+
+    return node_reach

@@ -1,0 +1,158 @@
+"""Group management: membership and leader election as collectives.
+
+TPU-native replacement for the reference's ``gm`` module — the
+Garcia-Molina invitation election (``Broker/src/gm/GroupManagement.hpp:44``)
+with states NORMAL/DOWN/RECOVERY/REORGANIZATION/ELECTION, AYC/AYT
+keep-alive polling, priority = hash of UUID, Invite/Accept merge, and
+FID/BFS filtering of unreachable peers
+(``GroupManagement.cpp:437-1330``).
+
+On a mesh the whole protocol collapses (SURVEY.md §2.5): every node runs
+in the same program, so "who is alive and reachable" is a mask and "who
+leads my group" is an argmax:
+
+- **groups** are the connected components of the masked reachability
+  graph (comm health × FID-gated physical topology), found by
+  ``O(log N)`` rounds of label propagation with adjacency squaring —
+  all inside jit — replacing the Recovery/Merge/Reorganize message
+  waves;
+- **the coordinator** of each group is its highest-priority member
+  (priority = salted hash of the node id, exactly the reference's
+  string-hash priority, ``GroupManagement.cpp:653-679``), found with a
+  masked argmax — replacing Invite/Accept/Ready;
+- **keep-alive** (AYC/AYT timeouts) becomes the alive mask itself: a
+  node that misses a superstep barrier is marked dead by the host
+  failure detector (:mod:`freedm_tpu.runtime`), and the next
+  ``form_groups`` call re-forms groups — the reference's automatic
+  Recovery/re-election, in one step.
+
+The Invite/Accept state machine survives only at the DCN boundary for
+multi-slice federation (:mod:`freedm_tpu.dcn`).
+
+Outputs mirror what the reference pushes to every module via
+``PeerListMessage`` (``ProcessPeerList``, ``GroupManagement.cpp:895-936``):
+per-node coordinator index and same-group membership mask; plus the
+counters GM tracks for its ``SystemState()`` table
+(``GroupManagement.hpp:184-195``) derivable by diffing successive states.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GroupState(NamedTuple):
+    """Per-node group view (all arrays [N] or [N, N])."""
+
+    coordinator: jax.Array  # [N] int32: node index of my group's leader (-1 if dead)
+    group_mask: jax.Array  # [N, N] 0/1: j in my group (row i = my view)
+    is_coordinator: jax.Array  # [N] bool
+    group_size: jax.Array  # [N] int32: members in my group
+    n_groups: jax.Array  # [] int32: live groups in the system
+
+
+def node_priority(n_nodes: int, salt: int = 0x9E3779B9) -> np.ndarray:
+    """Election priority per node — a salted integer hash, matching the
+    reference's "priority = hash of UUID" (GroupManagement.cpp:653-679).
+
+    Deterministic, collision-free for any n (a bijective mix of the node
+    index), and host-computable so tests can predict leaders.
+    """
+    idx = np.arange(n_nodes, dtype=np.uint32)
+    x = (idx + np.uint32(salt)) * np.uint32(2654435761)
+    x ^= x >> np.uint32(16)
+    x = x * np.uint32(2246822519)
+    x ^= x >> np.uint32(13)
+    # Rank the hashes: a pseudo-random permutation of 1..n — unique,
+    # positive, and exactly representable in float32 (labels propagate
+    # as f32, so priorities must stay below 2^24).
+    rank = np.argsort(np.argsort(x, kind="stable"), kind="stable")
+    return (rank + 1).astype(np.int32)
+
+
+def form_groups(
+    alive: jax.Array,
+    reachable: jax.Array,
+    priority: Optional[jax.Array] = None,
+) -> GroupState:
+    """Form groups and elect coordinators — one jittable call.
+
+    ``alive``: [N] 0/1 node health mask.
+    ``reachable``: [N, N] 0/1 symmetric comm/physical reachability
+    (e.g. from :func:`freedm_tpu.grid.topology.reachability`); the
+    diagonal is implied.  Dead rows/columns are masked out.
+    ``priority``: [N] int election priority (default
+    :func:`node_priority`; must be unique and positive).
+
+    Label propagation with adjacency squaring: after ``ceil(log2 N)+1``
+    rounds each live node's label is the maximum priority in its
+    connected component — its coordinator.  Equivalent to the
+    reference's election outcome (the highest-priority reachable
+    coordinator wins, ``GroupManagement.cpp:710-762``) without the
+    message waves, and correct for any diameter (chains of microgrids
+    included).  Cost: O(N³ log N) MXU flops — trivial at DGI fleet
+    sizes (N ≤ a few hundred).
+    """
+    n = alive.shape[0]
+    alive_f = alive.astype(jnp.float32)
+    if priority is None:
+        priority = jnp.asarray(node_priority(n))
+    adj = reachable.astype(jnp.float32) * alive_f[:, None] * alive_f[None, :]
+    adj = jnp.maximum(adj, jnp.eye(n) * alive_f)
+    prio_f = priority.astype(jnp.float32) * alive_f  # dead -> 0 < any live prio
+
+    rounds = max(1, math.ceil(math.log2(max(n, 2)))) + 1
+
+    def body(carry, _):
+        adj, label = carry
+        label = jnp.max(jnp.where(adj > 0, label[None, :], 0.0), axis=1)
+        label = jnp.maximum(label, prio_f)
+        adj = jnp.minimum(adj @ adj, 1.0)  # reachable-set doubling
+        return (adj, label), None
+
+    (_, label), _ = jax.lax.scan(body, (adj, prio_f), None, length=rounds)
+
+    # Coordinator index: the node whose priority equals my label.
+    eq = (jnp.abs(label[:, None] - prio_f[None, :]) < 0.5).astype(jnp.float32)
+    coord = jnp.argmax(eq, axis=1).astype(jnp.int32)
+    dead = alive_f < 0.5
+    coord = jnp.where(dead, -1, coord)
+    same = (jnp.abs(label[:, None] - label[None, :]) < 0.5).astype(jnp.float32)
+    group_mask = same * alive_f[:, None] * alive_f[None, :]
+    group_size = jnp.sum(group_mask, axis=1).astype(jnp.int32)
+    is_coord = jnp.logical_and(coord == jnp.arange(n), ~dead)
+    n_groups = jnp.sum(is_coord).astype(jnp.int32)
+    return GroupState(
+        coordinator=coord,
+        group_mask=group_mask,
+        is_coordinator=is_coord,
+        group_size=group_size,
+        n_groups=n_groups,
+    )
+
+
+class GroupCounters(NamedTuple):
+    """Event counters between two group states — the statistics GM keeps
+    for its ``SystemState()`` table (``GroupManagement.hpp:184-195``)."""
+
+    groups_formed: jax.Array  # [] int32: nodes whose coordinator changed
+    groups_broken: jax.Array  # [] int32: pairs that lost same-group status
+    elections: jax.Array  # [] int32: coordinators that changed identity
+
+
+def diff_counters(prev: GroupState, new: GroupState) -> GroupCounters:
+    changed = jnp.sum(
+        jnp.logical_and(prev.coordinator != new.coordinator, new.coordinator >= 0)
+    ).astype(jnp.int32)
+    broken = jnp.sum(
+        jnp.logical_and(prev.group_mask > 0, new.group_mask == 0)
+    ).astype(jnp.int32)
+    elections = jnp.sum(
+        jnp.logical_and(new.is_coordinator, ~prev.is_coordinator)
+    ).astype(jnp.int32)
+    return GroupCounters(changed, broken, elections)

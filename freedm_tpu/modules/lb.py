@@ -1,0 +1,192 @@
+"""Load balancing: the draft auction as vectorized matching.
+
+TPU-native replacement for the reference's ``lb`` module — Akella's
+distributed power balancing (``docs/modules/load_balance.rst``): per
+round each node reads devices (net generation = DRER + DESD − Load,
+gateway from its SST, ``lb/LoadBalance.cpp:382-402``), classifies itself
+SUPPLY/DEMAND/NORMAL by a ±migration-step band (``:412-453``), demand
+nodes advertise, and each supply node runs a draft auction —
+DraftRequest → DraftAge (deficit) → ``DraftStandard`` picks the max age
+≥ step (``:749-797``) → DraftSelect → DraftAccept (demand lowers its
+gateway) or TooLate rollback (``:854-956``) — then actuates via SetPStar
+(``:1000-1075``).
+
+On a mesh the whole message choreography is one matching kernel
+(SURVEY.md §2.5, the north-star core):
+
+- classification is elementwise;
+- the auction is **rank-matching within each group**: the r-th ranked
+  supply node pairs with the r-th ranked demand node (demand ranked by
+  age = deficit, exactly ``DraftStandard``'s max-age choice, executed
+  for all supplies simultaneously instead of sequentially);
+- acceptance, the malicious-node drop (``:862-865``), and the TooLate
+  path are masks on the pairing matrix;
+- actuation is a ±step update of the gateway vector; the in-flight
+  ledger rows feed :mod:`freedm_tpu.modules.sc`.
+
+One call = one complete LB round for every node at once; ``vmap`` it
+for Monte-Carlo fleets.  The frequency-invariant gate
+(``InvariantCheck``, ``:1237-1277``, hard-coded ω = 376.8 model) is a
+caller-supplied scalar mask so it can come from the plant's Omega
+device or from a power-flow feasibility check
+(:mod:`freedm_tpu.pf`) — the reference's TODO made real.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Node states (reference LBAgent::EState).
+DEMAND = -1
+NORMAL = 0
+SUPPLY = 1
+
+
+class LBRound(NamedTuple):
+    """Result of one vectorized load-balance round."""
+
+    state: jax.Array  # [N] int32: -1 demand / 0 normal / +1 supply
+    gateway: jax.Array  # [N] updated gateway (predicted, post-migration)
+    matched: jax.Array  # [N, N] 0/1: migration supply i -> demand j
+    supply_step: jax.Array  # [N] gateway delta applied at supply side
+    demand_step: jax.Array  # [N] gateway delta applied at demand side
+    intransit: jax.Array  # [N] signed pending gateway delta (accepted, unapplied)
+    n_migrations: jax.Array  # [] int32
+
+
+def classify(net_generation: jax.Array, gateway: jax.Array, step: float) -> jax.Array:
+    """SUPPLY/DEMAND/NORMAL by the ±migration-step band
+    (``UpdateState``, ``lb/LoadBalance.cpp:412-453``)."""
+    imbalance = net_generation - gateway
+    return jnp.where(
+        imbalance >= step, SUPPLY, jnp.where(imbalance <= -step, DEMAND, NORMAL)
+    ).astype(jnp.int32)
+
+
+def _group_rank(key: jax.Array, member: jax.Array, group_mask: jax.Array) -> jax.Array:
+    """Rank of each member *within its group* by descending key.
+
+    ``member``: [N] 0/1 participation mask; ties break by node index.
+    Rank 0 = best. Non-members get rank N (never matched).
+    """
+    n = key.shape[0]
+    idx = jnp.arange(n)
+    # better[j, i] = 1 if j beats i (same group, both members).
+    key_j = key[:, None]
+    key_i = key[None, :]
+    beats = jnp.logical_or(key_j > key_i, jnp.logical_and(key_j == key_i, idx[:, None] < idx[None, :]))
+    both = member[:, None] * member[None, :] * group_mask
+    rank = jnp.sum(beats.astype(jnp.float32) * both, axis=0)
+    return jnp.where(member > 0, rank, jnp.float32(n)).astype(jnp.int32)
+
+
+def lb_round(
+    net_generation: jax.Array,
+    gateway: jax.Array,
+    group_mask: jax.Array,
+    migration_step: float,
+    malicious: Optional[jax.Array] = None,
+    invariant_ok: Optional[jax.Array] = None,
+) -> LBRound:
+    """One complete LB round for all nodes.
+
+    ``net_generation``/``gateway``: [N] device readings (kW);
+    ``group_mask``: [N, N] from gm; ``malicious``: [N] 0/1 nodes that
+    accept but never actuate (``--malicious-behavior``);
+    ``invariant_ok``: [] or [N] 0/1 gate on migrations (frequency /
+    power-flow feasibility; default pass).
+    """
+    n = gateway.shape[0]
+    step = migration_step
+    state = classify(net_generation, gateway, step)
+    is_supply = (state == SUPPLY).astype(jnp.float32)
+    is_demand = (state == DEMAND).astype(jnp.float32)
+    malicious = jnp.zeros(n) if malicious is None else malicious.astype(jnp.float32)
+    gate = jnp.ones(()) if invariant_ok is None else jnp.asarray(invariant_ok)
+    gate = jnp.broadcast_to(gate, (n,)).astype(jnp.float32)
+
+    # Draft ages: demand deficit magnitude (SendDraftAge, :688-708).
+    age = jnp.maximum(gateway - net_generation, 0.0) * is_demand
+
+    # Within-group ranks: supplies by surplus, demands by age.
+    surplus = jnp.maximum(net_generation - gateway, 0.0) * is_supply
+    s_rank = _group_rank(surplus, is_supply * gate, group_mask)
+    d_rank = _group_rank(age, is_demand * gate, group_mask)
+
+    # Pair r-th supply with r-th demand of the same group; demand must
+    # still need at least one quantum (age >= step, DraftStandard's
+    # eligibility, :749-797).
+    eligible = (age >= step).astype(jnp.float32)
+    pair = (
+        (s_rank[:, None] == d_rank[None, :]).astype(jnp.float32)
+        * (s_rank[:, None] < n).astype(jnp.float32)
+        * group_mask
+        * is_supply[:, None]
+        * (is_demand * eligible)[None, :]
+    )
+
+    supply_delta = jnp.sum(pair, axis=1) * step  # each supply exports +step
+    # Malicious demand accepts but silently drops actuation
+    # (LoadBalance.cpp:862-865) -> incomplete migration.
+    demand_applied = jnp.sum(pair, axis=0) * step * (1.0 - malicious)
+    demand_accepted = jnp.sum(pair, axis=0) * step
+
+    gateway_new = gateway + supply_delta - demand_applied
+    # Ledger: signed gateway delta still in flight — accepted at the
+    # demand side but not yet actuated (the reference counts Accept
+    # messages crossing the snapshot cut). Chosen so that
+    # Σ gateway + Σ intransit is conserved within each group
+    # (sc.invariant_total).
+    intransit = demand_applied - demand_accepted
+
+    return LBRound(
+        state=state,
+        gateway=gateway_new,
+        matched=pair,
+        supply_step=supply_delta,
+        demand_step=-demand_applied,
+        intransit=intransit,
+        n_migrations=jnp.sum(pair).astype(jnp.int32),
+    )
+
+
+def synchronize(
+    gateway: jax.Array,
+    collected_total: jax.Array,
+    members: jax.Array,
+) -> jax.Array:
+    """Reset each node's power-differential prediction from a collected
+    snapshot: the group's conserved total spread over members
+    (``HandleCollectedState`` → ``Synchronize``,
+    ``lb/LoadBalance.cpp:1160-1236``).
+
+    Returns the per-node "normal" (target gateway) the reference centers
+    its next round on.
+    """
+    return collected_total / jnp.maximum(members, 1)
+
+
+def run_rounds(
+    net_generation: jax.Array,
+    gateway0: jax.Array,
+    group_mask: jax.Array,
+    migration_step: float,
+    n_rounds: int,
+    malicious: Optional[jax.Array] = None,
+):
+    """Iterate LB rounds under ``lax.scan`` until (typically) convergence.
+
+    Returns the final gateway vector and the per-round migration counts —
+    the trajectory the 3-node CPU baseline produces over its 3000 ms
+    rounds (BASELINE.md config #1), produced here in one device program.
+    """
+
+    def body(gw, _):
+        out = lb_round(net_generation, gw, group_mask, migration_step, malicious)
+        return out.gateway, (out.n_migrations, out.state)
+
+    gw, (migs, states) = jax.lax.scan(body, gateway0, None, length=n_rounds)
+    return gw, migs, states

@@ -1,0 +1,89 @@
+"""State collection: consistent global snapshots as collectives.
+
+TPU-native replacement for the reference's ``sc`` module — a
+Chandy-Lamport distributed snapshot (``Broker/src/sc/StateCollection.cpp:9-23``):
+the initiator snapshots local device signals, floods markers, peers
+snapshot on first marker and record in-transit lb/vvc "Accept" messages
+as channel state (``HandleAccept``, ``:539-558``), then states flow back
+and are aggregated into a ``CollectedStateMessage`` (gateway/generation/
+storage/drain/state sums + ``num_intransit_accepts``,
+``Broker/src/messages/StateCollection.proto:22-74``).
+
+On a synchronous mesh the algorithm is the *step boundary itself*
+(SURVEY.md §2.2): every node's signals at the end of superstep t are a
+consistent cut by construction — no markers, no marker ordering, no
+channel recording.  The only genuinely distributed content left is:
+
+- the **group-masked aggregation** (each initiator aggregates only its
+  group), a masked matmul / ``psum`` here;
+- the **in-flight migration ledger**: migrations accepted in round t but
+  not yet applied to the plant are the reference's in-transit Accepts;
+  LB maintains them as an integer array that the snapshot sums.
+
+The equivalence is property-tested in ``tests/test_sc.py``: for any
+interleaving of migrations, ``Σ gateways + in-transit = const`` — the
+invariant the reference's LB ``Synchronize`` relies on
+(``lb/LoadBalance.cpp:1160-1236``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CollectedState(NamedTuple):
+    """Per-initiator aggregated snapshot (rows = each node's group view).
+
+    Field names mirror ``CollectedStateMessage``
+    (``StateCollection.proto:52-74``).
+    """
+
+    gateway: jax.Array  # [N] Σ SST gateway over my group
+    generation: jax.Array  # [N] Σ DRER generation
+    storage: jax.Array  # [N] Σ DESD storage
+    drain: jax.Array  # [N] Σ Load drain
+    state: jax.Array  # [N] Σ FID state
+    num_intransit_accepts: jax.Array  # [N] Σ in-flight migration quanta
+    members: jax.Array  # [N] group size (peers in the cut)
+
+
+def collect(
+    group_mask: jax.Array,
+    gateway: jax.Array,
+    generation: jax.Array,
+    storage: jax.Array,
+    drain: jax.Array,
+    fid_state: jax.Array,
+    intransit: jax.Array,
+) -> CollectedState:
+    """Aggregate a consistent cut over each node's group.
+
+    ``group_mask``: [N, N] 0/1 same-group indicator (row i = node i's
+    view, from :func:`freedm_tpu.modules.gm.form_groups`); signal arrays
+    are [N].  One masked matvec per signal — the snapshot every node
+    would get by initiating the reference protocol simultaneously.
+    """
+    m = group_mask.astype(gateway.dtype)
+
+    def agg(x):
+        return m @ x.astype(gateway.dtype)
+
+    return CollectedState(
+        gateway=agg(gateway),
+        generation=agg(generation),
+        storage=agg(storage),
+        drain=agg(drain),
+        state=agg(fid_state),
+        num_intransit_accepts=agg(intransit),
+        members=jnp.sum(m, axis=1).astype(jnp.int32),
+    )
+
+
+def invariant_total(cs: CollectedState) -> jax.Array:
+    """The conserved quantity LB synchronizes against: group gateway sum
+    plus in-flight quanta (``HandleCollectedState`` → ``Synchronize``,
+    ``lb/LoadBalance.cpp:1160-1236``)."""
+    return cs.gateway + cs.num_intransit_accepts

@@ -1,0 +1,19 @@
+"""Small text-input helpers shared by the config parsers."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Union
+
+
+def read_source(source: Union[str, Path], marker: str) -> str:
+    """Accept a filesystem path or raw config text; return the text.
+
+    ``marker`` is a substring that only appears in raw text of the given
+    format (e.g. ``"<"`` for XML, ``"\\n"`` for line-oriented DSLs) —
+    if absent, ``source`` is treated as a path.
+    """
+    text = str(source)
+    if marker not in text:
+        return Path(source).read_text()
+    return text
