@@ -67,6 +67,11 @@ MAX_PACKET_SIZE: int = 32767
 # Reference: CBroker ALIGNMENT_DURATION = 250ms (Broker/src/CBroker.hpp:54).
 ALIGNMENT_DURATION_MS: int = 250
 
+# Nominal system frequency, rad/s. Reference: hard-coded in the LB
+# frequency invariant for its 7-node PSCAD model
+# (Broker/src/lb/LoadBalance.cpp:1237-1277).
+OMEGA_NOMINAL: float = 376.8
+
 
 def parse_cfg(path: Union[str, Path]) -> Dict[str, List[str]]:
     """Parse a boost::program_options style config file.

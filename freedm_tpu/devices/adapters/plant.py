@@ -33,11 +33,12 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from freedm_tpu.core.config import OMEGA_NOMINAL
 from freedm_tpu.devices.adapters.base import Adapter
 from freedm_tpu.grid.feeder import Feeder
 from freedm_tpu.pf import ladder
 
-NOMINAL_OMEGA = 376.8  # rad/s, the reference's PSCAD model constant
+NOMINAL_OMEGA = OMEGA_NOMINAL  # rad/s, the reference's PSCAD model constant
 
 
 def register_plant_type(factory, feeder: "Feeder", node_of: Dict[str, int], **kwargs) -> None:

@@ -1,0 +1,13 @@
+from freedm_tpu.runtime.broker import Broker  # noqa: F401
+from freedm_tpu.runtime.dispatch import Dispatcher  # noqa: F401
+from freedm_tpu.runtime.messages import ModuleMessage, ALL_MODULES  # noqa: F401
+from freedm_tpu.runtime.module import DgiModule, PhaseContext  # noqa: F401
+from freedm_tpu.runtime.peers import Peer, PeerList, TimedPeerSet  # noqa: F401
+from freedm_tpu.runtime.fleet import (  # noqa: F401
+    Fleet,
+    NodeHandle,
+    GmModule,
+    ScModule,
+    LbModule,
+    build_broker,
+)

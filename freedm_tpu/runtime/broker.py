@@ -1,0 +1,178 @@
+"""The broker: real-time round-robin phase scheduler.
+
+Reference: ``CBroker`` (``Broker/src/CBroker.cpp``) — the singleton
+io_service owner whose scheduler gives each registered module a
+wall-clock time slice per round; phases are aligned to
+``time-of-day mod round-length`` (plus the clock-sync skew) so all N
+processes run the same module simultaneously (``ChangePhase``,
+``CBroker.cpp:423-519``); per-module ready queues hold tasks and
+dispatched messages; ``Schedule(module, task)`` with ``start_phase=False``
+means "run at the module's next phase start" (the ``not_a_date_time``
+convention); ``TimeRemaining`` exposes the budget left
+(``CBroker.cpp:533-536``).
+
+TPU-native differences:
+
+- one broker drives the whole fleet (modules are fleet-level, nodes are
+  array rows), so phase alignment across processes is only needed at
+  the DCN boundary — ``realtime=False`` runs rounds as fast as the
+  device can, ``realtime=True`` reproduces the reference's wall-clock
+  alignment (including the ALIGNMENT_DURATION skew window) for
+  hardware-in-the-loop parity;
+- no singletons, no io_service: a plain loop owns the schedule; device
+  ingress/egress happens between phases through the
+  :class:`~freedm_tpu.devices.manager.DeviceManager` pumps.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from freedm_tpu.core.config import ALIGNMENT_DURATION_MS
+from freedm_tpu.runtime.dispatch import Dispatcher
+from freedm_tpu.runtime.messages import ModuleMessage
+from freedm_tpu.runtime.module import DgiModule, PhaseContext
+
+
+@dataclass
+class _Phase:
+    module: DgiModule
+    time_ms: float
+    queue: List[Callable[[], None]] = field(default_factory=list)
+    next_queue: List[Callable[[], None]] = field(default_factory=list)
+
+
+class Broker:
+    """Round-robin phase scheduler over registered modules."""
+
+    def __init__(self, clock_skew_s: float = 0.0):
+        self.dispatcher = Dispatcher()
+        self._phases: List[_Phase] = []
+        self._by_name: Dict[str, _Phase] = {}
+        self._stop = False
+        self.clock_skew_s = clock_skew_s
+        self.round_index = 0
+        self.shared: Dict[str, Any] = {}
+        self._timers: List[Tuple[float, str, Callable[[], None]]] = []
+
+    # -- registration (CBroker::RegisterModule) ------------------------------
+    def register_module(self, module: DgiModule, phase_time_ms: float) -> None:
+        if module.name in self._by_name:
+            raise ValueError(f"duplicate module {module.name!r}")
+        ph = _Phase(module, phase_time_ms)
+        self._phases.append(ph)
+        self._by_name[module.name] = ph
+        # Default read handler: the module's own queue.
+        self.dispatcher.register(
+            module.name,
+            module.name,
+            lambda msg, m=module: m.handle_message(msg),
+        )
+
+    def subscribe(self, recipient: str, module: DgiModule) -> None:
+        """Extra subscription (SC listening on "lb"/"vvc",
+        ``PosixMain.cpp:361,367``)."""
+        self.dispatcher.register(
+            recipient, module.name, lambda msg, m=module: m.handle_message(msg)
+        )
+
+    @property
+    def round_length_ms(self) -> float:
+        return sum(p.time_ms for p in self._phases)
+
+    # -- scheduling (CBroker::Schedule) --------------------------------------
+    def schedule(self, module_name: str, task: Callable[[], None], this_round: bool = False) -> None:
+        """Queue a task for the module's next phase (``not_a_date_time``
+        semantics); ``this_round=True`` targets the current round's
+        still-pending phase queue."""
+        ph = self._by_name[module_name]
+        (ph.queue if this_round else ph.next_queue).append(task)
+
+    def allocate_timer(self, module_name: str) -> str:
+        """Timers are keyed by module (CBroker::AllocateTimer)."""
+        if module_name not in self._by_name:
+            raise ValueError(f"unknown module {module_name!r}")
+        return module_name
+
+    def schedule_timer(self, timer: str, delay_s: float, task: Callable[[], None]) -> None:
+        """Run ``task`` in the timer's module phase once ``delay_s``
+        elapsed (fires at the first phase boundary past the deadline,
+        like the reference's timer→phase-queue hand-off)."""
+        self._timers.append((time.monotonic() + delay_s, timer, task))
+
+    def deliver(self, msg: ModuleMessage) -> int:
+        """Dispatch an incoming message (transport/loopback ingress)."""
+        return self.dispatcher.dispatch(
+            msg,
+            lambda handler_id, handler, m: self.schedule(handler_id, lambda: handler(m)),
+        )
+
+    def stop(self) -> None:
+        self._stop = True
+
+    # -- the loop (CBroker::Run / ChangePhase / Worker) ----------------------
+    def _fire_due_timers(self) -> None:
+        now = time.monotonic()
+        due = [t for t in self._timers if t[0] <= now]
+        self._timers = [t for t in self._timers if t[0] > now]
+        for _, module_name, task in due:
+            self.schedule(module_name, task, this_round=True)
+
+    def _align(self) -> None:
+        """Wait for the next wall-clock round boundary (plus skew) when
+        off it — ChangePhase's time-of-day alignment so federated
+        brokers phase-lock without coordination.  Within the
+        ALIGNMENT_DURATION tolerance we are on-boundary (a round that
+        just ended on time) and no wait happens; past it (start-up, or a
+        phase overrun) we resynchronize by waiting out the remainder —
+        the reference's skip-to-catch-up."""
+        round_s = self.round_length_ms / 1000.0
+        if round_s <= 0:
+            return
+        now = time.time() + self.clock_skew_s
+        into = now % round_s
+        if into > ALIGNMENT_DURATION_MS / 1000.0:
+            time.sleep(round_s - into)
+
+    def run_round(self, realtime: bool = False) -> None:
+        """Execute one full round: every phase in registration order."""
+        for ph in self._phases:
+            phase_start = time.time()
+            ph.queue.extend(ph.next_queue)
+            ph.next_queue = []
+            self._fire_due_timers()
+            ctx = PhaseContext(
+                round_index=self.round_index,
+                phase_start=phase_start,
+                time_remaining_ms=ph.time_ms,
+                shared=self.shared,
+            )
+            # Drain queued work (messages + tasks), then the phase body.
+            while ph.queue:
+                task = ph.queue.pop(0)
+                task()
+            ph.module.run_phase(ctx)
+            if realtime:
+                spent = time.time() - phase_start
+                budget = ph.time_ms / 1000.0
+                if spent < budget:
+                    time.sleep(budget - spent)
+        self.round_index += 1
+
+    def run(self, n_rounds: Optional[int] = None, realtime: bool = False) -> int:
+        """Run rounds until ``n_rounds`` or :meth:`stop`.
+
+        Returns the number of completed rounds.
+        """
+        done = 0
+        while not self._stop and (n_rounds is None or done < n_rounds):
+            if realtime:
+                # Re-align EVERY round (ChangePhase does, CBroker.cpp:423-519):
+                # a phase overrun must not accumulate skew across rounds, or
+                # federated brokers drift out of phase-lock.
+                self._align()
+            self.run_round(realtime=realtime)
+            done += 1
+        return done

@@ -1,0 +1,65 @@
+"""Message dispatch.
+
+Reference: ``CDispatcher`` (``Broker/src/CDispatcher.cpp``) — routes
+accepted ``ModuleMessage``s to modules by ``recipient_module`` string
+through a multimap (several modules may subscribe to one id — SC
+listens on "lb" and "vvc" to count in-flight Accepts,
+``PosixMain.cpp:361,367``); ``"all"`` broadcasts.  Messages for
+*scheduled* modules are queued into the module's next phase; messages
+for unscheduled modules (the clock synchronizer) are delivered
+immediately (``HandleRequest``, ``CDispatcher.cpp:68-103``).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable, Dict, List, Tuple
+
+from freedm_tpu.runtime.messages import ALL_MODULES, ModuleMessage
+
+Handler = Callable[[ModuleMessage], None]
+
+
+class Dispatcher:
+    """recipient_module → handler multimap with queue/immediate split."""
+
+    def __init__(self) -> None:
+        # (handler_id, handler, immediate)
+        self._handlers: Dict[str, List[Tuple[str, Handler, bool]]] = defaultdict(list)
+        self.dropped_expired = 0
+
+    def register(self, recipient: str, handler_id: str, handler: Handler, immediate: bool = False) -> None:
+        """Subscribe a handler to a recipient id
+        (``RegisterReadHandler``, ``CDispatcher.cpp:144-150``);
+        ``immediate`` marks unscheduled modules (clock sync)."""
+        self._handlers[recipient].append((handler_id, handler, immediate))
+
+    def dispatch(self, msg: ModuleMessage, enqueue: Callable[[str, Handler, ModuleMessage], None]) -> int:
+        """Route a message; returns the number of handlers reached.
+
+        ``enqueue(handler_id, handler, msg)`` is the broker's
+        queue-into-phase callback for non-immediate handlers. Expired
+        messages are dropped here, like the transport's expiration check
+        (real-time semantics: stale control data must die).
+        """
+        if msg.is_expired():
+            self.dropped_expired += 1
+            return 0
+        if msg.recipient_module == ALL_MODULES:
+            # One delivery per handler even when it subscribes to several
+            # recipient ids (e.g. SC on "sc"+"lb"+"vvc").
+            seen = set()
+            targets = []
+            for hs in self._handlers.values():
+                for h in hs:
+                    if h[0] not in seen:
+                        seen.add(h[0])
+                        targets.append(h)
+        else:
+            targets = list(self._handlers.get(msg.recipient_module, ()))
+        for handler_id, handler, immediate in targets:
+            if immediate:
+                handler(msg)
+            else:
+                enqueue(handler_id, handler, msg)
+        return len(targets)

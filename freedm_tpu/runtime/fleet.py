@@ -1,0 +1,299 @@
+"""Fleet assembly: N DGI nodes as one mesh program.
+
+This is the counterpart of the reference's ``PosixMain`` wiring
+(``Broker/src/PosixMain.cpp:346-435``): construct the four agents,
+register their phases with the broker in GM→SC→LB→VVC order with the
+``timings.cfg`` budgets, hook up device IO, and run.  The structural
+difference is the north star itself: where the reference starts one
+process per SST and lets them gossip, the fleet holds every node's
+device view and runs each module's *kernel* once per phase over the
+whole node axis.
+
+Per round:
+
+1. **ingress** — every node's :class:`DeviceManager` snapshot is read
+   into per-node scalars (netgen, gateway, FID states, frequency);
+2. **gm** — alive mask + FID-gated reachability →
+   :func:`freedm_tpu.modules.gm.form_groups`;
+3. **sc** — group-masked collection + LB's in-flight ledger →
+   :func:`freedm_tpu.modules.sc.collect`;
+4. **lb** — :func:`freedm_tpu.modules.lb.lb_round`; gateway deltas
+   become SST commands (SetPStar path);
+5. **vvc** — a gradient Volt-VAR step on the fleet's feeder
+   (:mod:`freedm_tpu.modules.vvc`);
+6. **egress** — commands flow back through the managers' adapters; the
+   plant (if any) advances one tick.
+
+A node "dies" (power off / network loss) via :meth:`Fleet.set_alive` —
+the next gm phase re-forms groups exactly like the reference's
+AYT-timeout → Recovery → re-election path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.core.config import OMEGA_NOMINAL, GlobalConfig, Timings
+from freedm_tpu.devices import tensor as dt
+from freedm_tpu.devices.manager import DeviceManager
+from freedm_tpu.modules import gm, lb, sc
+from freedm_tpu.runtime.broker import Broker
+from freedm_tpu.runtime.module import DgiModule, PhaseContext
+
+
+@dataclass
+class NodeHandle:
+    """One DGI node: uuid + its device view."""
+
+    uuid: str
+    manager: DeviceManager
+    alive: bool = True
+
+
+class Fleet:
+    """The fleet state shared by all modules."""
+
+    def __init__(
+        self,
+        nodes: Sequence[NodeHandle],
+        reachability=None,
+        migration_step: float = 1.0,
+        malicious: Optional[np.ndarray] = None,
+    ):
+        self.nodes = list(nodes)
+        self.reachability = reachability  # callable (fid_closed)->[N,N] or None
+        self.migration_step = migration_step
+        self.malicious = (
+            jnp.zeros(len(nodes)) if malicious is None else jnp.asarray(malicious)
+        )
+        self.priority = jnp.asarray(gm.node_priority(len(nodes)))
+        self.plants: List = []  # adapters with a .step() to advance per round
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.nodes)
+
+    def set_alive(self, idx: int, alive: bool) -> None:
+        self.nodes[idx].alive = alive
+
+    def alive_mask(self) -> jnp.ndarray:
+        return jnp.asarray([1.0 if n.alive else 0.0 for n in self.nodes])
+
+    # -- device ingress ------------------------------------------------------
+    def read_devices(self) -> Dict[str, jnp.ndarray]:
+        """Per-node scalars from each node's devices.
+
+        Mirrors LB's ``ReadDevices`` (net generation = DRER + DESD −
+        Load, gateway from SST, ``lb/LoadBalance.cpp:382-402``) plus the
+        FID states GM needs and the Omega frequency the invariant
+        checks.
+        """
+        n = self.n_nodes
+        generation = np.zeros(n)
+        storage = np.zeros(n)
+        drain = np.zeros(n)
+        gateway = np.zeros(n)
+        fid_min = np.ones(n)
+        # Nodes without an Omega device read the nominal frequency (a
+        # NaN here would silently fail any numeric invariant gate).
+        omega = np.full(n, OMEGA_NOMINAL)
+        for i, node in enumerate(self.nodes):
+            if not node.alive:
+                continue
+            m = node.manager
+            generation[i] = m.get_net_value("Drer", "generation")
+            storage[i] = m.get_net_value("Desd", "storage")
+            drain[i] = m.get_net_value("Load", "drain")
+            gateway[i] = m.get_net_value("Sst", "gateway")
+            fids = m.device_names("Fid")
+            if fids:
+                fid_min[i] = min(m.get_state(f, "state") for f in fids)
+            omegas = m.device_names("Omega")
+            if omegas:
+                omega[i] = m.get_state(omegas[0], "frequency")
+        return {
+            "netgen": jnp.asarray(generation + storage - drain),
+            "generation": jnp.asarray(generation),
+            "storage": jnp.asarray(storage),
+            "drain": jnp.asarray(drain),
+            "gateway": jnp.asarray(gateway),
+            "fid_min": jnp.asarray(fid_min),
+            "omega": jnp.asarray(omega),
+        }
+
+    def fid_states(self) -> jnp.ndarray:
+        """Global FID closed/open vector in topology order (best effort:
+        FID devices named after topology fid_names)."""
+        out = []
+        for node in self.nodes:
+            for f in node.manager.device_names("Fid"):
+                out.append(node.manager.get_state(f, "state"))
+        return jnp.asarray(out) if out else jnp.zeros(0)
+
+    # -- device egress -------------------------------------------------------
+    def write_gateways(self, gateway: np.ndarray) -> None:
+        """Push per-node gateway setpoints to each node's SSTs
+        (``SetPStar`` → ``SetCommand("gateway")``,
+        ``lb/LoadBalance.cpp:1000-1075``)."""
+        for i, node in enumerate(self.nodes):
+            if not node.alive:
+                continue
+            for name in node.manager.device_names("Sst"):
+                node.manager.set_command(name, "gateway", float(gateway[i]))
+
+    def step_plants(self) -> None:
+        for p in self.plants:
+            p.step()
+
+
+# ---------------------------------------------------------------------------
+# Modules
+# ---------------------------------------------------------------------------
+
+
+class GmModule(DgiModule):
+    name = "gm"
+
+    def __init__(self, fleet: Fleet):
+        self.fleet = fleet
+        self.last: Optional[gm.GroupState] = None
+        self.counters = {"elections": 0, "groups_broken": 0}
+        # Kernels must run compiled: eager op-by-op dispatch on TPU costs
+        # ~1000x (each jnp op is a device round-trip).
+        self._form = jax.jit(gm.form_groups)
+
+    def run_phase(self, ctx: PhaseContext) -> None:
+        fleet = self.fleet
+        # GM runs first: one device ingress per round, shared by every
+        # later phase (the plant only advances at egress, so re-reading
+        # would return identical data).
+        ctx.shared["readings"] = fleet.read_devices()
+        alive = fleet.alive_mask()
+        if fleet.reachability is not None:
+            reach = fleet.reachability(fleet.fid_states())
+        else:
+            reach = jnp.ones((fleet.n_nodes, fleet.n_nodes))
+        group = self._form(alive, reach, fleet.priority)
+        if self.last is not None:
+            c = gm.diff_counters(self.last, group)
+            self.counters["elections"] += int(c.elections)
+            self.counters["groups_broken"] += int(c.groups_broken)
+        self.last = group
+        ctx.shared["group"] = group
+
+
+class ScModule(DgiModule):
+    name = "sc"
+
+    def __init__(self, fleet: Fleet):
+        self.fleet = fleet
+        self._accepts = 0  # DCN-boundary Accepts seen on "lb"/"vvc"
+        self._collect = jax.jit(sc.collect)
+
+    def handle_message(self, msg, ctx=None) -> None:
+        # SC subscribes to lb/vvc to count in-flight Accepts arriving
+        # over the DCN boundary (PosixMain.cpp:361,367; HandleAccept,
+        # StateCollection.cpp:539-558). On-mesh migrations use the
+        # lb_intransit ledger instead.
+        if msg.type == "accept":
+            self._accepts += 1
+
+    def run_phase(self, ctx: PhaseContext) -> None:
+        fleet = self.fleet
+        group: Optional[gm.GroupState] = ctx.shared.get("group")
+        if group is None:
+            return
+        r = ctx.shared.get("readings") or fleet.read_devices()
+        intransit = ctx.shared.get("lb_intransit", jnp.zeros(fleet.n_nodes))
+        cs = self._collect(
+            group.group_mask,
+            r["gateway"],
+            r["generation"],
+            r["storage"],
+            r["drain"],
+            r["fid_min"],
+            intransit,
+        )
+        ctx.shared["collected"] = cs
+        # Surface (and reset) the DCN Accept count with the cut it
+        # belongs to, like the reference's num_intransit_accepts field.
+        ctx.shared["dcn_accepts"] = self._accepts
+        self._accepts = 0
+
+
+class LbModule(DgiModule):
+    name = "lb"
+
+    def __init__(self, fleet: Fleet, invariant=None):
+        self.fleet = fleet
+        self.invariant = invariant  # callable(readings) -> [] 0/1 gate
+        self.total_migrations = 0
+        self.rounds = 0
+        self._round = jax.jit(
+            partial(lb.lb_round, migration_step=fleet.migration_step)
+        )
+
+    def run_phase(self, ctx: PhaseContext) -> None:
+        fleet = self.fleet
+        group: Optional[gm.GroupState] = ctx.shared.get("group")
+        if group is None:
+            return
+        r = ctx.shared.get("readings") or fleet.read_devices()
+        gate = None if self.invariant is None else self.invariant(r)
+        out = self._round(
+            r["netgen"],
+            r["gateway"],
+            group.group_mask,
+            malicious=fleet.malicious,
+            invariant_ok=gate,
+        )
+        fleet.write_gateways(np.asarray(out.gateway))
+        ctx.shared["lb_intransit"] = out.intransit
+        ctx.shared["lb_round"] = out
+        self.total_migrations += int(out.n_migrations)
+        self.rounds += 1
+
+
+class EgressModule(DgiModule):
+    """End-of-round device egress + plant tick (the adapter io_service's
+    periodic exchange in the reference, CAdapterFactory's device thread)."""
+
+    name = "egress"
+
+    def __init__(self, fleet: Fleet):
+        self.fleet = fleet
+
+    def run_phase(self, ctx: PhaseContext) -> None:
+        self.fleet.step_plants()
+
+
+def build_broker(
+    fleet: Fleet,
+    timings: Optional[Timings] = None,
+    config: Optional[GlobalConfig] = None,
+    invariant=None,
+    extra_modules: Sequence[DgiModule] = (),
+) -> Broker:
+    """Wire the standard module stack (PosixMain.cpp:346-435 parity:
+    GM, SC, LB phases in order with timings.cfg budgets, SC subscribed
+    to lb/vvc, plus fleet egress)."""
+    t = timings or Timings()
+    broker = Broker()
+    gm_mod = GmModule(fleet)
+    sc_mod = ScModule(fleet)
+    lb_mod = LbModule(fleet, invariant=invariant)
+    broker.register_module(gm_mod, t.gm_phase_time)
+    broker.register_module(sc_mod, t.sc_phase_time)
+    broker.register_module(lb_mod, t.lb_phase_time)
+    for m in extra_modules:
+        broker.register_module(m, t.vvc_phase_time)
+    broker.register_module(EgressModule(fleet), 0)
+    broker.subscribe("lb", sc_mod)
+    broker.subscribe("vvc", sc_mod)
+    return broker

@@ -1,0 +1,70 @@
+"""Module messages: envelope, hashing, expiration.
+
+Host-side equivalent of the reference's protobuf envelope and helpers:
+``ModuleMessage{recipient_module, payload}``
+(``Broker/src/messages/ModuleMessage.proto:29-39``) and the
+``Messages.cpp`` utilities — content hash (``ComputeMessageHash``,
+``Messages.cpp:50-56``), expiration stamping/checking
+(``SetExpirationTimeFromNow``/``MessageIsExpired``, ``:65-91``), and
+send-time stamping (``StampMessageSendtime``, ``:100-108``).
+
+Real-time semantics carry over: control messages *should* die when
+stale (the reference's expiration-based at-most-once delivery,
+``CProtocolSR.cpp:113,154-169``) — on-mesh data never needs this, but
+every DCN-boundary message keeps it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Optional
+
+# recipient_module value meaning "every registered module"
+# (CDispatcher::HandleRequest broadcast, CDispatcher.cpp:68-103).
+ALL_MODULES = "all"
+
+
+@dataclass(frozen=True)
+class ModuleMessage:
+    """An inter-module / inter-node message."""
+
+    recipient_module: str
+    type: str
+    payload: Dict[str, Any] = field(default_factory=dict)
+    source: str = ""  # sender uuid (hostname:port discipline)
+    send_time: Optional[float] = None  # unix seconds
+    expire_time: Optional[float] = None
+
+    def stamped(self, now: Optional[float] = None) -> "ModuleMessage":
+        """Stamp the send time (StampMessageSendtime)."""
+        return replace(self, send_time=time.time() if now is None else now)
+
+    def expiring(self, ttl_s: float, now: Optional[float] = None) -> "ModuleMessage":
+        """Set expiration ttl seconds from now (SetExpirationTimeFromNow)."""
+        base = time.time() if now is None else now
+        return replace(self, expire_time=base + ttl_s)
+
+    def is_expired(self, now: Optional[float] = None) -> bool:
+        """True when past the expire time (MessageIsExpired); messages
+        without an expiration never expire."""
+        if self.expire_time is None:
+            return False
+        return (time.time() if now is None else now) > self.expire_time
+
+    def hash(self) -> str:
+        """Stable content hash (ComputeMessageHash: the reference hashes
+        the serialized proto; we hash the canonical JSON)."""
+        blob = json.dumps(
+            {
+                "recipient_module": self.recipient_module,
+                "type": self.type,
+                "payload": self.payload,
+                "source": self.source,
+            },
+            sort_keys=True,
+            default=str,
+        )
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]

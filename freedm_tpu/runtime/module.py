@@ -1,0 +1,52 @@
+"""Module plugin interface.
+
+Reference: ``IDGIModule`` (``Broker/src/IDGIModule.hpp:52-53``) — every
+algorithm module implements ``HandleIncomingMessage`` and exposes a
+``Run()`` entry the broker schedules into its phase; modules also
+receive the coordinator's ``PeerListMessage`` via ``ProcessPeerList``.
+
+The TPU-native difference: one module instance manages the whole fleet
+(nodes are array rows inside its jitted kernels), so ``run_phase``
+receives a :class:`PhaseContext` carrying the shared fleet state instead
+of per-process device handles.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from freedm_tpu.runtime.messages import ModuleMessage
+
+
+@dataclass
+class PhaseContext:
+    """State handed to a module for one phase of one round.
+
+    ``shared`` is the blackboard the modules cooperate through (group
+    state from gm, collected snapshots from sc, …) — the counterpart of
+    the reference modules messaging each other's handlers.
+    """
+
+    round_index: int
+    phase_start: float  # wall-clock seconds
+    time_remaining_ms: float  # budget left in this phase (CBroker::TimeRemaining)
+    shared: Dict[str, Any] = field(default_factory=dict)
+
+
+class DgiModule(ABC):
+    """Base class for scheduler-driven modules."""
+
+    #: short module id used for dispatch routing ("gm", "sc", "lb", ...)
+    name: str = ""
+
+    @abstractmethod
+    def run_phase(self, ctx: PhaseContext) -> None:
+        """Execute one phase (the reference's scheduled ``Run()``)."""
+
+    def handle_message(self, msg: ModuleMessage, ctx: Optional[PhaseContext] = None) -> None:
+        """Process one queued message (``HandleIncomingMessage``)."""
+
+    def handle_peer_list(self, coordinator: int, members) -> None:
+        """Group view push (``ProcessPeerList`` counterpart)."""

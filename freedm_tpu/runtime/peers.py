@@ -1,0 +1,96 @@
+"""Peer handles and peer sets.
+
+Reference: ``CPeerNode`` (uuid + send via the connection manager,
+``Broker/src/CPeerNode.cpp:113-132``), ``PeerSet``/``TimedPeerSet``
+(uuid→peer maps with insert/count/erase and response-deadline stamps,
+``Broker/src/PeerSets.hpp``) and the process-wide ``CGlobalPeerList``.
+
+The loopback short-circuit is preserved: sending to one's own uuid
+delivers straight into the local broker (``CConnection::Send``,
+``CConnection.cpp:113-130``); remote sends go through a pluggable
+transport (the DCN boundary, :mod:`freedm_tpu.dcn`) — on-mesh nodes
+never message each other at all.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+from freedm_tpu.runtime.messages import ModuleMessage
+
+# transport(uuid, message) -> None; raises on unreachable.
+Transport = Callable[[str, ModuleMessage], None]
+
+
+@dataclass(frozen=True)
+class Peer:
+    """A sendable handle on a (possibly remote) DGI node."""
+
+    uuid: str
+    _send: Transport
+
+    def send(self, msg: ModuleMessage) -> None:
+        self._send(self.uuid, msg.stamped())
+
+
+class PeerList:
+    """uuid → Peer registry (CGlobalPeerList + PeerSet helpers)."""
+
+    def __init__(self, self_uuid: str, loopback: Callable[[ModuleMessage], None]):
+        self.self_uuid = self_uuid
+        self._loopback = loopback
+        self._peers: Dict[str, Peer] = {}
+        self.add(self_uuid, None)
+
+    def add(self, uuid: str, transport: Optional[Transport]) -> Peer:
+        if uuid == self.self_uuid:
+            send: Transport = lambda _uuid, msg: self._loopback(msg)  # noqa: E731
+        elif transport is None:
+            raise ValueError(f"remote peer {uuid!r} needs a transport")
+        else:
+            send = transport
+        peer = Peer(uuid, send)
+        self._peers[uuid] = peer
+        return peer
+
+    def get(self, uuid: str) -> Peer:
+        return self._peers[uuid]
+
+    def __contains__(self, uuid: str) -> bool:
+        return uuid in self._peers
+
+    def __len__(self) -> int:
+        return len(self._peers)
+
+    def uuids(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._peers))
+
+    def broadcast(self, msg: ModuleMessage) -> int:
+        for p in self._peers.values():
+            p.send(msg)
+        return len(self._peers)
+
+
+class TimedPeerSet:
+    """Peers with a response deadline (TimedPeerSet: AYC/AYT bookkeeping)."""
+
+    def __init__(self) -> None:
+        self._deadline: Dict[str, float] = {}
+
+    def insert(self, uuid: str, timeout_s: float) -> None:
+        self._deadline[uuid] = time.monotonic() + timeout_s
+
+    def expired(self) -> Tuple[str, ...]:
+        now = time.monotonic()
+        return tuple(u for u, d in self._deadline.items() if d <= now)
+
+    def erase(self, uuid: str) -> None:
+        self._deadline.pop(uuid, None)
+
+    def __len__(self) -> int:
+        return len(self._deadline)
+
+    def __contains__(self, uuid: str) -> bool:
+        return uuid in self._deadline

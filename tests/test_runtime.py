@@ -1,0 +1,196 @@
+"""Runtime tests: broker scheduling, dispatch, and the 3-node
+end-to-end demo (BASELINE.md config #1: the reference's 3-node LB+SC
+deployment with fake devices, here one fleet program over a shared
+JAX plant).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from freedm_tpu.devices.adapters.plant import NOMINAL_OMEGA, PlantAdapter
+from freedm_tpu.devices.manager import DeviceManager
+from freedm_tpu.grid import cases
+from freedm_tpu.modules import lb, sc
+from freedm_tpu.runtime import (
+    Broker,
+    DgiModule,
+    Fleet,
+    ModuleMessage,
+    NodeHandle,
+    PeerList,
+    build_broker,
+)
+
+
+class Recorder(DgiModule):
+    def __init__(self, name):
+        self.name = name
+        self.phases = []
+        self.messages = []
+
+    def run_phase(self, ctx):
+        self.phases.append(ctx.round_index)
+
+    def handle_message(self, msg, ctx=None):
+        self.messages.append(msg)
+
+
+def test_broker_phase_order_and_rounds():
+    b = Broker()
+    m1, m2 = Recorder("a"), Recorder("b")
+    b.register_module(m1, 10)
+    b.register_module(m2, 20)
+    assert b.round_length_ms == 30
+    done = b.run(n_rounds=3)
+    assert done == 3
+    assert m1.phases == m2.phases == [0, 1, 2]
+
+
+def test_broker_message_queueing_and_broadcast():
+    b = Broker()
+    m1, m2 = Recorder("a"), Recorder("b")
+    b.register_module(m1, 10)
+    b.register_module(m2, 10)
+    # Messages dispatched before a round run in the recipient's phase.
+    assert b.deliver(ModuleMessage("a", "ping")) == 1
+    assert b.deliver(ModuleMessage("all", "bcast")) == 2
+    b.run(n_rounds=1)
+    assert [m.type for m in m1.messages] == ["ping", "bcast"]
+    assert [m.type for m in m2.messages] == ["bcast"]
+    # Expired messages are dropped at dispatch (real-time semantics).
+    stale = ModuleMessage("a", "late").expiring(-1.0)
+    assert b.deliver(stale) == 0
+    assert b.dispatcher.dropped_expired == 1
+
+
+def test_broker_timers_fire_in_module_phase():
+    b = Broker()
+    m = Recorder("a")
+    fired = []
+    b.register_module(m, 10)
+    t = b.allocate_timer("a")
+    b.schedule_timer(t, 0.0, lambda: fired.append(b.round_index))
+    b.run(n_rounds=2)
+    assert fired == [0]
+
+
+def test_peer_loopback_shortcircuit():
+    got = []
+    pl = PeerList("me:1", loopback=got.append)
+    pl.get("me:1").send(ModuleMessage("lb", "hello"))
+    assert got and got[0].type == "hello"
+    with pytest.raises(ValueError):
+        pl.add("other:2", None)  # remote peer requires a transport
+
+
+# ---------------------------------------------------------------------------
+# 3-node end-to-end demo
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def three_node_fleet():
+    feeder = cases.vvc_9bus()
+    placements = {
+        # node A (supply): surplus 20 kW
+        "SST1": ("Sst", 2),
+        "DRER_A": ("Drer", 1),
+        "LOAD_A": ("Load", 0),
+        # node B (demand): deficit 20 kW
+        "SST2": ("Sst", 4),
+        "LOAD_B": ("Load", 5),
+        "DRER_B": ("Drer", 6),
+        # node C (balanced)
+        "SST3": ("Sst", 7),
+        "LOAD_C": ("Load", 3),
+        "DRER_C": ("Drer", 3),
+        "OMEGA": ("Omega", 0),
+    }
+    plant = PlantAdapter(feeder, placements, droop=0.05)
+    managers = []
+    owned = [
+        ["SST1", "DRER_A", "LOAD_A", "OMEGA"],
+        ["SST2", "LOAD_B", "DRER_B"],
+        ["SST3", "LOAD_C", "DRER_C"],
+    ]
+    for names in owned:
+        m = DeviceManager(capacity=8)
+        for n in names:
+            m.add_device(n, placements[n][0], plant)
+        managers.append(m)
+    plant.reveal_devices()
+    plant.set_generation("DRER_A", 30.0)
+    plant.set_load("LOAD_A", 10.0)
+    plant.set_load("LOAD_B", 30.0)
+    plant.set_generation("DRER_B", 10.0)
+    plant.set_load("LOAD_C", 20.0)
+    plant.set_generation("DRER_C", 20.0)
+    plant.start()
+
+    fleet = Fleet(
+        [NodeHandle(f"host{i}:5187{i}", m) for i, m in enumerate(managers)],
+        migration_step=1.0,
+    )
+    fleet.plants.append(plant)
+    return fleet, plant
+
+
+def test_three_node_demo_converges(three_node_fleet):
+    fleet, plant = three_node_fleet
+    broker = build_broker(fleet)
+    broker.run(n_rounds=30)
+
+    r = fleet.read_devices()
+    gw = np.asarray(r["gateway"])
+    # Supply exported its surplus, demand imported its deficit
+    # (reference 3-node LB outcome after its 3000 ms rounds).
+    np.testing.assert_allclose(gw, [20.0, -20.0, 0.0], atol=1.01)
+    out = broker.shared["lb_round"]
+    assert int(out.n_migrations) == 0  # converged: no more drafts
+    # Everyone inside the ±step band.
+    assert np.all(np.asarray(out.state) == lb.NORMAL)
+    # The balanced system's frequency is near nominal.
+    assert plant.omega == pytest.approx(NOMINAL_OMEGA, rel=0.02)
+    # SC's collected view agrees: group gateway total ~ 0 (honest run).
+    cs = broker.shared["collected"]
+    assert float(jnp.max(jnp.abs(sc.invariant_total(cs)))) < 1.01
+
+
+def test_node_failure_reforms_groups(three_node_fleet):
+    fleet, plant = three_node_fleet
+    broker = build_broker(fleet)
+    broker.run(n_rounds=5)
+    assert int(broker.shared["group"].n_groups) == 1
+
+    # Kill the supply node: the AYT-timeout -> Recovery path.
+    fleet.set_alive(0, False)
+    broker.run(n_rounds=3)
+    g = broker.shared["group"]
+    assert int(g.n_groups) == 1  # B and C regroup
+    assert int(g.coordinator[0]) == -1
+    assert np.asarray(g.group_mask)[1, 0] == 0
+    # Demand can no longer be served (no supply in the group): the
+    # incomplete-coverage outcome, not an error.
+    out = broker.shared["lb_round"]
+    assert int(out.state[1]) == lb.DEMAND
+    assert int(out.n_migrations) == 0
+
+    # Node A returns: merge back into one 3-node group (re-election).
+    fleet.set_alive(0, True)
+    broker.run(n_rounds=3)
+    g2 = broker.shared["group"]
+    assert int(g2.n_groups) == 1
+    assert int(g2.group_size[0]) == 3
+
+
+def test_malicious_node_detected_by_ledger(three_node_fleet):
+    fleet, plant = three_node_fleet
+    fleet.malicious = jnp.asarray([0.0, 1.0, 0.0])  # demand node B cheats
+    broker = build_broker(fleet)
+    broker.run(n_rounds=3)
+    cs = broker.shared["collected"]
+    out = broker.shared["lb_round"]
+    # The cut's conserved total differs from the raw gateway sum by the
+    # unapplied quanta — the discrepancy SC exists to surface.
+    assert float(jnp.sum(out.intransit)) < 0.0
