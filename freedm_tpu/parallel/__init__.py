@@ -1,0 +1,8 @@
+from freedm_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    node_sharding,
+    batch_sharding,
+    replicated,
+)
+from freedm_tpu.parallel.collectives import group_totals, alive_argmax  # noqa: F401
+from freedm_tpu.parallel.superstep import FleetState, SuperstepOut, make_superstep  # noqa: F401

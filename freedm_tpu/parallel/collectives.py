@@ -1,0 +1,90 @@
+"""Explicit-collective kernels (shard_map over the nodes axis).
+
+The north star's key sentence (BASELINE.json): "the LB Demand/Supply
+normals collapse to a single psum over ICI instead of N×N broker
+messages".  Most of the framework lets GSPMD place collectives from
+sharding annotations; the kernels here write them explicitly with
+``shard_map`` where the communication pattern IS the algorithm:
+
+- :func:`group_totals` — per-group sums (gateway, supply, demand) via a
+  local masked partial-sum + one ``psum`` over ``nodes``: the
+  reference's SC aggregation wave and LB demand broadcast in one
+  collective hop;
+- :func:`alive_argmax` — leader election as ``psum``-combined masked
+  argmax (the gm election's communication core, for fleets too large to
+  replicate the [N, N] group mask).
+
+Each is numerically identical to its replicated counterpart in
+:mod:`freedm_tpu.modules` (tested in tests/test_parallel.py); they are
+the multi-chip execution path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def group_totals(mesh: Mesh, group_mask: jax.Array, values: jax.Array) -> jax.Array:
+    """[N] per-node group totals of ``values`` with one psum over ICI.
+
+    ``group_mask`` rows are sharded over ``nodes``; each shard computes
+    its local block's contribution ``mask_block @ values`` after an
+    all-gather of the (small) value vector — one collective per call
+    instead of the reference's N×N message exchange
+    (``StateCollection.cpp`` send-back wave / LB demand broadcast).
+    """
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("nodes", None), P("nodes")),
+        out_specs=P("nodes"),
+    )
+    def _totals(mask_block, values_block):
+        # values_block: this shard's node values; gather the full vector
+        # over ICI, then reduce against the local mask rows.
+        full = jax.lax.all_gather(values_block, "nodes", tiled=True)
+        return mask_block @ full
+
+    return _totals(group_mask, values)
+
+
+def alive_argmax(mesh: Mesh, score: jax.Array, alive: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Global (argmax index, max score) over live nodes — one psum.
+
+    The election collective: each shard reduces its local candidates,
+    then a psum-style max-combine over ``nodes`` picks the fleet winner
+    (GroupManagement's election outcome for the fully-connected case).
+    Returns replicated scalars.
+    """
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("nodes"), P("nodes")),
+        out_specs=(P(), P()),
+    )
+    def _argmax(score_block, alive_block):
+        idx = jax.lax.axis_index("nodes")
+        block = score_block.shape[0]
+        masked = jnp.where(alive_block > 0, score_block, -jnp.inf)
+        local_best = jnp.max(masked)
+        local_arg = jnp.argmax(masked) + idx * block  # argmax: lowest local index
+        best = jax.lax.pmax(local_best, "nodes")
+        # Ties across shards resolve to the LOWEST global index (like a
+        # replicated argmax): min-combine candidate indices.
+        n_total = block * jax.lax.axis_size("nodes")
+        winner = jax.lax.pmin(
+            jnp.where(local_best == best, local_arg, n_total), "nodes"
+        )
+        # All dead => best is -inf everywhere; report -1.
+        winner = jnp.where(jnp.isfinite(best), winner, -1)
+        return winner.astype(jnp.int32), best
+
+    return _argmax(score, alive)

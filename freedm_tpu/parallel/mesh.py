@@ -1,0 +1,78 @@
+"""Device-mesh utilities.
+
+The framework's two parallel axes (SURVEY.md §2.5):
+
+- ``nodes`` — one row per DGI node (the reference's one-broker-process-
+  per-SST, collapsed onto chips); per-node vectors shard over it, the
+  [N, N] group/reachability operators shard by rows, and group
+  reductions ride ICI as ``psum``s instead of N×N UDP messages;
+- ``batch`` — Monte-Carlo scenarios / contingencies (the reference has
+  no equivalent; it runs one scenario per deployment).
+
+Multi-host scaling is the same code: `jax.distributed` initializes the
+global device list, the mesh spans hosts, and XLA routes collectives
+over ICI within a slice and DCN across slices — the transport layer the
+reference hand-built with CProtocolSR over UDP (SURVEY.md §5) exists
+below XLA here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axes: Tuple[str, ...] = ("nodes",),
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Build a mesh over the first ``n_devices`` local devices.
+
+    With two axes and no explicit shape, devices split as evenly as
+    possible favoring the first axis (e.g. 8 → nodes=4 × batch=2).
+    """
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    if len(devs) < n:
+        raise RuntimeError(f"need {n} devices, host has {len(devs)}")
+    devs = devs[:n]
+    if shape is None:
+        if len(axes) == 1:
+            shape = (n,)
+        elif len(axes) == 2:
+            # Favor the first axis: second gets the largest divisor
+            # not exceeding sqrt(n) (8 -> 4x2, 16 -> 4x4).
+            a = _largest_divisor_at_most(n, int(np.sqrt(n)))
+            shape = (n // a, a)
+        else:
+            raise ValueError("give an explicit shape for >2 axes")
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    return Mesh(np.asarray(devs).reshape(shape), axis_names=axes)
+
+
+def _largest_divisor_at_most(n: int, cap: int) -> int:
+    for d in range(min(cap, n), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def node_sharding(mesh: Mesh, rank: int = 1) -> NamedSharding:
+    """Sharding for per-node arrays: axis 0 over ``nodes``, rest
+    replicated ([N], [N, N], [N, ...])."""
+    return NamedSharding(mesh, P("nodes", *([None] * (rank - 1))))
+
+
+def batch_sharding(mesh: Mesh, rank: int = 1) -> NamedSharding:
+    """Sharding for scenario-batched arrays: axis 0 over ``batch``."""
+    axis = "batch" if "batch" in mesh.axis_names else None
+    return NamedSharding(mesh, P(axis, *([None] * (rank - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,152 @@
+"""The sharded superstep: one full DGI round as a multi-chip program.
+
+This is the framework's "training step" — the composition the driver's
+``dryrun_multichip`` compiles over an ``n_devices`` mesh:
+
+    gm.form_groups  — [N, N] operators sharded by rows over ``nodes``
+    lb.lb_round     — per-node vectors sharded over ``nodes``
+    sc.collect      — group-masked reduction (GSPMD inserts the psum)
+    vvc gradient    — scenario-batched power flow + ``jax.grad`` sharded
+                      over ``batch``
+
+Sharding stance: inputs/outputs carry ``NamedSharding`` annotations and
+GSPMD places the collectives (the scaling-book recipe: pick a mesh,
+annotate, let XLA insert psum/all_gather); the explicitly-written
+collective variants of the hot reductions live in
+:mod:`freedm_tpu.parallel.collectives`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.grid.feeder import Feeder
+from freedm_tpu.modules import gm, lb, sc, vvc
+from freedm_tpu.parallel.mesh import batch_sharding, node_sharding, replicated
+from freedm_tpu.pf import ladder
+from freedm_tpu.utils import cplx
+from freedm_tpu.utils.cplx import C
+
+
+class FleetState(NamedTuple):
+    """Sharded per-round fleet state."""
+
+    alive: jax.Array  # [N] over nodes
+    reachable: jax.Array  # [N, N] rows over nodes
+    netgen: jax.Array  # [N] over nodes
+    gateway: jax.Array  # [N] over nodes
+    s_load: C  # [B, nb, 3] over batch: per-scenario feeder loads (kVA)
+    q_ctrl: jax.Array  # [B, nb, 3] over batch: VVC setpoints
+
+
+class SuperstepOut(NamedTuple):
+    state: FleetState
+    group: gm.GroupState
+    lb_out: lb.LBRound
+    collected: sc.CollectedState
+    vvc_loss: jax.Array  # [B] per-scenario losses after the VVC step
+
+
+def make_superstep(
+    mesh,
+    feeder: Feeder,
+    migration_step: float = 1.0,
+    vvc_config: vvc.VVCConfig = vvc.VVCConfig(),
+):
+    """Compile the sharded superstep for a mesh and feeder.
+
+    Returns ``(step, shard_state)``: ``step(state) -> SuperstepOut`` is
+    jitted with node/batch shardings; ``shard_state`` places a host
+    state onto the mesh.
+    """
+    vvc_step = vvc.make_vvc_controller(feeder, config=vvc_config)
+
+    n1 = node_sharding(mesh, 1)
+    n2 = node_sharding(mesh, 2)
+    b3 = batch_sharding(mesh, 3)
+    rep = replicated(mesh)
+
+    state_shardings = FleetState(
+        alive=n1,
+        reachable=n2,
+        netgen=n1,
+        gateway=n1,
+        s_load=C(b3, b3),
+        q_ctrl=b3,
+    )
+
+    group_shardings = gm.GroupState(
+        coordinator=n1, group_mask=n2, is_coordinator=n1, group_size=n1, n_groups=rep
+    )
+    lb_shardings = lb.LBRound(
+        state=n1,
+        gateway=n1,
+        matched=n2,
+        supply_step=n1,
+        demand_step=n1,
+        intransit=n1,
+        n_migrations=rep,
+    )
+    sc_shardings = sc.CollectedState(*([n1] * 7))
+    out_shardings = SuperstepOut(
+        state=state_shardings,
+        group=group_shardings,
+        lb_out=lb_shardings,
+        collected=sc_shardings,
+        vvc_loss=batch_sharding(mesh, 1),
+    )
+
+    @partial(jax.jit, out_shardings=out_shardings)
+    def step(state: FleetState) -> SuperstepOut:
+        group = gm.form_groups(state.alive, state.reachable)
+        lb_out = lb.lb_round(
+            state.netgen, state.gateway, group.group_mask, migration_step
+        )
+        zeros = jnp.zeros_like(state.gateway)
+        collected = sc.collect(
+            group.group_mask,
+            lb_out.gateway,
+            zeros,
+            zeros,
+            zeros,
+            zeros,
+            lb_out.intransit,
+        )
+        vvc_out = jax.vmap(lambda s, q: vvc_step(s, q))(state.s_load, state.q_ctrl)
+        new_state = state._replace(gateway=lb_out.gateway, q_ctrl=vvc_out.q_ctrl_kvar)
+        return SuperstepOut(
+            state=new_state,
+            group=group,
+            lb_out=lb_out,
+            collected=collected,
+            vvc_loss=vvc_out.loss_after_kw,
+        )
+
+    def shard_state(
+        netgen: np.ndarray,
+        gateway: np.ndarray,
+        scenario_scale: np.ndarray,
+        alive: Optional[np.ndarray] = None,
+        reachable: Optional[np.ndarray] = None,
+    ) -> FleetState:
+        n = len(netgen)
+        b = len(scenario_scale)
+        s = np.asarray(feeder.s_load)[None] * np.asarray(scenario_scale)[:, None, None]
+        state = FleetState(
+            alive=jnp.asarray(np.ones(n) if alive is None else alive, jnp.float32),
+            reachable=jnp.asarray(
+                np.ones((n, n)) if reachable is None else reachable, jnp.float32
+            ),
+            netgen=jnp.asarray(netgen, jnp.float32),
+            gateway=jnp.asarray(gateway, jnp.float32),
+            s_load=cplx.as_c(s, dtype=jnp.float32),
+            q_ctrl=jnp.zeros((b, feeder.n_branches, 3), jnp.float32),
+        )
+        return jax.device_put(state, state_shardings)
+
+    return step, shard_state

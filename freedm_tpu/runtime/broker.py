@@ -74,6 +74,10 @@ class Broker:
     def subscribe(self, recipient: str, module: DgiModule) -> None:
         """Extra subscription (SC listening on "lb"/"vvc",
         ``PosixMain.cpp:361,367``)."""
+        if module.name not in self._by_name:
+            raise ValueError(
+                f"module {module.name!r} must be registered before subscribing"
+            )
         self.dispatcher.register(
             recipient, module.name, lambda msg, m=module: m.handle_message(msg)
         )

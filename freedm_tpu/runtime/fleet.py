@@ -31,7 +31,7 @@ AYT-timeout → Recovery → re-election path.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Sequence
 
@@ -40,7 +40,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from freedm_tpu.core.config import OMEGA_NOMINAL, GlobalConfig, Timings
-from freedm_tpu.devices import tensor as dt
 from freedm_tpu.devices.manager import DeviceManager
 from freedm_tpu.modules import gm, lb, sc
 from freedm_tpu.runtime.broker import Broker

@@ -15,7 +15,7 @@ never message each other at all.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
 from freedm_tpu.runtime.messages import ModuleMessage
