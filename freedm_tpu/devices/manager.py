@@ -126,16 +126,21 @@ class DeviceManager:
         st = np.zeros((self.capacity, lay.n_signals), np_dtype)
         tid = np.full(self.capacity, -1, np.int32)
         alive = np.zeros(self.capacity, np_dtype)
+        # Hold the lock across the pump: a concurrent PnP-timeout
+        # remove_device + add_device can re-assign a freed row, and a
+        # stale slot list would write the departed device's state into a
+        # row now owned by a new device.  Adapters here are in-memory
+        # buffer reads (and must not call back into the manager), so
+        # holding the lock is cheap and safe.
         with self._lock:
-            slots = list(self._slots.values())
-        for s in slots:
-            if not s.adapter.revealed:
-                continue
-            ti = lay.type_ids[s.type_name]
-            tid[s.row] = ti
-            alive[s.row] = 1.0
-            for sig in lay.types[ti].states:
-                st[s.row, lay.signal_index(sig)] = s.adapter.get_state(s.name, sig)
+            for s in self._slots.values():
+                if not s.adapter.revealed:
+                    continue
+                ti = lay.type_ids[s.type_name]
+                tid[s.row] = ti
+                alive[s.row] = 1.0
+                for sig in lay.types[ti].states:
+                    st[s.row, lay.signal_index(sig)] = s.adapter.get_state(s.name, sig)
         return dt.DeviceTensor(
             state=jnp.asarray(st, dtype),
             command=jnp.full((self.capacity, lay.n_signals), NULL_COMMAND, dtype),
@@ -151,15 +156,15 @@ class DeviceManager:
         lay = self.layout
         cmd = np.asarray(t.command)
         written = 0
+        # Locked for the same slot-reassignment race as snapshot().
         with self._lock:
-            slots = list(self._slots.values())
-        for s in slots:
-            if not s.adapter.revealed:
-                continue
-            ti = lay.type_ids[s.type_name]
-            for sig in lay.types[ti].commands:
-                v = cmd[s.row, lay.signal_index(sig)]
-                if abs(v - NULL_COMMAND) > 0.5 and s.adapter.can_command(s.name, sig):
-                    s.adapter.set_command(s.name, sig, float(v))
-                    written += 1
+            for s in self._slots.values():
+                if not s.adapter.revealed:
+                    continue
+                ti = lay.type_ids[s.type_name]
+                for sig in lay.types[ti].commands:
+                    v = cmd[s.row, lay.signal_index(sig)]
+                    if abs(v - NULL_COMMAND) > 0.5 and s.adapter.can_command(s.name, sig):
+                        s.adapter.set_command(s.name, sig, float(v))
+                        written += 1
         return written

@@ -88,6 +88,18 @@ def parse_topology(source: Union[str, Path]) -> Topology:
         if parts[0] == "edge" and len(parts) == 3:
             edges.append((vert(parts[1]), vert(parts[2])))
         elif parts[0] == "fid" and len(parts) == 4:
+            # Duplicate fid declarations over one vertex pair would
+            # create two gate entries where an open state on one could
+            # be silently overridden by a closed state on the other —
+            # reject at parse time, like the reference loader.
+            pair = frozenset((parts[1], parts[2]))
+            if any(frozenset((a, b)) == pair for a, b, _ in fids):
+                raise ValueError(f"duplicate fid declaration: {raw!r}")
+            # Device names must be unique too: FID states are looked up
+            # by name, so one name on two edges would gate both with a
+            # single breaker's state.
+            if any(name == parts[3] for _, _, name in fids):
+                raise ValueError(f"duplicate fid device name: {raw!r}")
             fids.append((vert(parts[1]), vert(parts[2]), parts[3]))
         elif parts[0] == "sst" and len(parts) == 3:
             uuid = parts[2]

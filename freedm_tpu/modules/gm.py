@@ -86,8 +86,11 @@ def form_groups(
     ``reachable``: [N, N] 0/1 symmetric comm/physical reachability
     (e.g. from :func:`freedm_tpu.grid.topology.reachability`); the
     diagonal is implied.  Dead rows/columns are masked out.
-    ``priority``: [N] int election priority (default
-    :func:`node_priority`; must be unique and positive).
+    ``priority``: [N] election priority (default :func:`node_priority`).
+    Any magnitude is accepted — raw UUID hashes included — because the
+    values are rank-compressed to 1..N before propagating as float32
+    (uniqueness would otherwise only survive below 2^24); ties break by
+    node index.
 
     Label propagation with adjacency squaring: after ``ceil(log2 N)+1``
     rounds each live node's label is the maximum priority in its
@@ -102,6 +105,11 @@ def form_groups(
     alive_f = alive.astype(jnp.float32)
     if priority is None:
         priority = jnp.asarray(node_priority(n))
+    # Rank-compress to 1..N so labels stay exactly representable in
+    # float32 whatever the caller supplied (raw 32/64-bit UUID hashes
+    # would silently collide above 2^24); stable argsort breaks ties by
+    # node index.
+    priority = jnp.argsort(jnp.argsort(priority, stable=True), stable=True) + 1
     adj = reachable.astype(jnp.float32) * alive_f[:, None] * alive_f[None, :]
     adj = jnp.maximum(adj, jnp.eye(n) * alive_f)
     prio_f = priority.astype(jnp.float32) * alive_f  # dead -> 0 < any live prio

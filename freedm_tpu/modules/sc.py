@@ -20,7 +20,7 @@ channel recording.  The only genuinely distributed content left is:
   not yet applied to the plant are the reference's in-transit Accepts;
   LB maintains them as an integer array that the snapshot sums.
 
-The equivalence is property-tested in ``tests/test_sc.py``: for any
+The equivalence is property-tested in ``tests/test_gm_sc_lb.py``: for any
 interleaving of migrations, ``Σ gateways + in-transit = const`` — the
 invariant the reference's LB ``Synchronize`` relies on
 (``lb/LoadBalance.cpp:1160-1236``).

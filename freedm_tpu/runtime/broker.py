@@ -56,6 +56,8 @@ class Broker:
         self.round_index = 0
         self.shared: Dict[str, Any] = {}
         self._timers: List[Tuple[float, str, Callable[[], None]]] = []
+        self._timer_seq = 0
+        self._timer_owner: Dict[str, str] = {}
 
     # -- registration (CBroker::RegisterModule) ------------------------------
     def register_module(self, module: DgiModule, phase_time_ms: float) -> None:
@@ -95,16 +97,39 @@ class Broker:
         (ph.queue if this_round else ph.next_queue).append(task)
 
     def allocate_timer(self, module_name: str) -> str:
-        """Timers are keyed by module (CBroker::AllocateTimer)."""
+        """Return a fresh timer handle bound to a module's phase.
+
+        Distinct handles per call (CBroker::AllocateTimer parity) so one
+        module can hold several concurrent deadlines; the handle resolves
+        back to the owning module's phase queue when it fires.
+        """
         if module_name not in self._by_name:
             raise ValueError(f"unknown module {module_name!r}")
-        return module_name
+        self._timer_seq += 1
+        handle = f"{module_name}#{self._timer_seq}"
+        self._timer_owner[handle] = module_name
+        return handle
 
     def schedule_timer(self, timer: str, delay_s: float, task: Callable[[], None]) -> None:
         """Run ``task`` in the timer's module phase once ``delay_s``
         elapsed (fires at the first phase boundary past the deadline,
-        like the reference's timer→phase-queue hand-off)."""
+        like the reference's timer→phase-queue hand-off).
+
+        ``timer`` is a handle from :meth:`allocate_timer`; a bare module
+        name is accepted for backwards compatibility.
+        """
+        if self._timer_owner.get(timer, timer) not in self._by_name:
+            raise ValueError(f"unknown timer {timer!r}")
         self._timers.append((time.monotonic() + delay_s, timer, task))
+
+    def cancel_timers(self, timer: str) -> int:
+        """Drop all pending deadlines on a handle (CBroker timer
+        cancellation); returns how many were cancelled.  The handle is
+        released (allocate a new one to reuse)."""
+        before = len(self._timers)
+        self._timers = [t for t in self._timers if t[1] != timer]
+        self._timer_owner.pop(timer, None)
+        return before - len(self._timers)
 
     def deliver(self, msg: ModuleMessage) -> int:
         """Dispatch an incoming message (transport/loopback ingress)."""
@@ -121,8 +146,13 @@ class Broker:
         now = time.monotonic()
         due = [t for t in self._timers if t[0] <= now]
         self._timers = [t for t in self._timers if t[0] > now]
-        for _, module_name, task in due:
-            self.schedule(module_name, task, this_round=True)
+        pending = {t[1] for t in self._timers}
+        for _, handle, task in due:
+            self.schedule(self._timer_owner.get(handle, handle), task, this_round=True)
+            # Release fired handles with no further deadlines so
+            # per-deadline allocate_timer callers don't leak entries.
+            if handle not in pending:
+                self._timer_owner.pop(handle, None)
 
     def _align(self) -> None:
         """Wait for the next wall-clock round boundary (plus skew) when

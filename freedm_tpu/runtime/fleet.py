@@ -62,11 +62,16 @@ class Fleet:
         self,
         nodes: Sequence[NodeHandle],
         reachability=None,
+        fid_names: Optional[Sequence[str]] = None,
         migration_step: float = 1.0,
         malicious: Optional[np.ndarray] = None,
     ):
         self.nodes = list(nodes)
         self.reachability = reachability  # callable (fid_closed)->[N,N] or None
+        # Topology FID edge order (Topology.fid_names); fid_states() must
+        # emit states in exactly this order or reachability gates the
+        # wrong edges.
+        self.fid_names = tuple(fid_names) if fid_names is not None else None
         self.migration_step = migration_step
         self.malicious = (
             jnp.zeros(len(nodes)) if malicious is None else jnp.asarray(malicious)
@@ -127,13 +132,39 @@ class Fleet:
         }
 
     def fid_states(self) -> jnp.ndarray:
-        """Global FID closed/open vector in topology order (best effort:
-        FID devices named after topology fid_names)."""
-        out = []
+        """Global FID closed/open vector in **topology order**.
+
+        When the fleet was built with ``fid_names`` (from
+        ``Topology.fid_names``), each entry is looked up by device name
+        across all nodes, so the vector lines up with the topology's FID
+        edge order regardless of which node hosts which breaker — the
+        ordering contract ``CPhysicalTopology::ReachablePeers`` relies
+        on.  A topology FID with no live backing device reads 0 (open),
+        matching the reference's treatment of *unknown* FID state
+        (``CPhysicalTopology.cpp:92-169``: edges break unless the FID is
+        known-closed).
+
+        Without ``fid_names`` the states are concatenated in node/device
+        scan order — only unambiguous when there is at most one FID.
+        """
+        by_name: Dict[str, float] = {}
+        scan_order: List[float] = []
         for node in self.nodes:
             for f in node.manager.device_names("Fid"):
-                out.append(node.manager.get_state(f, "state"))
-        return jnp.asarray(out) if out else jnp.zeros(0)
+                # A dead node's breaker state is *unknown* → open (0),
+                # never skipped: the vector length must not change when
+                # a host dies mid-run.
+                state = node.manager.get_state(f, "state") if node.alive else 0.0
+                by_name[f] = state
+                scan_order.append(state)
+        if self.fid_names is None:
+            if len(scan_order) > 1:
+                raise ValueError(
+                    "multiple FID devices need Fleet(fid_names=topology.fid_names) "
+                    "to fix their order"
+                )
+            return jnp.asarray(scan_order) if scan_order else jnp.zeros(0)
+        return jnp.asarray([by_name.get(name, 0.0) for name in self.fid_names])
 
     # -- device egress -------------------------------------------------------
     def write_gateways(self, gateway: np.ndarray) -> None:

@@ -270,3 +270,26 @@ def test_snapshot_invariant_under_migrations():
             np.asarray(sc.invariant_total(cs)), np.full(n, before), atol=1e-5
         )
         gw = out.gateway
+
+
+def test_duplicate_fid_declaration_rejected():
+    with pytest.raises(ValueError, match="duplicate fid"):
+        topo_mod.parse_topology("edge a b\nfid a b F1\nfid b a F2\n")
+
+
+def test_single_line_raw_topology_parses():
+    # A marker-free one-liner is raw text, not a path (textio fix).
+    topo = topo_mod.parse_topology("edge a b")
+    assert topo.vertices == ("a", "b")
+
+
+def test_form_groups_with_raw_hash_priorities():
+    # Raw 32-bit UUID-hash magnitudes must not collide in float32: the
+    # kernel rank-compresses internally.
+    n = 6
+    base = np.uint64(2**31)
+    prio = jnp.asarray((base + np.arange(n, dtype=np.uint64) * 3).astype(np.float64))
+    g = gm.form_groups(jnp.ones(n), jnp.ones((n, n)), prio)
+    # Highest raw priority (last index) coordinates the single group.
+    assert int(g.n_groups) == 1
+    assert np.asarray(g.coordinator).tolist() == [n - 1] * n

@@ -4,6 +4,8 @@ deployment with fake devices, here one fleet program over a shared
 JAX plant).
 """
 
+import time
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -194,3 +196,41 @@ def test_malicious_node_detected_by_ledger(three_node_fleet):
     # The cut's conserved total differs from the raw gateway sum by the
     # unapplied quanta — the discrepancy SC exists to surface.
     assert float(jnp.sum(out.intransit)) < 0.0
+
+
+def test_allocate_timer_distinct_handles():
+    broker = Broker()
+    rec = Recorder("m")
+    broker.register_module(rec, 10)
+    t1 = broker.allocate_timer("m")
+    t2 = broker.allocate_timer("m")
+    assert t1 != t2
+    fired = []
+    broker.schedule_timer(t1, 0.0, lambda: fired.append("a"))
+    broker.schedule_timer(t2, 0.0, lambda: fired.append("b"))
+    assert broker.cancel_timers(t2) == 1
+    time.sleep(0.01)
+    broker.run(n_rounds=1)
+    assert fired == ["a"]
+
+
+def test_fleet_fid_states_topology_order(three_node_fleet):
+    fleet, plant = three_node_fleet
+    # Give nodes FID devices named like topology fid edges, registered in
+    # an order that disagrees with topology order.
+    from freedm_tpu.devices.adapters.fake import FakeAdapter
+
+    fake = FakeAdapter()
+    fleet.nodes[2].manager.add_device("FID_Z", "Fid", fake)
+    fleet.nodes[0].manager.add_device("FID_A", "Fid", fake)
+    fake.reveal_devices()
+    fake.set_state("FID_Z", "state", 0.0)
+    fake.set_state("FID_A", "state", 1.0)
+    fleet.fid_names = ("FID_A", "FID_Z", "FID_MISSING")
+    states = np.asarray(fleet.fid_states())
+    # Topology order, with the unbacked FID defaulting to 0/open.
+    np.testing.assert_allclose(states, [1.0, 0.0, 0.0])
+    # Without fid_names, >1 FID is ambiguous and must raise.
+    fleet.fid_names = None
+    with pytest.raises(ValueError, match="fid_names"):
+        fleet.fid_states()
