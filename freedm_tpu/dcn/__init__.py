@@ -1,0 +1,35 @@
+"""DCN boundary transport: reliable-enough messaging off the mesh.
+
+On-mesh (intra-slice) traffic never touches this package — group sums,
+elections, and snapshots ride XLA collectives over ICI
+(:mod:`freedm_tpu.parallel`).  This package is the *external* edge the
+reference built its whole stack on (``CProtocolSR`` / ``CListener`` /
+``CConnectionManager``): hardware-in-the-loop rigs, co-simulators, and
+federated slices linked over ordinary networks, where messages must
+expire rather than arrive stale and loss must be survivable.
+
+- :mod:`freedm_tpu.dcn.wire` — datagram window format;
+- :mod:`freedm_tpu.dcn.protocol` — the sans-IO SR state machine
+  (seq/ack/resend/TTL/kill/stale semantics);
+- :mod:`freedm_tpu.dcn.endpoint` — threaded UDP endpoint + loss
+  injection (CUSTOMNETWORK/network.xml parity).
+"""
+
+from freedm_tpu.dcn.endpoint import UdpEndpoint, load_network_config
+from freedm_tpu.dcn.protocol import (
+    MAX_DROPPED_MSGS,
+    SEQUENCE_MODULO,
+    SrChannel,
+)
+from freedm_tpu.dcn.wire import Frame, decode_window, encode_window
+
+__all__ = [
+    "Frame",
+    "MAX_DROPPED_MSGS",
+    "SEQUENCE_MODULO",
+    "SrChannel",
+    "UdpEndpoint",
+    "decode_window",
+    "encode_window",
+    "load_network_config",
+]

@@ -1,0 +1,205 @@
+"""Threaded UDP endpoint: one socket per process, SR channel per peer.
+
+Reference: ``CListener`` (single UDP ingress socket, auto-registration
+of unknown senders, ``Broker/src/CListener.cpp:127-191``) +
+``CConnectionManager`` (uuid→channel registry, ``network.xml``
+reliability injection under CUSTOMNETWORK,
+``CConnectionManager.cpp:185-318``) + the blocking socket write of
+``IProtocol::Write`` (``IProtocol.cpp:74-120``).
+
+One background thread owns the socket: it drains datagrams into the
+per-peer :class:`~freedm_tpu.dcn.protocol.SrChannel` state machines,
+delivers accepted messages to the sink (usually ``Broker.deliver``),
+and runs every channel's resend clock.  ``transport_for(uuid)`` returns
+a callable matching :data:`freedm_tpu.runtime.peers.Transport`, so a
+remote peer plugs into ``PeerList.add(uuid, transport)`` exactly like a
+loopback one.
+
+Loss injection (CUSTOMNETWORK parity): each channel carries an outgoing
+``reliability`` percentage — datagrams roll a die before hitting the
+socket (``IProtocol.cpp:94-101``) — and the endpoint an incoming one;
+:func:`load_network_config` applies a ``network.xml``.  The RNG is
+seedable so failure tests are reproducible.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple, Union
+
+import numpy as np
+
+from freedm_tpu.dcn import wire
+from freedm_tpu.dcn.protocol import SrChannel
+from freedm_tpu.runtime.messages import ModuleMessage
+from freedm_tpu.utils.textio import read_source
+
+MessageSink = Callable[[ModuleMessage], None]
+
+
+@dataclass
+class _PeerState:
+    channel: SrChannel
+    addr: Optional[Tuple[str, int]]  # None until learned from ingress
+    reliability: int = 100  # outgoing delivery %, CUSTOMNETWORK
+
+
+class UdpEndpoint:
+    """The process's DCN socket + channel registry."""
+
+    def __init__(
+        self,
+        uuid: str,
+        bind: Tuple[str, int] = ("127.0.0.1", 0),
+        sink: Optional[MessageSink] = None,
+        resend_time_s: float = 0.060,
+        ttl_s: float = 4.100,
+        incoming_reliability: int = 100,
+        seed: Optional[int] = None,
+    ):
+        self.uuid = uuid
+        self.sink = sink
+        self.resend_time_s = resend_time_s
+        self.ttl_s = ttl_s
+        self.incoming_reliability = incoming_reliability
+        self._rng = np.random.default_rng(seed)
+        self._peers: Dict[str, _PeerState] = {}
+        self._lock = threading.RLock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(bind)
+        self._sock.settimeout(resend_time_s / 2)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._sock.getsockname()
+
+    # -- registry (CConnectionManager::PutHost / GetConnectionByUUID) --------
+    def connect(
+        self,
+        uuid: str,
+        addr: Optional[Tuple[str, int]] = None,
+        reliability: int = 100,
+    ) -> SrChannel:
+        with self._lock:
+            st = self._peers.get(uuid)
+            if st is None:
+                st = _PeerState(
+                    SrChannel(uuid, self.resend_time_s, self.ttl_s), addr, reliability
+                )
+                self._peers[uuid] = st
+            else:
+                if addr is not None:
+                    st.addr = addr
+                st.reliability = reliability
+            return st.channel
+
+    def transport_for(self, uuid: str) -> Callable[[str, ModuleMessage], None]:
+        """A :data:`~freedm_tpu.runtime.peers.Transport` for PeerList."""
+        if uuid not in self._peers:
+            raise KeyError(f"unknown peer {uuid!r}; connect() it first")
+
+        def transport(peer_uuid: str, msg: ModuleMessage) -> None:
+            self.send(peer_uuid, msg)
+
+        return transport
+
+    def send(self, uuid: str, msg: ModuleMessage) -> None:
+        now = time.monotonic()
+        with self._lock:
+            st = self._peers[uuid]
+            st.channel.send(msg, now)
+            self._flush(st, now)
+
+    def channel(self, uuid: str) -> SrChannel:
+        return self._peers[uuid].channel
+
+    # -- the pump ------------------------------------------------------------
+    def start(self) -> "UdpEndpoint":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        self._sock.close()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, addr = self._sock.recvfrom(wire.MAX_PACKET_SIZE)
+                self._on_datagram(data, addr)
+            except socket.timeout:
+                pass
+            except OSError:
+                break
+            except Exception:  # the pump must outlive a bad sink/frame
+                logging.getLogger(__name__).exception("dcn pump error")
+            try:
+                now = time.monotonic()
+                with self._lock:
+                    for st in self._peers.values():
+                        self._flush(st, now)
+            except Exception:
+                logging.getLogger(__name__).exception("dcn flush error")
+
+    def _on_datagram(self, data: bytes, addr: Tuple[str, int]) -> None:
+        if self.incoming_reliability < 100 and (
+            self._rng.integers(100) >= self.incoming_reliability
+        ):
+            return  # CListener.cpp:147-154 ingress drop
+        try:
+            src, _sent, frames = wire.decode_window(data)
+        except ValueError:
+            return  # malformed datagrams are dropped, not fatal
+        now = time.monotonic()
+        with self._lock:
+            st = self._peers.get(src)
+            if st is None:
+                # Auto-register unknown senders (CListener.cpp:139-166).
+                st = _PeerState(SrChannel(src, self.resend_time_s, self.ttl_s), addr)
+                self._peers[src] = st
+            elif st.addr is None:
+                st.addr = addr
+            accepted = st.channel.on_frames(frames, now)
+            self._flush(st, now)  # OnReceive: flush window + acks
+        for m in accepted:
+            if self.sink is not None:
+                self.sink(m)
+
+    def _flush(self, st: _PeerState, now: float) -> None:
+        frames = st.channel.poll(now)
+        if not frames or st.addr is None:
+            return
+        for datagram in wire.encode_windows(self.uuid, frames, time.time()):
+            if st.reliability < 100 and self._rng.integers(100) >= st.reliability:
+                continue  # IProtocol.cpp:94-101 outgoing drop
+            try:
+                self._sock.sendto(datagram, st.addr)
+            except OSError:
+                pass  # unreachable peers retry on the resend clock
+
+
+def load_network_config(endpoint: UdpEndpoint, source: Union[str, os.PathLike]) -> None:
+    """Apply a ``network.xml`` reliability config
+    (``CConnectionManager::LoadNetworkConfig``,
+    ``CConnectionManager.cpp:304-318``): per-peer outgoing percentages
+    and the endpoint-wide incoming percentage."""
+    root = ET.fromstring(read_source(source, "<"))
+    inc = root.find("incoming/reliability")
+    if inc is not None and inc.text:
+        endpoint.incoming_reliability = int(inc.text)
+    for ch in root.findall("outgoing/channel"):
+        uuid = ch.get("uuid")
+        rel = ch.find("reliability")
+        if uuid and rel is not None and rel.text and uuid in endpoint._peers:
+            endpoint._peers[uuid].reliability = int(rel.text)

@@ -1,0 +1,279 @@
+"""Send-reliable (SR) channel: expiring at-most-once ordered delivery.
+
+This is the framework's DCN transport protocol — the layer that carries
+control messages across machine boundaries (HIL rigs, co-simulators,
+federated slices) where XLA collectives don't reach.  Semantics match
+the reference's ``CProtocolSR`` (``Broker/src/CProtocolSR.cpp:95-446``):
+
+- every message gets a sequence number mod ``SEQUENCE_MODULO`` and a
+  content hash; the receiver accepts in order and ACKs by (seq, hash);
+- unACKed messages resend every ``resend_time_s`` until their TTL
+  (``CSRC_DEFAULT_TIMEOUT``) passes — *stale control data is meant to
+  die*, not arrive late (the real-time semantics the whole DGI relies
+  on);
+- when the sender expires a message it tells the receiver via a **kill
+  number** (last sequence the receiver is known to have accepted) so
+  the receiver can skip the gap (``Receive`` case 8);
+- ``MAX_DROPPED_MSGS`` consecutive expirations declare the connection
+  stale and force a reconnect (SYN resync), like the reference's
+  ``Stop()`` + reconnect path;
+- sequence resync (SYN / ``CREATED`` frames) bootstraps a connection
+  and recovers from wraps; an unsynced receiver answers ``BAD_REQUEST``
+  so the sender knows to SYN (``Receive`` cases 1-4).
+
+Deliberately **sans-IO** (unlike the reference's timer-callback weave):
+the state machine consumes frames and a clock, and emits frames — so
+the protocol's 8-case accept logic is property-testable with simulated
+loss/reorder/duplication, and the same core runs under the threaded UDP
+endpoint (:mod:`freedm_tpu.dcn.endpoint`) or any future carrier.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Optional
+
+from freedm_tpu.dcn import wire
+from freedm_tpu.dcn.wire import ACCEPTED, BAD_REQUEST, CREATED, MESSAGE, Frame
+from freedm_tpu.runtime.messages import ModuleMessage
+
+# CProtocolSR.hpp:91-95.
+SEQUENCE_MODULO = 1024
+MAX_DROPPED_MSGS = 3
+
+# timings.cfg CSRC_RESEND_TIME / CSRC_DEFAULT_TIMEOUT (ms -> s).
+DEFAULT_RESEND_S = 0.060
+DEFAULT_TTL_S = 4.100
+
+
+class SrChannel:
+    """One direction-pair of the SR protocol with a single peer."""
+
+    def __init__(
+        self,
+        uuid: str,
+        resend_time_s: float = DEFAULT_RESEND_S,
+        ttl_s: float = DEFAULT_TTL_S,
+    ):
+        self.uuid = uuid  # the peer
+        self.resend_time_s = resend_time_s
+        self.ttl_s = ttl_s
+        # Outbound (sender role).
+        self._out_seq = 0
+        self._out_window: Deque[Frame] = deque()
+        self._out_synced = False
+        self._out_sync_hash: Optional[str] = None  # last BAD_REQUEST honored
+        self._send_kill = 0
+        self._send_kills = False
+        self._dropped = 0
+        self._next_resend = 0.0
+        # Inbound (receiver role).
+        self._in_seq = 0
+        self._in_sync = False
+        self._in_sync_time: Optional[float] = None
+        self._in_resyncs = 0
+        self._ack_window: List[Frame] = []
+        self._reply_frames: List[Frame] = []
+        # Stats.
+        self.reconnects = 0
+        self.sent = 0
+        self.accepted = 0
+        self.expired = 0
+
+    # -- sender side ---------------------------------------------------------
+    def send(self, msg: ModuleMessage, now: float) -> None:
+        """Queue a message (CProtocolSR::Send): SYN-first when unsynced,
+        assign seq + hash, stamp TTL."""
+        if not self._out_synced:
+            self._push_syn(now)
+        # The frame TTL governs on-wire life on the channel's clock;
+        # end-to-end ModuleMessage.expire_time is wall-clock and is
+        # enforced at dispatch (Dispatcher drops expired messages).
+        frame = Frame(
+            status=MESSAGE,
+            seq=self._take_seq(),
+            hash=msg.hash(),
+            expire=now + self.ttl_s,
+            msg=wire.pack_message(msg),
+        )
+        # Oversize messages fail loudly at the caller, not later in the
+        # pump thread (IProtocol::Write's too-long throw).
+        wire.encode_window(self.uuid, [frame], now)
+        self._out_window.append(frame)
+        self.sent += 1
+        self._next_resend = now  # fire immediately on next poll
+
+    def _take_seq(self) -> int:
+        seq = self._out_seq
+        self._out_seq = (self._out_seq + 1) % SEQUENCE_MODULO
+        return seq
+
+    def _push_syn(self, now: float) -> None:
+        """Insert a SYN at the window front (CProtocolSR::SendSYN)."""
+        if self._out_window and self._out_window[0].status == CREATED:
+            return
+        if not self._out_window:
+            seq = self._take_seq()
+        else:
+            seq = (self._out_window[0].seq - 1) % SEQUENCE_MODULO
+        self._out_window.appendleft(
+            Frame(status=CREATED, seq=seq, expire=now + self.ttl_s, sync_time=now)
+        )
+        self._out_synced = True
+
+    def poll(self, now: float) -> List[Frame]:
+        """The resend timer body (CProtocolSR::Resend): flush expired
+        messages, arm kill numbers, declare staleness, and return the
+        frames to put on the wire (window + pending ACKs).
+        """
+        if now < self._next_resend and not self._ack_window and not self._reply_frames:
+            return []
+        todrop = 0
+        if self._out_window and self._out_window[0].status == CREATED:
+            # A SYN is in flight: count (but keep) expired messages
+            # behind it.
+            todrop = sum(1 for f in list(self._out_window)[1:] if f.expired(now))
+        else:
+            while (
+                self._out_window
+                and self._out_window[0].status != CREATED
+                and self._out_window[0].expired(now)
+            ):
+                self._out_window.popleft()
+                self._send_kills = True
+                self._dropped += 1
+                self.expired += 1
+        if self._dropped > MAX_DROPPED_MSGS or todrop > MAX_DROPPED_MSGS:
+            # Stale connection: reconnect with a fresh sync instead of
+            # the reference's Stop()-and-recreate.
+            self._reconnect(now)
+        if self._out_window:
+            if self._send_kills and self._send_kill > self._out_window[0].seq:
+                # Expiration wrapped the sequence space: resync instead
+                # of sending a kill the comparison logic can't order.
+                self._send_kills = False
+                self._send_kill = 0
+                self._push_syn(now)
+            self._out_window[0].kill = self._send_kill if self._send_kills else None
+        if now >= self._next_resend:
+            self._next_resend = now + self.resend_time_s
+        out = list(self._out_window) + self._ack_window + self._reply_frames
+        self._ack_window = []
+        self._reply_frames = []
+        return out
+
+    def _reconnect(self, now: float) -> None:
+        """Tear down and resync (the reference's Stop()-and-recreate,
+        minus losing the still-live queued messages): drop any stale SYN
+        so the replacement carries a *fresh* sync stamp, flush expired
+        frames, and SYN again."""
+        self._dropped = 0
+        self.reconnects += 1
+        if self._out_window and self._out_window[0].status == CREATED:
+            self._out_window.popleft()
+        while self._out_window and self._out_window[0].expired(now):
+            self._out_window.popleft()
+            self.expired += 1
+        self._out_synced = False
+        if self._out_window:
+            self._push_syn(now)
+
+    # -- receiver side -------------------------------------------------------
+    def on_frames(self, frames: List[Frame], now: float) -> List[ModuleMessage]:
+        """Process an incoming window; return messages accepted for
+        dispatch, in order, each exactly once."""
+        out: List[ModuleMessage] = []
+        for f in frames:
+            if f.status == ACCEPTED:
+                self._receive_ack(f)
+            elif self._receive(f, now) and f.msg is not None:
+                out.append(wire.unpack_message(f.msg))
+                self.accepted += 1
+        return out
+
+    def _receive_ack(self, f: Frame) -> None:
+        """CProtocolSR::ReceiveACK — pop the window head on seq+hash match."""
+        if not self._out_window:
+            return
+        head = self._out_window[0]
+        if head.seq == f.seq and head.hash == f.hash:
+            self._send_kill = head.seq
+            self._out_window.popleft()
+            self._send_kills = False
+            self._dropped = 0
+
+    def _receive(self, f: Frame, now: float) -> bool:
+        """CProtocolSR::Receive — the 8-case accept logic."""
+        if f.status == BAD_REQUEST:
+            # Case 1: peer lost sync with us; SYN unless already syncing
+            # or we already honored this exact request.
+            head_created = bool(self._out_window) and self._out_window[0].status == CREATED
+            if not head_created and f.hash != self._out_sync_hash:
+                self._out_sync_hash = f.hash
+                self._push_syn(now)
+            return False
+        if f.status == CREATED:
+            # Cases 2-3: SYN, first time vs duplicate (identified by the
+            # sender's sync stamp).  Duplicates are re-ACKed: a lost
+            # SYN-ACK must not leave the sender's CREATED head wedged at
+            # the window front forever (the reference instead tears the
+            # whole connection down via Stop(); re-ACKing recovers
+            # without losing the queued window).
+            if f.sync_time is not None and f.sync_time == self._in_sync_time:
+                self._queue_ack(f)
+                return False
+            self._in_seq = (f.seq + 1) % SEQUENCE_MODULO
+            self._in_sync_time = f.sync_time
+            self._in_resyncs += 1
+            self._in_sync = True
+            self._queue_ack(f)
+            return False
+        if not self._in_sync:
+            # Case 4: message before sync — ask the sender to SYN.
+            self._reply_frames.append(
+                Frame(
+                    status=BAD_REQUEST,
+                    seq=self._in_resyncs % SEQUENCE_MODULO,
+                    hash=f.hash,
+                )
+            )
+            return False
+        if f.status == MESSAGE:
+            if not f.hash:
+                return False  # this protocol NEEDS hashes
+            if f.seq == self._in_seq:
+                # Case 5: exactly the expected message.
+                self._in_seq = (self._in_seq + 1) % SEQUENCE_MODULO
+                self._queue_ack(f)
+                return True
+            if f.kill is not None and f.kill < self._in_seq and f.seq > self._in_seq:
+                # Case 8: the gap ahead of us expired at the sender —
+                # skip it.  (Case 6, kill >= expected: out-of-order kill,
+                # reject; case 7, seq < expected: old duplicate, reject.)
+                self._in_seq = (f.seq + 1) % SEQUENCE_MODULO
+                self._queue_ack(f)
+                return True
+            if f.seq < self._in_seq or f.kill is not None:
+                # Cases 6-7 + plain duplicates: re-ACK duplicates so a
+                # lost ACK doesn't wedge the sender's window head.
+                if f.seq < self._in_seq:
+                    self._queue_ack(f)
+                return False
+            return False
+        return False
+
+    def _queue_ack(self, f: Frame) -> None:
+        """CProtocolSR::SendACK — ACKs echo seq/hash/expire and ride the
+        next wire flush."""
+        self._ack_window.append(
+            Frame(status=ACCEPTED, seq=f.seq, hash=f.hash, expire=f.expire)
+        )
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def outstanding(self) -> int:
+        return len(self._out_window)
+
+    @property
+    def synced(self) -> bool:
+        return self._in_sync

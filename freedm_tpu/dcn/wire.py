@@ -1,0 +1,121 @@
+"""DCN wire format: bundled protocol frames in one datagram.
+
+Reference: ``ProtocolMessage`` / ``ProtocolMessageWindow``
+(``Broker/src/messages/ProtocolMessage.proto:25-49``) — each datagram
+carries the sender uuid, a send-time stamp, and a window of frames, each
+frame being a status (MESSAGE / ACCEPTED / CREATED / BAD_REQUEST), a
+sequence number, a content hash, an optional kill number, an expiration
+stamp, and (for MESSAGE) the embedded module message.
+
+The encoding here is canonical JSON inside a fixed header — small,
+debuggable, and language-neutral (the C++ runtime codec in
+``native/`` speaks the same format).  Datagrams are capped at
+``MAX_PACKET_SIZE`` like the reference (``CGlobalConfiguration.hpp:108``,
+``IProtocol.cpp:87-92``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from freedm_tpu.runtime.messages import ModuleMessage
+
+# Frame statuses (ProtocolMessage.Status).
+MESSAGE = "MESSAGE"
+ACCEPTED = "ACCEPTED"  # an ACK
+CREATED = "CREATED"  # a SYN
+BAD_REQUEST = "BAD_REQUEST"
+
+# CGlobalConfiguration::MAX_PACKET_SIZE = SHRT_MAX.
+MAX_PACKET_SIZE = 32767
+
+
+@dataclass
+class Frame:
+    """One protocol frame within a datagram window."""
+
+    status: str
+    seq: int
+    hash: str = ""
+    kill: Optional[int] = None
+    expire: Optional[float] = None  # unix seconds
+    sync_time: Optional[float] = None  # SYN identity (duplicate detection)
+    msg: Optional[Dict[str, Any]] = None  # serialized ModuleMessage
+
+    def expired(self, now: float) -> bool:
+        return self.expire is not None and now > self.expire
+
+
+def pack_message(m: ModuleMessage) -> Dict[str, Any]:
+    return {
+        "recipient_module": m.recipient_module,
+        "type": m.type,
+        "payload": m.payload,
+        "source": m.source,
+        "send_time": m.send_time,
+        "expire_time": m.expire_time,
+    }
+
+
+def unpack_message(d: Dict[str, Any]) -> ModuleMessage:
+    return ModuleMessage(
+        recipient_module=d["recipient_module"],
+        type=d["type"],
+        payload=d.get("payload", {}),
+        source=d.get("source", ""),
+        send_time=d.get("send_time"),
+        expire_time=d.get("expire_time"),
+    )
+
+
+def encode_window(source_uuid: str, frames: List[Frame], send_time: float) -> bytes:
+    """Serialize a window datagram (``IProtocol::Write`` stamping:
+    source uuid + send time on the window, size check)."""
+    blob = json.dumps(
+        {
+            "src": source_uuid,
+            "sent": send_time,
+            "frames": [asdict(f) for f in frames],
+        },
+        separators=(",", ":"),
+    ).encode()
+    if len(blob) > MAX_PACKET_SIZE:
+        raise ValueError(f"datagram too long: {len(blob)} > {MAX_PACKET_SIZE}")
+    return blob
+
+
+def encode_windows(
+    source_uuid: str, frames: List[Frame], send_time: float
+) -> List[bytes]:
+    """Greedily split ``frames`` into as many datagrams as the size cap
+    requires (the reference fills one packet per write; an unACKed
+    backlog larger than one packet must chunk, not crash the pump)."""
+    out: List[bytes] = []
+    batch: List[Frame] = []
+    size = _EMPTY_OVERHEAD + len(source_uuid)
+    for f in frames:
+        fsize = len(json.dumps(asdict(f), separators=(",", ":")).encode()) + 1
+        if batch and size + fsize > MAX_PACKET_SIZE:
+            out.append(encode_window(source_uuid, batch, send_time))
+            batch, size = [], _EMPTY_OVERHEAD + len(source_uuid)
+        batch.append(f)
+        size += fsize
+    if batch:
+        out.append(encode_window(source_uuid, batch, send_time))
+    return out
+
+
+# json envelope bytes around the frame list (measured generously).
+_EMPTY_OVERHEAD = 64
+
+
+def decode_window(data: bytes) -> Tuple[str, float, List[Frame]]:
+    """Parse a datagram; raises ``ValueError`` on malformed input."""
+    try:
+        obj = json.loads(data.decode())
+        frames = [Frame(**f) for f in obj["frames"]]
+        return str(obj["src"]), float(obj["sent"]), frames
+    except (KeyError, TypeError, UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"malformed datagram: {e}") from e
