@@ -34,6 +34,11 @@ class Adapter(ABC):
     RevealDevices, ``IAdapter.cpp``).
     """
 
+    #: Transport adapters that reveal on their own handshake (e.g. the
+    #: RTDS buffer-initialization) set this so the factory leaves them
+    #: hidden at create time.
+    defer_reveal = False
+
     def __init__(self) -> None:
         self._devices: List[str] = []
         self._revealed = False
@@ -90,6 +95,7 @@ class BufferAdapter(Adapter):
         self._state_index: Dict[Tuple[str, str], int] = {}
         self._command_index: Dict[Tuple[str, str], int] = {}
         self._lock = threading.Lock()
+        self._finalized = False
         self._state: np.ndarray = np.zeros(0, np.float32)
         self._command: np.ndarray = np.zeros(0, np.float32)
 
@@ -101,16 +107,19 @@ class BufferAdapter(Adapter):
         self._command_index[(device, signal)] = index
 
     def finalize_bindings(self) -> None:
-        """Size the buffers once all entries are bound.
+        """Size the buffers once all entries are bound (idempotent).
 
         Indices must form a dense 0..n-1 range per buffer, like the
         reference's 1-based ``<entry index>`` checked by CAdapterFactory.
         """
+        if self._finalized:
+            return
         for name, idx in (("state", self._state_index), ("command", self._command_index)):
             if idx and sorted(idx.values()) != list(range(len(idx))):
                 raise ValueError(f"{name} entry indices are not dense 0..{len(idx) - 1}")
         self._state = np.zeros(len(self._state_index), np.float32)
         self._command = np.full(len(self._command_index), NULL_COMMAND, np.float32)
+        self._finalized = True
 
     # -- transport side -----------------------------------------------------
     def swap_state(self, new_state: np.ndarray) -> np.ndarray:
@@ -121,6 +130,20 @@ class BufferAdapter(Adapter):
                 raise ValueError("state buffer size mismatch")
             self._state = np.asarray(new_state, np.float32).copy()
             return self._command.copy()
+
+    def command_buffer(self) -> np.ndarray:
+        """Copy of the command staging buffer (send-first transports:
+        the RTDS exchange transmits commands *before* reading states,
+        ``CRtdsAdapter::Run``)."""
+        with self._lock:
+            return self._command.copy()
+
+    def install_state(self, new_state: np.ndarray) -> None:
+        """Install a received state buffer without touching commands."""
+        with self._lock:
+            if np.shape(new_state) != self._state.shape:
+                raise ValueError("state buffer size mismatch")
+            self._state = np.asarray(new_state, np.float32).copy()
 
     # -- manager side -------------------------------------------------------
     def get_state(self, device: str, signal: str) -> float:

@@ -1,0 +1,133 @@
+"""RTDS lock-step adapter: synchronous buffer exchange over TCP.
+
+Reference: ``CRtdsAdapter`` (``Broker/src/device/CRtdsAdapter.cpp:120-230``)
+— the hardware-in-the-loop path.  Every ``DEV_RTDS_DELAY`` (50 ms) the
+adapter sends its whole command buffer to the simulator/FPGA and then
+blocking-reads the whole state buffer back, both as 4-byte big-endian
+floats with a ``DEV_SOCKET_TIMEOUT`` deadline; the simulator does the
+reverse (read, then write), producing lock-step synchronous exchange.
+Devices stay hidden until the first state buffer arrives with no
+``NULL_COMMAND`` sentinels left (the simulator-side initialization
+handshake).
+
+TPU-native difference: the exchange runs on its own thread against the
+:class:`~freedm_tpu.devices.adapters.base.BufferAdapter` staging
+buffers, so the device superstep never blocks on the socket — the
+manager pumps whatever state was installed last (the double-buffered
+host staging of SURVEY.md §7 hard part iv).  A socket failure marks the
+adapter errored instead of killing the process; the manager sees the
+last good state and the failure detector sees ``error``.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from freedm_tpu.core.config import NULL_COMMAND
+from freedm_tpu.devices.adapters.base import BufferAdapter
+
+# timings.cfg DEV_RTDS_DELAY / DEV_SOCKET_TIMEOUT (ms → s).
+DEFAULT_POLL_S = 0.050
+DEFAULT_SOCKET_TIMEOUT_S = 1.000
+
+# The wire dtype: 4-byte float, network (big-endian) byte order —
+# CRtdsAdapter asserts sizeof(SignalValue)==4 and endian-swaps on
+# little-endian hosts (CRtdsAdapter.cpp:61, EndianSwapIfNeeded).
+WIRE_DTYPE = ">f4"
+
+
+def read_exactly(sock: socket.socket, n: int) -> bytes:
+    """Blocking read of exactly ``n`` bytes (SynchronousTimeout's
+    TimedRead: the socket's timeout bounds each recv)."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise ConnectionError("peer closed during buffer exchange")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+class RtdsAdapter(BufferAdapter):
+    """Lock-step TCP exchange against an RTDS-protocol server."""
+
+    defer_reveal = True  # reveal on first initialized state buffer
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        poll_s: float = DEFAULT_POLL_S,
+        socket_timeout_s: float = DEFAULT_SOCKET_TIMEOUT_S,
+        on_error: Optional[Callable[[Exception], None]] = None,
+    ) -> None:
+        super().__init__()
+        self.host = host
+        self.port = port
+        self.poll_s = poll_s
+        self.socket_timeout_s = socket_timeout_s
+        self.on_error = on_error
+        self.error: Optional[Exception] = None
+        self.exchanges = 0
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        """Connect and begin the periodic exchange (CRtdsAdapter::Start)."""
+        self.finalize_bindings()
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.socket_timeout_s
+        )
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0 + self.socket_timeout_s)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    # -- the engine (CRtdsAdapter::Run) --------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            began = time.monotonic()
+            try:
+                self._exchange()
+            except Exception as e:  # socket death ends the pump, not the process
+                self.error = e
+                if self.on_error is not None:
+                    self.on_error(e)
+                return
+            self.exchanges += 1
+            remaining = self.poll_s - (time.monotonic() - began)
+            if remaining > 0:
+                self._stop.wait(remaining)
+
+    def _exchange(self) -> None:
+        assert self._sock is not None
+        # Always send data to the simulator first...
+        if self.command_size:
+            tx = self.command_buffer().astype(WIRE_DTYPE)
+            self._sock.sendall(tx.tobytes())
+        # ...then block for the full state buffer.
+        if self.state_size:
+            raw = read_exactly(self._sock, self.state_size * 4)
+            rx = np.frombuffer(raw, WIRE_DTYPE).astype(np.float32)
+            self.install_state(rx)
+            if not self.revealed and not np.any(rx == np.float32(NULL_COMMAND)):
+                # First fully-initialized state: devices go live
+                # (CRtdsAdapter.cpp buffer_initialized → RevealDevices).
+                self.reveal_devices()

@@ -114,6 +114,7 @@ class AdapterFactory:
         self.adapters: Dict[str, Adapter] = {}
         self._registry: Dict[str, AdapterCtor] = {}
         self.register_type("fake", _make_fake)
+        self.register_type("rtds", _make_rtds)
 
     def register_type(self, type_name: str, ctor: AdapterCtor) -> None:
         self._registry[type_name] = ctor
@@ -147,7 +148,8 @@ class AdapterFactory:
                     adapter.bind_command(e.device, e.signal, e.index)
                 adapter.finalize_bindings()
                 self._check_state_coverage(spec, adapter)
-            adapter.reveal_devices()
+            if not adapter.defer_reveal:
+                adapter.reveal_devices()
         except Exception:
             # Roll back partial registration so a corrected spec can
             # retry without phantom "duplicate device" errors.
@@ -189,3 +191,20 @@ class AdapterFactory:
 
 def _make_fake(spec: AdapterSpec, manager: DeviceManager) -> Adapter:
     return FakeAdapter()
+
+
+def _make_rtds(spec: AdapterSpec, manager: DeviceManager) -> Adapter:
+    """rtds adapter from ``<info>``: host, port, and optional poll/
+    timeout (seconds) — CAdapterFactory.cpp:264-274's rtds branch."""
+    from freedm_tpu.devices.adapters.rtds import RtdsAdapter
+
+    try:
+        host, port = spec.info["host"], int(spec.info["port"])
+    except KeyError as e:
+        raise ValueError(f"rtds adapter {spec.name!r} needs <info> {e}") from None
+    return RtdsAdapter(
+        host,
+        port,
+        poll_s=float(spec.info.get("poll", 0.050)),
+        socket_timeout_s=float(spec.info.get("timeout", 1.000)),
+    )

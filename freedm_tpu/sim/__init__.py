@@ -1,0 +1,14 @@
+"""Co-simulation rigs: run the framework without real hardware.
+
+The reference ships ``pscad-interface`` — a standalone table server
+that emulates the simulator side of the RTDS protocol so N DGI
+processes can be tested against one simulated grid (SURVEY.md §2.4).
+This package is its TPU-native replacement: the "simulator" is the
+physics-bearing pure-JAX plant (:class:`freedm_tpu.devices.adapters
+.plant.PlantAdapter`), served over the same lock-step buffer protocol
+the RTDS adapter speaks.
+"""
+
+from freedm_tpu.sim.plantserver import PlantServer, load_rig
+
+__all__ = ["PlantServer", "load_rig"]

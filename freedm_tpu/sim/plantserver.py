@@ -1,0 +1,249 @@
+"""Standalone plant server speaking the RTDS lock-step protocol.
+
+Reference: ``pscad-interface-master`` — one process, one TCP server per
+``<adapter>`` element, shared state/command device tables with
+reader/writer locks (``src/PosixMain.cpp:46-80``,
+``include/CTableManager.hpp:43-88``).  N DGI processes connect their
+RTDS adapters and exchange whole float buffers against the tables.
+
+Here the tables *are* a live plant: a
+:class:`~freedm_tpu.devices.adapters.plant.PlantAdapter` (radial feeder
++ ladder power flow + frequency droop) advanced by a physics clock.
+Each served port performs the simulator half of the lock-step exchange
+— read the client's command buffer, apply it, reply with the state
+buffer — so a fleet process (or several) runs against real closed-loop
+physics with no hardware, which is strictly more than the reference's
+static tables.
+
+Run standalone:  ``python -m freedm_tpu.sim.plantserver rig.xml``
+(see :func:`load_rig` for the XML schema), or embed in-process for
+tests via :class:`PlantServer`.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from freedm_tpu.core.config import NULL_COMMAND
+from freedm_tpu.devices.adapters.plant import PlantAdapter
+from freedm_tpu.devices.adapters.rtds import WIRE_DTYPE, read_exactly
+from freedm_tpu.utils.textio import read_source
+
+Binding = Tuple[str, str]  # (device, signal)
+
+
+@dataclass
+class _Port:
+    """One served adapter port: its socket + buffer⇄table bindings."""
+
+    states: List[Binding]  # index order = buffer order
+    commands: List[Binding]
+    server: socket.socket = None  # type: ignore[assignment]
+    threads: List[threading.Thread] = field(default_factory=list)
+
+
+class PlantServer:
+    """Serve a PlantAdapter's signals over RTDS lock-step TCP ports."""
+
+    def __init__(self, plant: PlantAdapter, period_s: float = 0.050):
+        self.plant = plant
+        self.period_s = period_s
+        self._plant_lock = threading.RLock()
+        self._ports: List[_Port] = []
+        self._stop = threading.Event()
+        self._physics: Optional[threading.Thread] = None
+        self.exchanges = 0
+
+    # -- configuration -------------------------------------------------------
+    def add_port(
+        self,
+        states: Sequence[Binding],
+        commands: Sequence[Binding],
+        bind: Tuple[str, int] = ("127.0.0.1", 0),
+    ) -> Tuple[str, int]:
+        """Declare a served port; returns its bound (host, port)."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(bind)
+        srv.listen(4)
+        self._ports.append(_Port(list(states), list(commands), server=srv))
+        return srv.getsockname()
+
+    def port_address(self, i: int) -> Tuple[str, int]:
+        return self._ports[i].server.getsockname()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "PlantServer":
+        with self._plant_lock:
+            self.plant.step()  # prime voltages/omega before first client
+        self._physics = threading.Thread(target=self._physics_loop, daemon=True)
+        self._physics.start()
+        for p in self._ports:
+            t = threading.Thread(target=self._accept_loop, args=(p,), daemon=True)
+            t.start()
+            p.threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for p in self._ports:
+            try:
+                p.server.close()
+            except OSError:
+                pass
+        if self._physics is not None:
+            self._physics.join(timeout=2.0)
+
+    def _physics_loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            with self._plant_lock:
+                self.plant.step()
+
+    # -- the simulator half of the lock-step exchange ------------------------
+    def _accept_loop(self, p: _Port) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = p.server.accept()
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._serve_conn, args=(p, conn), daemon=True
+            )
+            t.start()
+            p.threads.append(t)
+
+    def _serve_conn(self, p: _Port, conn: socket.socket) -> None:
+        """Receive commands, apply, reply with states — the reverse
+        order of the DGI side (CRtdsAdapter.cpp:141-145)."""
+        conn.settimeout(None)  # the client's command write paces us
+        try:
+            while not self._stop.is_set():
+                if not p.commands:
+                    # Nothing to block on: pace state pushes ourselves.
+                    if self._stop.wait(self.period_s):
+                        break
+                if p.commands:
+                    raw = read_exactly(conn, len(p.commands) * 4)
+                    cmds = np.frombuffer(raw, WIRE_DTYPE).astype(np.float64)
+                    with self._plant_lock:
+                        for (device, signal), v in zip(p.commands, cmds):
+                            if abs(v - NULL_COMMAND) > 0.5:
+                                self.plant.set_command(device, signal, float(v))
+                if p.states:
+                    with self._plant_lock:
+                        vals = [
+                            self.plant.get_state(device, signal)
+                            for device, signal in p.states
+                        ]
+                    conn.sendall(np.asarray(vals, WIRE_DTYPE).tobytes())
+                self.exchanges += 1
+        except (ConnectionError, OSError):
+            pass  # client went away; the acceptor keeps serving
+        finally:
+            conn.close()
+
+
+# ---------------------------------------------------------------------------
+# rig.xml
+# ---------------------------------------------------------------------------
+
+
+def load_rig(source: Union[str, "os.PathLike[str]"]) -> PlantServer:
+    """Build a PlantServer from a rig XML (pscad-interface's
+    ``rscad.xml`` role, ``pscad-interface-master/src/PosixMain.cpp:46-80``):
+
+    .. code-block:: xml
+
+        <rig case="vvc_9bus" period="0.05">
+          <device name="SST1" type="Sst" node="2"/>
+          <device name="DRER_A" type="Drer" node="1" value="30"/>
+          <device name="LOAD_A" type="Load" node="0" value="10"/>
+          <adapter port="5501">
+            <state device="SST1" signal="gateway" index="0"/>
+            <command device="SST1" signal="gateway" index="0"/>
+          </adapter>
+        </rig>
+
+    ``case`` names a constructor in :mod:`freedm_tpu.grid.cases`;
+    ``value`` seeds Drer generation / Load drain.  ``port="0"`` binds an
+    ephemeral port (query it via :meth:`PlantServer.port_address`).
+    """
+    root = ET.fromstring(read_source(source, "<"))
+    from freedm_tpu.grid import cases
+
+    case_name = root.get("case", "vvc_9bus")
+    try:
+        feeder = getattr(cases, case_name)()
+    except AttributeError as e:
+        raise ValueError(f"unknown feeder case {case_name!r}") from e
+
+    placements: Dict[str, Tuple[str, int]] = {}
+    seeds: List[Tuple[str, str, float]] = []
+    for d in root.findall("device"):
+        name, tname = d.get("name"), d.get("type")
+        if not name or not tname or d.get("node") is None:
+            raise ValueError("device needs name, type, node attributes")
+        placements[name] = (tname, int(d.get("node")))
+        if d.get("value") is not None:
+            seeds.append((name, tname, float(d.get("value"))))
+
+    plant = PlantAdapter(feeder, placements, droop=float(root.get("droop", 0.05)))
+    for name, tname, value in seeds:
+        if tname == "Drer":
+            plant.set_generation(name, value)
+        elif tname == "Load":
+            plant.set_load(name, value)
+        else:
+            raise ValueError(f"value= only seeds Drer/Load, not {tname}")
+    plant.reveal_devices()
+
+    server = PlantServer(plant, period_s=float(root.get("period", 0.05)))
+    for a in root.findall("adapter"):
+        port = int(a.get("port", "0"))
+
+        def table(kind: str) -> List[Binding]:
+            entries = sorted(
+                a.findall(kind), key=lambda e: int(e.get("index", "0"))
+            )
+            idx = [int(e.get("index", "0")) for e in entries]
+            if idx != list(range(len(idx))):
+                raise ValueError(f"{kind} entry indices are not dense 0..n-1")
+            return [(e.get("device"), e.get("signal")) for e in entries]
+
+        server.add_port(table("state"), table("command"), bind=("127.0.0.1", port))
+    return server
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import time
+
+    ap = argparse.ArgumentParser(
+        description="FREEDM-TPU plant server (pscad-interface replacement)"
+    )
+    ap.add_argument("config", help="rig.xml path")
+    args = ap.parse_args(argv)
+    server = load_rig(args.config)
+    server.start()
+    import json
+
+    addrs = [list(server.port_address(i)) for i in range(len(server._ports))]
+    print(json.dumps({"plantserver": addrs}), flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
