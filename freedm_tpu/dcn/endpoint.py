@@ -92,7 +92,9 @@ class UdpEndpoint:
             st = self._peers.get(uuid)
             if st is None:
                 st = _PeerState(
-                    SrChannel(uuid, self.resend_time_s, self.ttl_s), addr, reliability
+                    SrChannel(uuid, self.resend_time_s, self.ttl_s, src_uuid=self.uuid),
+                    addr,
+                    reliability,
                 )
                 self._peers[uuid] = st
             else:
@@ -166,7 +168,10 @@ class UdpEndpoint:
             st = self._peers.get(src)
             if st is None:
                 # Auto-register unknown senders (CListener.cpp:139-166).
-                st = _PeerState(SrChannel(src, self.resend_time_s, self.ttl_s), addr)
+                st = _PeerState(
+                    SrChannel(src, self.resend_time_s, self.ttl_s, src_uuid=self.uuid),
+                    addr,
+                )
                 self._peers[src] = st
             elif st.addr is None:
                 st.addr = addr

@@ -31,6 +31,7 @@ endpoint (:mod:`freedm_tpu.dcn.endpoint`) or any future carrier.
 from __future__ import annotations
 
 from collections import deque
+from dataclasses import replace
 from typing import Deque, List, Optional
 
 from freedm_tpu.dcn import wire
@@ -45,6 +46,11 @@ MAX_DROPPED_MSGS = 3
 DEFAULT_RESEND_S = 0.060
 DEFAULT_TTL_S = 4.100
 
+# Slack for send()'s size pre-check: covers the worst-case length
+# difference between the probe's monotonic timestamp and the wall-clock
+# stamp (and any float repr jitter) the pump writes at flush time.
+_STAMP_MARGIN = 32
+
 
 class SrChannel:
     """One direction-pair of the SR protocol with a single peer."""
@@ -54,8 +60,13 @@ class SrChannel:
         uuid: str,
         resend_time_s: float = DEFAULT_RESEND_S,
         ttl_s: float = DEFAULT_TTL_S,
+        src_uuid: Optional[str] = None,
     ):
         self.uuid = uuid  # the peer
+        # Our own uuid — what the carrier stamps as datagram source.  The
+        # send() size pre-check must use it, or a near-cap message could
+        # pass here and then overflow in the pump on every flush.
+        self.src_uuid = src_uuid if src_uuid is not None else uuid
         self.resend_time_s = resend_time_s
         self.ttl_s = ttl_s
         # Outbound (sender role).
@@ -84,21 +95,25 @@ class SrChannel:
     def send(self, msg: ModuleMessage, now: float) -> None:
         """Queue a message (CProtocolSR::Send): SYN-first when unsynced,
         assign seq + hash, stamp TTL."""
+        # Oversize messages fail loudly at the caller — BEFORE any state
+        # mutation, or the rejected send would burn a sequence number
+        # and desync the stream.  Probe with worst-case seq digits and a
+        # stamp margin: the pump's flush stamps wall-clock time, which
+        # can serialize longer than the monotonic `now` used here.
+        probe = Frame(
+            status=MESSAGE,
+            seq=SEQUENCE_MODULO - 1,
+            hash=msg.hash(),
+            expire=now + self.ttl_s,
+            msg=wire.pack_message(msg),
+        )
+        wire.encode_window(self.src_uuid, [probe], now, margin=_STAMP_MARGIN)
         if not self._out_synced:
             self._push_syn(now)
         # The frame TTL governs on-wire life on the channel's clock;
         # end-to-end ModuleMessage.expire_time is wall-clock and is
         # enforced at dispatch (Dispatcher drops expired messages).
-        frame = Frame(
-            status=MESSAGE,
-            seq=self._take_seq(),
-            hash=msg.hash(),
-            expire=now + self.ttl_s,
-            msg=wire.pack_message(msg),
-        )
-        # Oversize messages fail loudly at the caller, not later in the
-        # pump thread (IProtocol::Write's too-long throw).
-        wire.encode_window(self.uuid, [frame], now)
+        frame = replace(probe, seq=self._take_seq())
         self._out_window.append(frame)
         self.sent += 1
         self._next_resend = now  # fire immediately on next poll

@@ -70,9 +70,16 @@ def unpack_message(d: Dict[str, Any]) -> ModuleMessage:
     )
 
 
-def encode_window(source_uuid: str, frames: List[Frame], send_time: float) -> bytes:
+def encode_window(
+    source_uuid: str, frames: List[Frame], send_time: float, margin: int = 0
+) -> bytes:
     """Serialize a window datagram (``IProtocol::Write`` stamping:
-    source uuid + send time on the window, size check)."""
+    source uuid + send time on the window, size check).
+
+    ``margin`` tightens the cap for pre-checks that can't know the exact
+    bytes of the eventual on-wire stamp (a wall-clock ``sent`` can be
+    longer than the channel's monotonic clock value used to probe).
+    """
     blob = json.dumps(
         {
             "src": source_uuid,
@@ -81,8 +88,8 @@ def encode_window(source_uuid: str, frames: List[Frame], send_time: float) -> by
         },
         separators=(",", ":"),
     ).encode()
-    if len(blob) > MAX_PACKET_SIZE:
-        raise ValueError(f"datagram too long: {len(blob)} > {MAX_PACKET_SIZE}")
+    if len(blob) > MAX_PACKET_SIZE - margin:
+        raise ValueError(f"datagram too long: {len(blob)} > {MAX_PACKET_SIZE - margin}")
     return blob
 
 

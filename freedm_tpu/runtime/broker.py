@@ -102,6 +102,13 @@ class Broker:
         Distinct handles per call (CBroker::AllocateTimer parity) so one
         module can hold several concurrent deadlines; the handle resolves
         back to the owning module's phase queue when it fires.
+
+        Handles live until :meth:`cancel_timers` releases them — firing
+        does NOT free a handle, so allocate-once/reschedule callers (a
+        callback re-arming its own handle) stay valid, exactly like the
+        reference's process-lifetime timer ids.  Allocate-per-deadline
+        callers should cancel_timers() their spent handles to avoid
+        accumulating registry entries.
         """
         if module_name not in self._by_name:
             raise ValueError(f"unknown module {module_name!r}")
@@ -146,13 +153,12 @@ class Broker:
         now = time.monotonic()
         due = [t for t in self._timers if t[0] <= now]
         self._timers = [t for t in self._timers if t[0] > now]
-        pending = {t[1] for t in self._timers}
+        # Handles stay registered until cancel_timers: the reference's
+        # AllocateTimer pattern allocates once and reschedules forever
+        # (e.g. a timer callback re-arming itself), so a fired handle
+        # must remain valid for schedule_timer.
         for _, handle, task in due:
             self.schedule(self._timer_owner.get(handle, handle), task, this_round=True)
-            # Release fired handles with no further deadlines so
-            # per-deadline allocate_timer callers don't leak entries.
-            if handle not in pending:
-                self._timer_owner.pop(handle, None)
 
     def _align(self) -> None:
         """Wait for the next wall-clock round boundary (plus skew) when

@@ -147,7 +147,12 @@ class Fleet:
         Without ``fid_names`` the states are concatenated in node/device
         scan order — only unambiguous when there is at most one FID.
         """
-        by_name: Dict[str, float] = {}
+        # by_name holds (state, from_live_node).  When several nodes
+        # expose a breaker under the same name, a live node's actual
+        # reading beats a dead node's forced 0, and conflicting live
+        # readings resolve to min — fail-open, matching the reference's
+        # "edges break unless known-closed" policy.
+        by_name: Dict[str, tuple] = {}
         scan_order: List[float] = []
         for node in self.nodes:
             for f in node.manager.device_names("Fid"):
@@ -155,7 +160,13 @@ class Fleet:
                 # never skipped: the vector length must not change when
                 # a host dies mid-run.
                 state = node.manager.get_state(f, "state") if node.alive else 0.0
-                by_name[f] = state
+                prev = by_name.get(f)
+                if prev is None:
+                    by_name[f] = (state, node.alive)
+                elif node.alive and not prev[1]:
+                    by_name[f] = (state, True)
+                elif node.alive == prev[1]:
+                    by_name[f] = (min(prev[0], state), prev[1])
                 scan_order.append(state)
         if self.fid_names is None:
             if len(scan_order) > 1:
@@ -164,7 +175,7 @@ class Fleet:
                     "to fix their order"
                 )
             return jnp.asarray(scan_order) if scan_order else jnp.zeros(0)
-        return jnp.asarray([by_name.get(name, 0.0) for name in self.fid_names])
+        return jnp.asarray([by_name.get(name, (0.0, False))[0] for name in self.fid_names])
 
     # -- device egress -------------------------------------------------------
     def write_gateways(self, gateway: np.ndarray) -> None:

@@ -390,6 +390,43 @@ def test_oversize_message_raises_at_sender():
     ep.stop()
 
 
+def test_oversize_send_burns_no_sequence_number():
+    # A rejected oversize send must leave the channel untouched: the
+    # next valid message keeps the expected seq and delivers normally.
+    a = SrChannel("b", src_uuid="a")
+    b = SrChannel("a", src_uuid="b")
+    with pytest.raises(ValueError, match="too long"):
+        a.send(ModuleMessage("lb", "big", {"blob": "y" * wire.MAX_PACKET_SIZE}), 0.0)
+    a.send(ModuleMessage("lb", "ok", {}), 0.0)
+    delivered = []
+    for _ in range(4):
+        for f in a.poll(0.01):
+            delivered.extend(b.on_frames([f], 0.01))
+        for f in b.poll(0.01):
+            a.on_frames([f], 0.01)
+    assert [m.type for m in delivered] == ["ok"]
+
+
+def test_sender_size_check_uses_local_uuid():
+    # Round-2 advisor finding: the send() size pre-check must be
+    # computed with the *endpoint's* uuid (what goes on the wire as
+    # src), not the peer's.  With a long local uuid and a short peer
+    # uuid, a message sized to just fit under the cap with the short
+    # uuid must be rejected at send(), not explode later in the pump.
+    long_uuid = "sender-" + "x" * 200
+    ep = ep_mod.UdpEndpoint(long_uuid)
+    ep.connect("b", ("127.0.0.1", 1))
+    pad = "y" * (wire.MAX_PACKET_SIZE - 400)  # fits with "b", not with long_uuid
+    msg = ModuleMessage("lb", "x", {"blob": pad})
+    # Sanity: the peer-uuid-sized window would have passed.
+    frame = wire.Frame(status=wire.MESSAGE, seq=0, hash=msg.hash(),
+                       msg=wire.pack_message(msg))
+    assert len(wire.encode_window("b", [frame], 0.0)) <= wire.MAX_PACKET_SIZE
+    with pytest.raises(ValueError, match="too long"):
+        ep.send("b", msg)
+    ep.stop()
+
+
 def test_large_backlog_does_not_kill_pump():
     # Unreachable peer + deep backlog: the pump thread must chunk and
     # keep running, and delivery must complete once the peer appears.

@@ -214,6 +214,51 @@ def test_allocate_timer_distinct_handles():
     assert fired == ["a"]
 
 
+def test_timer_handle_survives_firing_for_rearm():
+    # AllocateTimer allocate-once/reschedule pattern: a timer callback
+    # re-arming its own handle must not raise (round-2 advisor finding).
+    broker = Broker()
+    rec = Recorder("m")
+    broker.register_module(rec, 10)
+    t = broker.allocate_timer("m")
+    fired = []
+
+    def cb():
+        fired.append(len(fired))
+        if len(fired) < 3:
+            broker.schedule_timer(t, 0.0, cb)
+
+    broker.schedule_timer(t, 0.0, cb)
+    broker.run(n_rounds=5)
+    assert fired == [0, 1, 2]
+    assert broker.cancel_timers(t) == 0  # released only here
+
+
+def test_fleet_fid_duplicate_name_prefers_live_reading(three_node_fleet):
+    # Same breaker name exposed by two nodes: a dead node's forced-open 0
+    # must not mask the live node's actual reading, and vice versa the
+    # conservative open state must win among live conflicts (min).
+    fleet, plant = three_node_fleet
+    from freedm_tpu.devices.adapters.fake import FakeAdapter
+
+    fake = FakeAdapter()
+    fleet.nodes[0].manager.add_device("FID_X", "Fid", fake)
+    fake2 = FakeAdapter()
+    fleet.nodes[2].manager.add_device("FID_X", "Fid", fake2)
+    fake.reveal_devices()
+    fake2.reveal_devices()
+    fake.set_state("FID_X", "state", 1.0)
+    fake2.set_state("FID_X", "state", 1.0)
+    fleet.fid_names = ("FID_X",)
+    # Node 2 dies: its copy reads forced 0, but node 0 is live with 1.0.
+    fleet.set_alive(2, False)
+    np.testing.assert_allclose(np.asarray(fleet.fid_states()), [1.0])
+    # Both live but disagreeing: fail-open (min).
+    fleet.set_alive(2, True)
+    fake2.set_state("FID_X", "state", 0.0)
+    np.testing.assert_allclose(np.asarray(fleet.fid_states()), [0.0])
+
+
 def test_fleet_fid_states_topology_order(three_node_fleet):
     fleet, plant = three_node_fleet
     # Give nodes FID devices named like topology fid edges, registered in
