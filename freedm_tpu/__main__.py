@@ -1,0 +1,8 @@
+"""``python -m freedm_tpu`` — the PosixBroker binary equivalent."""
+
+import sys
+
+from freedm_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

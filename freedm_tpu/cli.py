@@ -1,0 +1,272 @@
+"""Command-line entry: launch a broker fleet from config files.
+
+Reference: ``PosixMain`` (``Broker/src/PosixMain.cpp:113-442``) — parse
+CLI + ``freedm.cfg`` (boost::program_options), load ``timings.cfg``,
+``device.xml``, ``adapter.xml``, ``logger.cfg``, ``topology.cfg``,
+construct the GM/SC/LB/VVC agents, register their phases and read
+handlers, seed the peer list from ``add-host``, and run the broker.
+
+The TPU-native difference is the process model: the reference starts
+one process per SST node and lets them gossip over UDP; here one
+process hosts the whole fleet — each ``add-host`` entry becomes a fleet
+row, and every module phase runs one kernel over the node axis.  A
+config written for N reference processes (N freedm.cfg files) becomes
+one freedm.cfg whose ``add-host`` lines list the other N-1 nodes and
+one adapter.xml whose ``<adapter owner="host:port">`` attributes assign
+adapters to nodes (``owner`` omitted = the process's own node, so
+single-node reference configs work unchanged).
+
+Flag names match the reference CLI (``PosixMain.cpp:130-194``); the
+additions are ``--rounds`` (run a bounded number of scheduler rounds;
+0 = run until killed), ``--realtime`` (wall-clock phase budgets +
+round alignment instead of free-running), and ``--summary-every``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from freedm_tpu.core import logging as dgilog
+from freedm_tpu.core.config import GlobalConfig, Timings
+from freedm_tpu.devices.factory import AdapterFactory, parse_adapter_xml
+from freedm_tpu.devices.manager import DeviceManager
+from freedm_tpu.devices.schema import compile_layout, parse_device_xml
+from freedm_tpu.grid.topology import node_reachability, parse_topology
+from freedm_tpu.runtime.broker import Broker
+from freedm_tpu.runtime.fleet import (
+    Fleet,
+    NodeHandle,
+    VvcModule,
+    build_broker,
+    omega_invariant,
+)
+
+logger = dgilog.get_logger(__name__)
+
+
+@dataclasses.dataclass
+class Runtime:
+    """Everything :func:`build_runtime` wires, for tests and embedders."""
+
+    config: GlobalConfig
+    timings: Timings
+    broker: Broker
+    fleet: Fleet
+    factories: Dict[str, AdapterFactory]
+    vvc: Optional[VvcModule] = None
+
+    def start(self) -> "Runtime":
+        for f in self.factories.values():
+            f.start()
+        return self
+
+    def stop(self) -> None:
+        for f in self.factories.values():
+            f.stop()
+
+
+def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        prog="freedm_tpu",
+        description="FREEDM-TPU broker fleet (PosixBroker equivalent)",
+    )
+    ap.add_argument("-c", "--config", help="freedm.cfg path")
+    ap.add_argument("-H", "--add-host", action="append", default=None,
+                    metavar="HOST:PORT", help="uuid of a peer node (repeatable)")
+    ap.add_argument("--address", default=None, help="IP interface to listen on")
+    ap.add_argument("-p", "--port", type=int, default=None, help="DCN listen port")
+    ap.add_argument("--factory-port", type=int, default=None,
+                    help="port for the plug-and-play session protocol")
+    ap.add_argument("--device-config", default=None, help="device.xml path")
+    ap.add_argument("--adapter-config", default=None, help="adapter.xml path")
+    ap.add_argument("--logger-config", default=None, help="logger.cfg path")
+    ap.add_argument("--timings-config", default=None, help="timings.cfg path")
+    ap.add_argument("--topology-config", default=None, help="topology.cfg path")
+    ap.add_argument("--migration-step", type=float, default=None,
+                    help="size of LB power migrations")
+    ap.add_argument("--malicious-behavior", action="store_true", default=None,
+                    help="this node drops DraftSelects while in demand")
+    ap.add_argument("--check-invariant", action="store_true", default=None,
+                    help="gate migrations on the frequency invariant")
+    ap.add_argument("-v", "--verbose", type=int, default=None,
+                    help="logger verbosity 0 (fatal) .. 8 (trace)")
+    ap.add_argument("--vvc-case", default=None,
+                    help="feeder case for the VVC module (grid.cases name)")
+    ap.add_argument("-l", "--list-loggers", action="store_true",
+                    help="print all available loggers and exit")
+    ap.add_argument("-u", "--uuid", action="store_true",
+                    help="print this node's uuid and exit")
+    ap.add_argument("--rounds", type=int, default=0,
+                    help="scheduler rounds to run (0 = until killed)")
+    ap.add_argument("--realtime", action="store_true",
+                    help="wall-clock phase budgets + round alignment")
+    ap.add_argument("--summary-every", type=int, default=0, metavar="N",
+                    help="print a JSON round summary every N rounds")
+    return ap.parse_args(argv)
+
+
+def _load_config(args: argparse.Namespace) -> GlobalConfig:
+    overrides = {}
+    for field, key in [
+        ("add_host", "add_host"), ("address", "address"), ("port", "port"),
+        ("factory_port", "factory_port"), ("device_config", "device_config"),
+        ("adapter_config", "adapter_config"), ("logger_config", "logger_config"),
+        ("timings_config", "timings_config"), ("topology_config", "topology_config"),
+        ("migration_step", "migration_step"),
+        ("malicious_behavior", "malicious_behavior"),
+        ("check_invariant", "check_invariant"), ("verbose", "verbose"),
+        ("vvc_case", "vvc_case"),
+    ]:
+        v = getattr(args, field)
+        if v is not None:
+            overrides[key] = v
+    if args.config:
+        return GlobalConfig.from_file(args.config, **overrides)
+    return GlobalConfig(**overrides)
+
+
+def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runtime:
+    """Wire the full stack from a :class:`GlobalConfig` (the body of
+    the reference's ``main``, ``PosixMain.cpp:268-435``)."""
+    if timings is None:
+        timings = (
+            Timings.from_file(cfg.timings_config) if cfg.timings_config else Timings()
+        )
+    if cfg.logger_config:
+        dgilog.configure_from_file(cfg.logger_config)
+    else:
+        dgilog.set_global_level(cfg.verbose)
+
+    layout = (
+        compile_layout(parse_device_xml(cfg.device_config))
+        if cfg.device_config
+        else compile_layout()
+    )
+
+    # Node axis: this process first, then peers in add-host order
+    # (CConnectionManager::PutHost seeding, PosixMain.cpp:376-404).
+    uuids: List[str] = [cfg.uuid]
+    for h in cfg.add_host:
+        if h not in uuids:
+            uuids.append(h)
+
+    managers = {u: DeviceManager(layout) for u in uuids}
+    factories = {u: AdapterFactory(managers[u]) for u in uuids}
+    if cfg.adapter_config:
+        for spec in parse_adapter_xml(cfg.adapter_config):
+            owner = spec.owner or cfg.uuid
+            if owner not in factories:
+                raise ValueError(
+                    f"adapter {spec.name!r}: owner {owner!r} is not a fleet node "
+                    f"(nodes: {', '.join(uuids)})"
+                )
+            factories[owner].create_adapter(spec)
+
+    reachability = None
+    fid_names = None
+    if cfg.topology_config:
+        topo = parse_topology(cfg.topology_config)
+        reachability = node_reachability(topo, tuple(uuids))
+        fid_names = topo.fid_names
+
+    import numpy as np
+
+    malicious = None
+    if cfg.malicious_behavior:
+        malicious = np.zeros(len(uuids))
+        malicious[0] = 1.0  # the reference flag maligns *this* process
+
+    fleet = Fleet(
+        [NodeHandle(u, managers[u]) for u in uuids],
+        reachability=reachability,
+        fid_names=fid_names,
+        migration_step=cfg.migration_step,
+        malicious=malicious,
+    )
+
+    vvc = None
+    extra = []
+    if cfg.vvc_case:
+        from freedm_tpu.grid import cases
+
+        try:
+            feeder = getattr(cases, cfg.vvc_case)()
+        except AttributeError:
+            raise ValueError(f"unknown vvc feeder case {cfg.vvc_case!r}") from None
+        vvc = VvcModule(fleet, feeder)
+        extra.append(vvc)
+
+    if cfg.factory_port is not None:
+        # PnP session server lands with the pnp adapter type; until it is
+        # wired here the flag must not be a silent no-op.
+        logger.warn(
+            f"factory-port {cfg.factory_port} set but the PnP session "
+            "server is not started by this entry yet"
+        )
+
+    invariant = omega_invariant() if cfg.check_invariant else None
+    broker = build_broker(fleet, timings, invariant=invariant, extra_modules=extra)
+    return Runtime(cfg, timings, broker, fleet, factories, vvc)
+
+
+def _round_summary(rt: Runtime) -> Dict[str, object]:
+    shared = rt.broker.shared
+    out: Dict[str, object] = {"round": rt.broker.round_index}
+    group = shared.get("group")
+    if group is not None:
+        out["n_groups"] = int(group.n_groups)
+    lb_out = shared.get("lb_round")
+    if lb_out is not None:
+        out["migrations"] = int(lb_out.n_migrations)
+    vvc_out = shared.get("vvc")
+    if vvc_out is not None:
+        out["vvc_loss_kw"] = round(float(vvc_out.loss_after_kw), 6)
+        out["vvc_improved"] = bool(vvc_out.improved)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parse_args(argv)
+    if args.list_loggers:
+        dgilog.basic_config()
+        for name in dgilog.list_loggers():
+            print(name)
+        return 0
+    cfg = _load_config(args)
+    if args.uuid:
+        print(cfg.uuid)
+        return 0
+    dgilog.basic_config()
+    rt = build_runtime(cfg)
+    logger.status(
+        f"fleet up: {rt.fleet.n_nodes} nodes, uuid {cfg.uuid}, "
+        f"round {rt.broker.round_length_ms:.0f} ms, "
+        f"vvc={'on' if rt.vvc else 'off'}"
+    )
+    rt.start()
+    try:
+        if args.summary_every > 0:
+            done = 0
+            while args.rounds == 0 or done < args.rounds:
+                chunk = args.summary_every
+                if args.rounds:
+                    chunk = min(chunk, args.rounds - done)
+                done += rt.broker.run(n_rounds=chunk, realtime=args.realtime)
+                print(json.dumps(_round_summary(rt)), flush=True)
+        else:
+            rt.broker.run(
+                n_rounds=args.rounds or None, realtime=args.realtime
+            )
+    except KeyboardInterrupt:
+        pass
+    finally:
+        rt.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

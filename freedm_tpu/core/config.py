@@ -217,6 +217,10 @@ class GlobalConfig:
     # Monte-Carlo scenarios.
     mesh_nodes: int = 1
     mesh_batch: int = 1
+    # Feeder case (freedm_tpu.grid.cases constructor name) the VVC module
+    # controls; unset = no VVC phase.  The reference compiles its feeder
+    # into vvc_main (load_system_data.cpp); ours is a config knob.
+    vvc_case: Optional[str] = None
 
     @property
     def uuid(self) -> str:

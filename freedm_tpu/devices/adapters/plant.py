@@ -20,7 +20,15 @@ Device semantics (signal names from ``device.xml``):
   net imbalance (the quantity the reference's LB invariant checks with
   its hard-coded 376.8 rad/s model, ``lb/LoadBalance.cpp:1237-1277``);
 - ``Fid.state``       — fault-isolation switch, 1 = closed; commands
-  open/close it (drives topology masks in gm).
+  open/close it (drives topology masks in gm);
+- ``Pload_a/b/c.pload`` — one phase's real load at the node, kW (the
+  RSCAD load feeds the reference VVC reads,
+  ``vvc/VoltVarCtrl.cpp:443-520``);
+- ``Sst_a/b/c.gateway`` — per-phase reactive setpoint command, kvar:
+  the VVC's accepted Q injections (the slaves' ``Sst_a/b/c`` gateway
+  writes, ``Broker_s1/src/vvc/VoltVarCtrl.cpp`` ``vvc_slave``); the
+  plant subtracts them from the phase's Q draw, closing the Volt-VAR
+  loop through real feeder physics.
 
 ``step()`` advances the plant one tick; it is host-called but the
 physics inside is the jitted ladder solve, so a plant step costs one
@@ -39,6 +47,17 @@ from freedm_tpu.grid.feeder import Feeder
 from freedm_tpu.pf import ladder
 
 NOMINAL_OMEGA = OMEGA_NOMINAL  # rad/s, the reference's PSCAD model constant
+
+# Per-phase VVC device types (Broker_s1/config/device.xml): type name →
+# (kind, phase column).
+_PHASE_OF = {
+    "Pload_a": ("pload", 0),
+    "Pload_b": ("pload", 1),
+    "Pload_c": ("pload", 2),
+    "Sst_a": ("sst", 0),
+    "Sst_b": ("sst", 1),
+    "Sst_c": ("sst", 2),
+}
 
 
 def register_plant_type(factory, feeder: "Feeder", node_of: Dict[str, int], **kwargs) -> None:
@@ -72,8 +91,17 @@ class PlantAdapter(Adapter):
         droop: float = 0.02,
         dt_hours: float = 1.0 / 3600.0,
         seed: int = 0,
+        feeder_base_load: bool = False,
     ) -> None:
-        """``placements``: device name → (type, feeder branch index)."""
+        """``placements``: device name → (type, feeder branch index).
+
+        ``feeder_base_load=True`` grounds the physics in the feeder's
+        configured spot loads (the reference's Dl table): device-driven
+        power is a *delta* on top of them.  This is the rig mode for
+        closed-loop VVC — the controller's feeder model and the plant
+        solve the same base case, so its expected loss descent is the
+        plant's actual descent.
+        """
         super().__init__()
         self.feeder = feeder
         self.placements = dict(placements)
@@ -82,6 +110,11 @@ class PlantAdapter(Adapter):
         self.dt_hours = dt_hours
         self._rng = np.random.default_rng(seed)
         self._solve, _ = ladder.make_ladder_solver(feeder)
+        self._s_base = (
+            np.asarray(feeder.s_load, dtype=np.complex128)
+            if feeder_base_load
+            else np.zeros((feeder.n_branches, 3), np.complex128)
+        )
 
         nb = feeder.n_branches
         self._load_kw = np.zeros(nb)
@@ -89,11 +122,17 @@ class PlantAdapter(Adapter):
         self._gateway_kw = np.zeros(nb)
         self._storage_kwh = np.zeros(nb)
         self._charge_kw = np.zeros(nb)
+        self._q_inj_kvar = np.zeros((nb, 3))  # VVC per-phase injections
         self._fid_closed: Dict[str, float] = {}
         self._omega = NOMINAL_OMEGA
         self._v_mag: Optional[np.ndarray] = None
+        self._loss_kw = float("nan")
 
+        # Seed Load/Drer from the feeder's spot loads — unless those
+        # already enter the physics via s_base (double counting).
         base = np.asarray(feeder.s_load.real).sum(axis=1)
+        if feeder_base_load:
+            base = np.zeros_like(base)
         for name, (tname, node) in self.placements.items():
             if tname == "Load":
                 self._load_kw[node] = max(base[node], 0.0)
@@ -121,11 +160,14 @@ class PlantAdapter(Adapter):
         )
 
         # Net per-node demand seen by the feeder: load - generation -
-        # gateway import + storage charging.
+        # gateway import + storage charging; VVC's per-phase reactive
+        # injections reduce the phase's Q draw.
         net_kw = self._load_kw - self._gen_kw - self._gateway_kw + eff_charge
         s = (net_kw / 3.0)[:, None] * np.ones(3)[None, :] * (1 + 0.3j)
+        s = self._s_base + s - 1j * self._q_inj_kvar
         res = self._solve(s.astype(np.complex128))
         self._v_mag = np.asarray(ladder.v_polar(res)[0])
+        self._loss_kw = float(ladder.total_loss_kw(self.feeder, res))
 
         # Frequency droop on total imbalance (generation+import-load).
         imbalance = float(self._gen_kw.sum() + self._gateway_kw.sum() - self._load_kw.sum())
@@ -139,6 +181,12 @@ class PlantAdapter(Adapter):
     def omega(self) -> float:
         return self._omega
 
+    @property
+    def loss_kw(self) -> float:
+        """Feeder series losses at the last solve (the quantity VVC
+        descends; NaN before the first step)."""
+        return self._loss_kw
+
     def voltage_pu(self, node: int) -> float:
         if self._v_mag is None:
             return float("nan")
@@ -151,6 +199,13 @@ class PlantAdapter(Adapter):
 
     def get_state(self, device: str, signal: str) -> float:
         tname, node = self.placements[device]
+        if tname in _PHASE_OF:
+            kind, phase = _PHASE_OF[tname]
+            if kind == "pload" and signal == "pload":
+                return float(self._s_base[node, phase].real + self._load_kw[node] / 3.0)
+            if kind == "sst" and signal == "gateway":
+                return float(self._q_inj_kvar[node, phase])
+            raise KeyError(f"unknown state signal {signal!r} for {tname} device {device!r}")
         if (tname, signal) == ("Load", "drain"):
             return float(self._load_kw[node])
         if (tname, signal) == ("Drer", "generation"):
@@ -167,6 +222,18 @@ class PlantAdapter(Adapter):
 
     def set_command(self, device: str, signal: str, value: float) -> None:
         tname, node = self.placements[device]
+        if tname in _PHASE_OF:
+            kind, phase = _PHASE_OF[tname]
+            if kind == "sst" and signal == "gateway":
+                self._q_inj_kvar[node, phase] = float(value)
+                return
+            if kind == "pload" and signal == "pload":
+                # Commanding a Pload sets the phase's base load directly
+                # (the reference schema declares <command>pload</command>
+                # on Pload_x; here it drives the rig's per-phase load).
+                self._s_base[node, phase] = float(value) + 1j * self._s_base[node, phase].imag
+                return
+            raise KeyError(f"unknown command signal {signal!r} for {tname} device {device!r}")
         if (tname, signal) == ("Sst", "gateway"):
             self._gateway_kw[node] = float(value)
         elif (tname, signal) == ("Desd", "storage"):

@@ -51,13 +51,20 @@ class EntryBinding:
 
 @dataclass(frozen=True)
 class AdapterSpec:
-    """Parsed ``<adapter>`` element."""
+    """Parsed ``<adapter>`` element.
+
+    ``owner`` is our extension of the reference format: when one config
+    drives a whole fleet, it names the DGI node (``hostname:port`` uuid)
+    whose device manager hosts this adapter; absent = the process's own
+    node.  Single-node configs (the reference's layout) never set it.
+    """
 
     name: str
     type: str
     info: Dict[str, str] = field(default_factory=dict)
     state: Tuple[EntryBinding, ...] = ()
     command: Tuple[EntryBinding, ...] = ()
+    owner: Optional[str] = None
 
     @property
     def devices(self) -> Tuple[Tuple[str, str], ...]:
@@ -96,6 +103,7 @@ def parse_adapter_xml(source: Union[str, Path]) -> Tuple[AdapterSpec, ...]:
                 info=info,
                 state=entries(node.find("state")),
                 command=entries(node.find("command")),
+                owner=node.get("owner"),
             )
         )
     if not specs:

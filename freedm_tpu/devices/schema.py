@@ -43,7 +43,10 @@ class DeviceType:
 
 
 # The reference's sample device classes (device.xml), used as defaults so
-# in-process setups need no XML file.
+# in-process setups need no XML file.  The per-phase Sst_x/Pload_x types
+# are the VVC deployment's additions (``Broker_s1/config/device.xml``):
+# Pload_x carries a phase's real load reading from the simulator and
+# Sst_x carries the per-phase kvar setpoint command the VVC scatters.
 DEFAULT_TYPES: Tuple[DeviceType, ...] = (
     DeviceType("Sst", states=("gateway",), commands=("gateway",)),
     DeviceType("Desd", states=("storage",), commands=("storage",)),
@@ -52,6 +55,12 @@ DEFAULT_TYPES: Tuple[DeviceType, ...] = (
     DeviceType("Fid", states=("state",)),
     DeviceType("Logger", states=("dgiEnable",), commands=("groupStatus",)),
     DeviceType("Omega", states=("frequency",)),
+    DeviceType("Sst_a", states=("gateway",), commands=("gateway",)),
+    DeviceType("Sst_b", states=("gateway",), commands=("gateway",)),
+    DeviceType("Sst_c", states=("gateway",), commands=("gateway",)),
+    DeviceType("Pload_a", states=("pload",), commands=("pload",)),
+    DeviceType("Pload_b", states=("pload",), commands=("pload",)),
+    DeviceType("Pload_c", states=("pload",), commands=("pload",)),
 )
 
 

@@ -9,5 +9,8 @@ from freedm_tpu.runtime.fleet import (  # noqa: F401
     GmModule,
     ScModule,
     LbModule,
+    VvcModule,
+    EgressModule,
     build_broker,
+    omega_invariant,
 )

@@ -301,6 +301,171 @@ class LbModule(DgiModule):
         self.rounds += 1
 
 
+class VvcModule(DgiModule):
+    """Gradient Volt-VAR control in the round loop.
+
+    The reference's flagship module (``vvc::VVCAgent``): every VVC phase
+    it reads per-phase real loads from ``Pload_a/b/c`` devices with
+    staleness detection (``vvc/VoltVarCtrl.cpp:443-520``: a reading
+    equal to the feeder's default is "Signal not updated" and the
+    default is kept), runs one gradient round with backtracking line
+    search (``vvc_main``), and scatters the accepted Q setpoints to the
+    per-phase ``Sst_a/b/c`` devices as ``gateway`` commands — the
+    master/slave ``GradientMessage``→``vvc_slave`` hand-off collapsed
+    into a direct device write.
+
+    Device → feeder-branch mapping: ``row_of`` overrides per name;
+    otherwise the first integer in the device name is the 0-based branch
+    row (our config convention — the reference hard-codes its
+    ``Pl{k}_{phase}`` → ``Dl`` row table in ``vvc_main``).
+    """
+
+    name = "vvc"
+    PHASES = ("a", "b", "c")
+
+    def __init__(
+        self,
+        fleet: Fleet,
+        feeder,
+        config=None,
+        row_of: Optional[Dict[str, int]] = None,
+        alpha0: float = 2000.0,
+    ):
+        from freedm_tpu.modules import vvc as vvc_mod
+
+        self.fleet = fleet
+        self.feeder = feeder
+        self.config = config or vvc_mod.VVCConfig()
+        self.row_of = dict(row_of or {})
+        self._make = lambda mask: vvc_mod.make_vvc_controller(
+            feeder, ctrl_mask=mask, config=self.config
+        )
+        # Compiled lazily on the first round that has actuation: the
+        # control mask comes from the live Sst_x device set.
+        self._mask_key: Optional[tuple] = None
+        self._step = None
+        self.skipped_rounds = 0
+        self.q_kvar = np.zeros((feeder.n_branches, 3))
+        # Warm-started step size (run_rounds' double/halve schedule);
+        # loss gradients are small (kW per kvar) so the start must be
+        # big — run_rounds' 2000 default, not VVCConfig.alpha0's
+        # per-trial scale.
+        self.alpha = float(alpha0)
+        self.rounds = 0
+        self.improved_rounds = 0
+        self.stale_reads = 0
+        self.last = None
+
+    def _row(self, device: str) -> int:
+        if device in self.row_of:
+            row = self.row_of[device]
+        else:
+            import re
+
+            m = re.search(r"(\d+)", device)
+            if m is None:
+                raise ValueError(
+                    f"VVC device {device!r}: no row_of entry and no integer in the name"
+                )
+            row = int(m.group(1))
+        # Range-check both paths: a row_of typo (e.g. -1) must not wrap
+        # to the wrong branch silently.
+        if not 0 <= row < self.feeder.n_branches:
+            raise ValueError(
+                f"VVC device {device!r}: row {row} outside feeder "
+                f"(0..{self.feeder.n_branches - 1})"
+            )
+        return row
+
+    def _sst_devices(self) -> List[tuple]:
+        """Live per-phase SST devices as (manager, name, row, phase)."""
+        out = []
+        for node in self.fleet.nodes:
+            if not node.alive:
+                continue
+            for pi, ph in enumerate(self.PHASES):
+                for name in node.manager.device_names(f"Sst_{ph}"):
+                    out.append((node.manager, name, self._row(name), pi))
+        return out
+
+    def _refresh_mask(self, ssts: List[tuple]) -> None:
+        """Controllable node-phases = where Sst_x devices exist (the
+        reference's S2 vector covers exactly the SST rows).  Recompiles
+        the step when the set changes (device reveal/PnP arrival)."""
+        key = tuple(sorted((row, pi) for _, _, row, pi in ssts))
+        if key == self._mask_key:
+            return
+        self._mask_key = key
+        mask = np.zeros((self.feeder.n_branches, 3), np.float32)
+        for row, pi in key:
+            mask[row, pi] = 1.0
+        self._step = self._make(mask)
+
+    def run_phase(self, ctx: PhaseContext) -> None:
+        fleet = self.fleet
+        # Start from the feeder's configured spot loads (the Dl table)
+        # and overlay live per-phase readings.
+        s_load = np.array(self.feeder.s_load, dtype=np.complex128)
+        for node in fleet.nodes:
+            if not node.alive:
+                continue
+            for pi, ph in enumerate(self.PHASES):
+                for name in node.manager.device_names(f"Pload_{ph}"):
+                    row = self._row(name)
+                    val = node.manager.get_state(name, "pload")
+                    # Staleness sentinel: a reading still equal to the
+                    # configured default means the simulator hasn't
+                    # updated the signal — keep the default (reference's
+                    # exact-compare, with float tolerance for the f4
+                    # wire round-trip).
+                    if abs(val - s_load[row, pi].real) <= 1e-4 * max(
+                        1.0, abs(s_load[row, pi].real)
+                    ):
+                        self.stale_reads += 1
+                    else:
+                        s_load[row, pi] = val + 1j * s_load[row, pi].imag
+        ssts = self._sst_devices()
+        if not ssts:
+            # No live per-phase SST: nothing to actuate.  Computing a
+            # full-mask "descent" here would publish falling losses the
+            # plant never sees (controls in model only) — skip instead,
+            # like the reference module logging an empty device set.
+            self.skipped_rounds += 1
+            ctx.shared.pop("vvc", None)
+            return
+        self._refresh_mask(ssts)
+        out = self._step(s_load, self.q_kvar, self.alpha)
+        improved = bool(out.improved)
+        self.q_kvar = np.asarray(out.q_ctrl_kvar)
+        self.alpha = max(
+            float(out.alpha) * 2.0 if improved else self.alpha * 0.5, 1e-3
+        )
+        # Scatter accepted setpoints to the per-phase SST devices.
+        for manager, name, row, pi in ssts:
+            manager.set_command(name, "gateway", float(self.q_kvar[row, pi]))
+        self.rounds += 1
+        self.improved_rounds += int(improved)
+        self.last = out
+        ctx.shared["vvc"] = out
+
+
+def omega_invariant(tolerance: float = 0.05):
+    """Frequency-invariant gate for LB migrations.
+
+    Reference: ``LBAgent::InvariantCheck`` blocks migrations when the
+    system frequency leaves its band (hard-coded 376.8 rad/s 7-node
+    PSCAD model, ``lb/LoadBalance.cpp:1237-1277``).  Returns a callable
+    for :class:`LbModule`'s ``invariant=``: 1 when every node's Omega
+    reading is within ``tolerance`` of nominal.
+    """
+
+    def gate(readings: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        dev = jnp.abs(readings["omega"] - OMEGA_NOMINAL) / OMEGA_NOMINAL
+        return (jnp.max(dev) <= tolerance).astype(jnp.float32)
+
+    return gate
+
+
 class EgressModule(DgiModule):
     """End-of-round device egress + plant tick (the adapter io_service's
     periodic exchange in the reference, CAdapterFactory's device thread)."""

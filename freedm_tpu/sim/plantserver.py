@@ -193,7 +193,14 @@ def load_rig(source: Union[str, "os.PathLike[str]"]) -> PlantServer:
         if d.get("value") is not None:
             seeds.append((name, tname, float(d.get("value"))))
 
-    plant = PlantAdapter(feeder, placements, droop=float(root.get("droop", 0.05)))
+    plant = PlantAdapter(
+        feeder,
+        placements,
+        droop=float(root.get("droop", 0.05)),
+        # base="feeder" grounds physics in the feeder's spot loads (the
+        # closed-loop VVC rig mode).
+        feeder_base_load=root.get("base") == "feeder",
+    )
     for name, tname, value in seeds:
         if tname == "Drer":
             plant.set_generation(name, value)

@@ -1,0 +1,342 @@
+"""CLI + VVC-in-the-loop tests.
+
+The flagship end-to-end scenario (VERDICT r2 item 1): a full
+GM→SC→LB→VVC fleet launched by ``python -m freedm_tpu`` from config
+files alone (freedm.cfg + timings.cfg + device.xml + adapter.xml +
+topology.cfg), running against a *separate-process* plant server over
+real TCP sockets, with VVC losses decreasing — the reference's
+PosixBroker + pscad-interface deployment
+(``Broker/src/PosixMain.cpp:113-442``).
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from freedm_tpu.core.config import NULL_COMMAND, GlobalConfig, Timings
+from freedm_tpu.devices.adapters.plant import PlantAdapter
+from freedm_tpu.devices.manager import DeviceManager
+from freedm_tpu.grid import cases
+from freedm_tpu.runtime import Fleet, NodeHandle, VvcModule, build_broker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------------------
+# In-process: VVC in the round loop, closed through plant physics
+# ---------------------------------------------------------------------------
+
+
+def build_vvc_plant_fleet():
+    """Single-node fleet with per-phase Pload/Sst devices on every
+    feeder row, physics grounded in the feeder's own spot loads so the
+    controller's model descent is the plant's real descent."""
+    feeder = cases.vvc_9bus()
+    placements = {"SST1": ("Sst", 2), "OMEGA": ("Omega", 0)}
+    for row in range(feeder.n_branches):
+        for ph in "abc":
+            placements[f"Pl{row}_{ph}"] = (f"Pload_{ph}", row)
+            placements[f"Q{row}_{ph}"] = (f"Sst_{ph}", row)
+    plant = PlantAdapter(feeder, placements, feeder_base_load=True)
+    manager = DeviceManager(capacity=64)
+    for name, (tname, _) in placements.items():
+        manager.add_device(name, tname, plant)
+    plant.reveal_devices()
+    plant.start()
+    fleet = Fleet([NodeHandle("n0:50850", manager)])
+    fleet.plants.append(plant)
+    return fleet, plant, feeder
+
+
+def test_vvc_module_reduces_plant_losses():
+    fleet, plant, feeder = build_vvc_plant_fleet()
+    loss_initial = plant.loss_kw
+    vvc = VvcModule(fleet, feeder)
+    broker = build_broker(fleet, extra_modules=[vvc])
+    broker.run(n_rounds=8)
+    out = broker.shared["vvc"]
+    # The accepted model loss and the plant's actual loss agree (same
+    # base case) and both dropped below the uncontrolled loss.
+    assert float(out.loss_after_kw) < loss_initial - 0.01
+    assert plant.loss_kw < loss_initial - 0.01
+    assert plant.loss_kw == pytest.approx(float(out.loss_after_kw), abs=0.05)
+    assert vvc.improved_rounds >= 1
+    # Setpoints actually flowed to the plant as per-phase Sst commands.
+    assert np.abs(plant._q_inj_kvar).sum() > 0.0
+    # Rows 0..7 all carry Pload devices with live (=default) readings:
+    # every read hits the staleness sentinel, reference-style.
+    assert vvc.stale_reads > 0
+
+
+def test_vvc_module_respects_device_mask():
+    fleet, plant, feeder = build_vvc_plant_fleet()
+    # Drop all but row 4's Q devices: the control mask must shrink to
+    # exactly that row's phases.
+    manager = fleet.nodes[0].manager
+    for row in range(feeder.n_branches):
+        if row != 4:
+            for ph in "abc":
+                manager.remove_device(f"Q{row}_{ph}")
+    vvc = VvcModule(fleet, feeder)
+    broker = build_broker(fleet, extra_modules=[vvc])
+    broker.run(n_rounds=4)
+    q = np.asarray(vvc.q_kvar)
+    mask = np.zeros_like(q)
+    mask[4, :] = 1.0
+    assert np.all(q * (1 - mask) == 0.0)
+    assert np.abs(q[4]).sum() > 0.0  # the controlled row moved
+
+
+# ---------------------------------------------------------------------------
+# Config-file generation for the CLI e2e
+# ---------------------------------------------------------------------------
+
+# (name, type, node, seed value or None) — LB story matches the 3-node
+# demo fixture; Pload/Q rows exercise the VVC read/scatter paths.
+RIG_DEVICES = (
+    [
+        ("SST1", "Sst", 2, None), ("DRER_A", "Drer", 1, 30.0),
+        ("LOAD_A", "Load", 0, 10.0), ("OMEGA", "Omega", 0, None),
+        ("SST2", "Sst", 4, None), ("LOAD_B", "Load", 5, 30.0),
+        ("DRER_B", "Drer", 6, 10.0),
+        ("SST3", "Sst", 7, None), ("LOAD_C", "Load", 3, 20.0),
+        ("DRER_C", "Drer", 3, 20.0),
+    ]
+    + [(f"Pl{row}_{ph}", f"Pload_{ph}", row, None)
+       for row in (0, 3, 5) for ph in "abc"]
+    + [(f"Q{row}_{ph}", f"Sst_{ph}", row, None)
+       for row in (2, 4, 6, 7) for ph in "abc"]
+)
+
+# Per-DGI-node adapter tables: device -> list of (device, signal) states
+# and commands, in buffer-index order (shared by rig.xml and adapter.xml).
+NODE_TABLES = {
+    "node0:50810": {
+        "states": [("SST1", "gateway"), ("DRER_A", "generation"),
+                   ("LOAD_A", "drain"), ("OMEGA", "frequency")]
+        + [(f"Pl{row}_{ph}", "pload") for row in (0, 3, 5) for ph in "abc"]
+        + [(f"Q{row}_{ph}", "gateway") for row in (2, 4, 6, 7) for ph in "abc"],
+        "commands": [("SST1", "gateway")]
+        + [(f"Q{row}_{ph}", "gateway") for row in (2, 4, 6, 7) for ph in "abc"],
+    },
+    "node1:50811": {
+        "states": [("SST2", "gateway"), ("LOAD_B", "drain"),
+                   ("DRER_B", "generation")],
+        "commands": [("SST2", "gateway")],
+    },
+    "node2:50812": {
+        "states": [("SST3", "gateway"), ("LOAD_C", "drain"),
+                   ("DRER_C", "generation")],
+        "commands": [("SST3", "gateway")],
+    },
+}
+
+TYPE_OF = {name: tname for name, tname, _, _ in RIG_DEVICES}
+
+
+def write_rig_xml(path):
+    lines = ['<rig case="vvc_9bus" base="feeder" period="0.02">']
+    for name, tname, node, value in RIG_DEVICES:
+        v = f' value="{value}"' if value is not None else ""
+        lines.append(f'  <device name="{name}" type="{tname}" node="{node}"{v}/>')
+    for uuid in NODE_TABLES:
+        lines.append('  <adapter port="0">')
+        for kind in ("state", "command"):
+            for i, (dev, sig) in enumerate(NODE_TABLES[uuid][kind + "s"]):
+                lines.append(f'    <{kind} device="{dev}" signal="{sig}" index="{i}"/>')
+        lines.append("  </adapter>")
+    lines.append("</rig>")
+    path.write_text("\n".join(lines))
+
+
+def write_adapter_xml(path, ports):
+    lines = ["<root>"]
+    for (uuid, tables), port in zip(NODE_TABLES.items(), ports):
+        owner = "" if uuid == "node0:50810" else f' owner="{uuid}"'
+        lines.append(f'  <adapter name="sim-{uuid.split(":")[0]}" type="rtds"{owner}>')
+        lines.append(f"    <info><host>127.0.0.1</host><port>{port}</port>"
+                     f"<poll>0.02</poll></info>")
+        for kind in ("state", "command"):
+            lines.append(f"    <{kind}>")
+            for i, (dev, sig) in enumerate(tables[kind + "s"]):
+                lines.append(
+                    f'      <entry index="{i + 1}"><type>{TYPE_OF[dev]}</type>'
+                    f"<device>{dev}</device><signal>{sig}</signal></entry>"
+                )
+            lines.append(f"    </{kind}>")
+        lines.append("  </adapter>")
+    lines.append("</root>")
+    path.write_text("\n".join(lines))
+
+
+def write_device_xml(path):
+    from freedm_tpu.devices.schema import DEFAULT_TYPES
+
+    lines = ["<root>"]
+    for t in DEFAULT_TYPES:
+        lines.append(f"  <deviceType><id>{t.id}</id>")
+        for s in t.states:
+            lines.append(f"    <state>{s}</state>")
+        for c in t.commands:
+            lines.append(f"    <command>{c}</command>")
+        lines.append("  </deviceType>")
+    lines.append("</root>")
+    path.write_text("\n".join(lines))
+
+
+def write_configs(tmp_path, ports):
+    write_adapter_xml(tmp_path / "adapter.xml", ports)
+    write_device_xml(tmp_path / "device.xml")
+    (tmp_path / "timings.cfg").write_text(
+        "\n".join(
+            f"{f.name.upper()} = {getattr(Timings(), f.name)}"
+            for f in dataclasses.fields(Timings)
+        )
+    )
+    (tmp_path / "topology.cfg").write_text(
+        "edge v0 v1\nedge v1 v2\n"
+        "sst v0 node0:50810\nsst v1 node1:50811\nsst v2 node2:50812\n"
+    )
+    (tmp_path / "freedm.cfg").write_text(
+        "hostname = node0\nport = 50810\n"
+        "add-host = node1:50811\nadd-host = node2:50812\n"
+        "vvc-case = vvc_9bus\nmigration-step = 1\n"
+        f"device-config = {tmp_path}/device.xml\n"
+        f"adapter-config = {tmp_path}/adapter.xml\n"
+        f"timings-config = {tmp_path}/timings.cfg\n"
+        f"topology-config = {tmp_path}/topology.cfg\n"
+    )
+
+
+# ---------------------------------------------------------------------------
+# The e2e itself
+# ---------------------------------------------------------------------------
+
+
+def _sub_env():
+    return dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+
+
+@pytest.fixture
+def plant_rig(tmp_path):
+    write_rig_xml(tmp_path / "rig.xml")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "freedm_tpu.sim.plantserver", str(tmp_path / "rig.xml")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=_sub_env(), text=True,
+    )
+    line = proc.stdout.readline()
+    try:
+        ports = [p for _, p in json.loads(line)["plantserver"]]
+    except Exception:
+        proc.terminate()
+        raise RuntimeError(
+            f"plantserver failed: {line!r} {proc.stderr.read()[:2000]}"
+        )
+    yield ports
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def test_cli_full_round_from_config_files(tmp_path, plant_rig):
+    write_configs(tmp_path, plant_rig)
+    out = subprocess.run(
+        [sys.executable, "-m", "freedm_tpu", "-c", str(tmp_path / "freedm.cfg"),
+         "--rounds", "12", "--summary-every", "1"],
+        capture_output=True, env=_sub_env(), text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    lines = [json.loads(l) for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 12
+    # One 3-node group formed (topology.cfg honored, all nodes alive).
+    assert lines[-1]["n_groups"] == 1
+    # LB migrated power (supply node0 has +20 kW surplus).
+    assert sum(l["migrations"] for l in lines) > 0
+    # VVC: rounds before the RTDS reveal are skipped (no actuation);
+    # once devices appear, losses decrease and stay non-increasing.
+    losses = [l["vvc_loss_kw"] for l in lines if "vvc_loss_kw" in l]
+    assert len(losses) >= 8, lines
+    tail = losses[3:]
+    assert all(b <= a + 1e-9 for a, b in zip(tail, tail[1:])), losses
+    assert tail[-1] < losses[0], losses
+    assert any(l.get("vvc_improved") for l in lines)
+
+    # The accepted Q setpoints crossed the wire: read the plant's state
+    # table back through node0's port and check the Q rows moved.
+    import socket
+
+    from freedm_tpu.devices.adapters.rtds import WIRE_DTYPE, read_exactly
+
+    tables = NODE_TABLES["node0:50810"]
+    with socket.create_connection(("127.0.0.1", plant_rig[0]), timeout=5) as s:
+        cmds = np.full(len(tables["commands"]), NULL_COMMAND, WIRE_DTYPE)
+        s.sendall(cmds.tobytes())
+        raw = read_exactly(s, 4 * len(tables["states"]))
+    states = np.frombuffer(raw, WIRE_DTYPE).astype(np.float64)
+    q_states = states[-12:]  # the Q{row}_{ph} gateway entries
+    assert np.abs(q_states).sum() > 0.0
+
+
+def test_cli_uuid_and_list_loggers(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "freedm_tpu", "-u", "-p", "1870"],
+        capture_output=True, env=_sub_env(), text=True, timeout=120,
+    )
+    assert out.returncode == 0 and out.stdout.strip() == "localhost:1870"
+    out = subprocess.run(
+        [sys.executable, "-m", "freedm_tpu", "--list-loggers"],
+        capture_output=True, env=_sub_env(), text=True, timeout=120,
+    )
+    assert out.returncode == 0
+
+
+def test_vvc_row_of_override_is_range_checked():
+    fleet, plant, feeder = build_vvc_plant_fleet()
+    vvc = VvcModule(fleet, feeder, row_of={"Q2_a": -1})
+    with pytest.raises(ValueError, match="outside feeder"):
+        vvc._row("Q2_a")
+
+
+def test_vvc_skips_rounds_without_actuation():
+    # All Sst_x devices gone: VVC must skip (publishing a model-only
+    # descent would claim control the plant never receives).
+    fleet, plant, feeder = build_vvc_plant_fleet()
+    manager = fleet.nodes[0].manager
+    for row in range(feeder.n_branches):
+        for ph in "abc":
+            manager.remove_device(f"Q{row}_{ph}")
+    vvc = VvcModule(fleet, feeder)
+    broker = build_broker(fleet, extra_modules=[vvc])
+    broker.run(n_rounds=3)
+    assert vvc.skipped_rounds == 3
+    assert "vvc" not in broker.shared
+
+
+def test_plant_pload_command_sets_phase_load():
+    fleet, plant, feeder = build_vvc_plant_fleet()
+    manager = fleet.nodes[0].manager
+    before = manager.get_state("Pl2_a", "pload")
+    manager.set_command("Pl2_a", "pload", before + 7.5)
+    assert manager.get_state("Pl2_a", "pload") == pytest.approx(before + 7.5)
+
+
+def test_build_runtime_rejects_unknown_owner(tmp_path):
+    write_device_xml(tmp_path / "device.xml")
+    (tmp_path / "adapter.xml").write_text(
+        '<root><adapter name="x" type="fake" owner="ghost:1">'
+        "<state><entry index=\"1\"><type>Sst</type><device>S</device>"
+        "<signal>gateway</signal></entry></state></adapter></root>"
+    )
+    from freedm_tpu.cli import build_runtime
+
+    cfg = GlobalConfig(
+        hostname="node0", port=50810,
+        device_config=str(tmp_path / "device.xml"),
+        adapter_config=str(tmp_path / "adapter.xml"),
+    )
+    with pytest.raises(ValueError, match="owner"):
+        build_runtime(cfg)
