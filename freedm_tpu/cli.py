@@ -186,6 +186,10 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         fid_names=fid_names,
         migration_step=cfg.migration_step,
         malicious=malicious,
+        # Deployed fleets (device transports configured) detect node
+        # failure from device health automatically; a node with no live
+        # devices — adapter died, PnP reaped, not yet joined — is down.
+        auto_liveness=bool(cfg.adapter_config or cfg.factory_port is not None),
     )
 
     vvc = None
@@ -201,11 +205,12 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         extra.append(vvc)
 
     if cfg.factory_port is not None:
-        # PnP session server lands with the pnp adapter type; until it is
-        # wired here the flag must not be a silent no-op.
-        logger.warn(
-            f"factory-port {cfg.factory_port} set but the PnP session "
-            "server is not started by this entry yet"
+        # Plug-and-play session server on this node's factory
+        # (PosixMain's factory-port → StartSessionProtocol).
+        factories[cfg.uuid].start_session_protocol(
+            bind=(cfg.address, cfg.factory_port),
+            heartbeat_s=timings.dev_pnp_heartbeat / 1000.0,
+            socket_timeout_s=timings.dev_socket_timeout / 1000.0,
         )
 
     invariant = omega_invariant() if cfg.check_invariant else None

@@ -42,6 +42,10 @@ class Adapter(ABC):
     def __init__(self) -> None:
         self._devices: List[str] = []
         self._revealed = False
+        #: Last fatal transport error (None = healthy).  Transport
+        #: adapters set this when their pump dies (e.g. the RTDS socket
+        #: failure path); the fleet's failure detector polls it.
+        self.error: object = None
 
     # -- registration -------------------------------------------------------
     def register_device(self, name: str) -> None:

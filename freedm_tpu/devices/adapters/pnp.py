@@ -1,0 +1,334 @@
+"""Plug-and-play session protocol: TCP server + dynamic device adapters.
+
+Reference: ``CPnpAdapter`` + ``CTcpServer`` + the session half of
+``CAdapterFactory`` (``Broker/src/device/CPnpAdapter.hpp:38-120``,
+``CTcpServer.cpp``, ``CAdapterFactory.cpp:522-760``) and the protocol
+spec in ``docs/devices/pnp_adapter.rst``:
+
+- ASCII messages over TCP, lines ``\\r\\n``-terminated, message ends
+  with a blank line;
+- ``Hello`` (controller id + ``Type Name`` device list) → DGI builds an
+  adapter, registers its devices, replies ``Start``;
+- then periodic ``DeviceStates`` from the device, each answered with a
+  ``DeviceCommands`` covering *every* command signal (``NULL_COMMAND``
+  = no command issued);
+- ``PoliteDisconnect`` → ``PoliteDisconnect/Accepted`` and a graceful
+  teardown;
+- silence for ``DEV_PNP_HEARTBEAT`` (default 5000 ms) kills the adapter
+  without notice and frees its device slots — the reference's countdown
+  timer self-destruction (``CPnpAdapter::Timeout``);
+- device names are namespaced ``controller:name`` with ``.`` → ``:``
+  (``CAdapterFactory.cpp:672-673``), duplicate live sessions are
+  rejected (``EDuplicateSession``), unknown device types get
+  ``BadRequest``.
+
+TPU-native shape: the server is plain threads writing into a
+:class:`~freedm_tpu.devices.adapters.base.BufferAdapter` staging buffer;
+arrival/departure are slot assignment/release on the owning
+:class:`~freedm_tpu.devices.manager.DeviceManager` (max-padding + alive
+mask, SURVEY.md §7 hard part v), surfaced to the fleet through
+``on_join``/``on_leave`` callbacks so failure detection can flip
+liveness without polling.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from freedm_tpu.core import logging as dgilog
+from freedm_tpu.core.config import NULL_COMMAND
+from freedm_tpu.devices.adapters.base import BufferAdapter
+from freedm_tpu.devices.manager import DeviceManager
+
+logger = dgilog.get_logger(__name__)
+
+# timings.cfg DEV_PNP_HEARTBEAT / DEV_SOCKET_TIMEOUT (ms → s).
+DEFAULT_HEARTBEAT_S = 5.0
+DEFAULT_SOCKET_TIMEOUT_S = 1.0
+
+CRLF = "\r\n"
+
+
+class PnpError(Exception):
+    """Protocol violation that ends the session with an Error reply."""
+
+
+class BadRequest(PnpError):
+    """Malformed client request (reference ``EBadRequest``)."""
+
+
+class PnpAdapter(BufferAdapter):
+    """One controller session's devices (the dynamic adapter).
+
+    Buffer entries are bound in Hello order — state and command indices
+    advance per signal exactly like the reference's ``sindex``/``cindex``
+    walk over the parsed Hello (``CAdapterFactory.cpp:676-705``).
+    """
+
+    def __init__(self, identifier: str):
+        super().__init__()
+        self.identifier = identifier
+        # (short_name, full_name, type) in Hello order.
+        self.entries: List[Tuple[str, str, str]] = []
+
+    def install_states_merge(self, new_state: np.ndarray) -> None:
+        """Install a DeviceStates buffer, keeping previous values where
+        the client sent ``NULL_COMMAND`` ("cannot give the DGI a state,
+        ignore it" — pnp_adapter.rst)."""
+        with self._lock:
+            if np.shape(new_state) != self._state.shape:
+                raise ValueError("state buffer size mismatch")
+            new = np.asarray(new_state, np.float32)
+            null = np.abs(new - NULL_COMMAND) <= 0.5
+            self._state = np.where(null, self._state, new)
+
+
+class PnpServer:
+    """TCP session server for plug-and-play device controllers.
+
+    The reference's ``CTcpServer`` + ``CAdapterFactory`` session logic:
+    one listener socket (``factory-port``), one session per controller.
+    """
+
+    def __init__(
+        self,
+        manager: DeviceManager,
+        bind: Tuple[str, int] = ("127.0.0.1", 0),
+        heartbeat_s: float = DEFAULT_HEARTBEAT_S,
+        socket_timeout_s: float = DEFAULT_SOCKET_TIMEOUT_S,
+        on_join: Optional[Callable[[str, PnpAdapter], None]] = None,
+        on_leave: Optional[Callable[[str, str], None]] = None,
+    ):
+        self.manager = manager
+        self.heartbeat_s = heartbeat_s
+        self.socket_timeout_s = socket_timeout_s
+        self.on_join = on_join
+        self.on_leave = on_leave  # (identifier, reason)
+        self.adapters: Dict[str, PnpAdapter] = {}
+        self._lock = threading.Lock()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(bind)
+        self._server.listen(8)
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self.sessions_started = 0
+        self.sessions_reaped = 0
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.getsockname()
+
+    def start(self) -> "PnpServer":
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            idents = list(self.adapters)
+        for ident in idents:
+            self._teardown(ident, "server stopped", notify=False)
+
+    # -- wire helpers --------------------------------------------------------
+    @staticmethod
+    def _read_message(conn: socket.socket) -> List[str]:
+        """Read one ``\\r\\n\\r\\n``-terminated message; the socket's
+        timeout is the heartbeat countdown (any read inactivity for
+        longer kills the session, ``CPnpAdapter::Timeout``)."""
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = conn.recv(4096)
+            if not chunk:
+                raise ConnectionError("client closed")
+            buf += chunk
+            if len(buf) > 1 << 20:
+                raise PnpError("message too large")
+        text, rest = buf.split(b"\r\n\r\n", 1)
+        if rest:
+            raise PnpError("pipelined packets are not supported")
+        return text.decode("ascii", errors="replace").split(CRLF)
+
+    @staticmethod
+    def _send(conn: socket.socket, *lines: str) -> None:
+        conn.sendall((CRLF.join(lines) + CRLF + CRLF).encode("ascii"))
+
+    # -- server loops --------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._server.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._session, args=(conn,), daemon=True)
+            t.start()
+
+    def _session(self, conn: socket.socket) -> None:
+        ident = None
+        try:
+            conn.settimeout(self.heartbeat_s)
+            try:
+                hello = self._read_message(conn)
+                ident, adapter = self._handle_hello(hello)
+            except BadRequest as e:
+                self._send(conn, "BadRequest", str(e))
+                return
+            except (PnpError, ValueError) as e:
+                self._send(conn, "Error", str(e))
+                return
+            except socket.timeout:
+                # Never said Hello: close without an adapter to reap
+                # (CAdapterFactory::Timeout sends a courtesy Error).
+                conn.settimeout(self.socket_timeout_s)
+                self._send(conn, "Error", "Connection closed due to timeout.")
+                return
+            self._send(conn, "Start")
+            self.sessions_started += 1
+            logger.status(f"pnp session started: {ident} ({len(adapter.entries)} devices)")
+            if self.on_join is not None:
+                self.on_join(ident, adapter)
+            self._active(conn, ident, adapter)
+        except (ConnectionError, OSError, socket.timeout):
+            if ident is not None:
+                self._teardown(ident, "heartbeat timeout")
+                self.sessions_reaped += 1
+        except PnpError as e:
+            if ident is not None:
+                try:
+                    conn.settimeout(self.socket_timeout_s)
+                    self._send(conn, "Error", str(e))
+                except OSError:
+                    pass
+                self._teardown(ident, f"protocol error: {e}")
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- protocol ------------------------------------------------------------
+    def _handle_hello(self, lines: List[str]) -> Tuple[str, PnpAdapter]:
+        if not lines or lines[0] != "Hello":
+            raise BadRequest(f"Expected 'Hello' message: {lines[0] if lines else ''}")
+        if len(lines) < 2 or not lines[1].strip():
+            raise BadRequest("Hello without controller identifier")
+        ident = lines[1].strip()
+        with self._lock:
+            if ident in self.adapters:
+                raise PnpError(f"Duplicate session for {ident}")
+        adapter = PnpAdapter(ident)
+        layout = self.manager.layout
+        sindex = cindex = 0
+        for line in lines[2:]:
+            if not line.strip():
+                continue
+            parts = line.split()
+            if len(parts) != 2:
+                raise BadRequest(f"malformed device line: {line!r}")
+            type_name, short = parts
+            if type_name not in layout.type_ids:
+                raise BadRequest(f"Unknown device type: {type_name}")
+            full = f"{ident}:{short}".replace(".", ":")
+            adapter.entries.append((short, full, type_name))
+            dtype_ = layout.type_of(type_name)
+            for sig in dtype_.states:
+                adapter.bind_state(full, sig, sindex)
+                sindex += 1
+            for sig in dtype_.commands:
+                adapter.bind_command(full, sig, cindex)
+                cindex += 1
+        if not adapter.entries:
+            raise BadRequest("Hello with no devices")
+        adapter.finalize_bindings()
+        try:
+            for _, full, type_name in adapter.entries:
+                self.manager.add_device(full, type_name, adapter)
+        except Exception:
+            self.manager.remove_adapter_devices(adapter)
+            raise
+        adapter.reveal_devices()
+        with self._lock:
+            self.adapters[ident] = adapter
+        return ident, adapter
+
+    def _active(self, conn: socket.socket, ident: str, adapter: PnpAdapter) -> None:
+        """The active session loop: DeviceStates in, DeviceCommands out."""
+        while not self._stop.is_set():
+            lines = self._read_message(conn)  # socket timeout = heartbeat
+            header = lines[0] if lines else ""
+            if header == "DeviceStates":
+                try:
+                    state = self._parse_states(lines[1:], adapter)
+                except BadRequest as e:
+                    # Malformed packet: dropped with an Error, session
+                    # lives on (pnp_adapter.rst: "often the DGI sends it
+                    # to indicate that a received packet ... was dropped").
+                    self._send(conn, "Error", str(e))
+                    continue
+                adapter.install_states_merge(state)
+                self._send_commands(conn, adapter)
+            elif header == "PoliteDisconnect":
+                self._send(conn, "PoliteDisconnect", "Accepted")
+                self._teardown(ident, "polite disconnect")
+                return
+            elif header == "Error":
+                logger.warn(f"pnp client {ident} error: {' '.join(lines[1:])}")
+            else:
+                self._send(conn, "Error", f"unexpected message: {header}")
+
+    def _parse_states(self, lines: List[str], adapter: PnpAdapter) -> np.ndarray:
+        """Validate a DeviceStates body: every state of every Hello
+        device present and numeric, no partial devices (the reference
+        rejects the whole message otherwise)."""
+        by_name = {short: full for short, full, _ in adapter.entries}
+        state = np.full(adapter.state_size, np.nan, np.float64)
+        for line in lines:
+            if not line.strip():
+                continue
+            parts = line.split()
+            if len(parts) != 3:
+                raise BadRequest(f"malformed state line: {line!r}")
+            short, sig, raw = parts
+            if short not in by_name:
+                raise BadRequest(f"unknown device: {short}")
+            if not adapter.has_state(by_name[short], sig):
+                raise BadRequest(f"unknown state {sig} for device {short}")
+            try:
+                value = float(raw)
+            except ValueError:
+                raise BadRequest(f"non-numeric value: {raw!r}") from None
+            state[adapter._state_index[(by_name[short], sig)]] = value
+        if np.isnan(state).any():
+            raise BadRequest("missing device states")
+        return state
+
+    def _send_commands(self, conn: socket.socket, adapter: PnpAdapter) -> None:
+        """All commands for all devices, every packet; NULL_COMMAND when
+        the DGI has nothing to issue (pnp_adapter.rst DeviceCommands)."""
+        full_to_short = {full: short for short, full, _ in adapter.entries}
+        cmd = adapter.command_buffer()
+        lines = ["DeviceCommands"]
+        for (full, sig), idx in sorted(
+            adapter._command_index.items(), key=lambda kv: kv[1]
+        ):
+            lines.append(f"{full_to_short[full]} {sig} {cmd[idx]:.6f}")
+        self._send(conn, *lines)
+
+    def _teardown(self, ident: str, reason: str, notify: bool = True) -> None:
+        with self._lock:
+            adapter = self.adapters.pop(ident, None)
+        if adapter is None:
+            return
+        self.manager.remove_adapter_devices(adapter)
+        logger.status(f"pnp session ended: {ident} ({reason})")
+        if notify and self.on_leave is not None:
+            self.on_leave(ident, reason)

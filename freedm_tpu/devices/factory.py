@@ -121,6 +121,7 @@ class AdapterFactory:
         self.manager = manager
         self.adapters: Dict[str, Adapter] = {}
         self._registry: Dict[str, AdapterCtor] = {}
+        self.session_server = None  # PnP (CAdapterFactory::m_server)
         self.register_type("fake", _make_fake)
         self.register_type("rtds", _make_rtds)
 
@@ -184,6 +185,18 @@ class AdapterFactory:
     def create_from_xml(self, source: Union[str, Path]) -> Tuple[Adapter, ...]:
         return tuple(self.create_adapter(s) for s in parse_adapter_xml(source))
 
+    def start_session_protocol(self, bind=("127.0.0.1", 0), **kwargs):
+        """Start the plug-and-play TCP session server on this factory's
+        manager (``CAdapterFactory::StartSessionProtocol``,
+        ``CAdapterFactory.cpp:522-534``); kwargs forward to
+        :class:`~freedm_tpu.devices.adapters.pnp.PnpServer`."""
+        if self.session_server is not None:
+            raise RuntimeError("session protocol already started")
+        from freedm_tpu.devices.adapters.pnp import PnpServer
+
+        self.session_server = PnpServer(self.manager, bind=bind, **kwargs).start()
+        return self.session_server
+
     def start(self) -> None:
         for a in self.adapters.values():
             a.start()
@@ -195,6 +208,9 @@ class AdapterFactory:
             a.stop()
             self.manager.remove_adapter_devices(a)
         self.adapters.clear()
+        if self.session_server is not None:
+            self.session_server.stop()
+            self.session_server = None
 
 
 def _make_fake(spec: AdapterSpec, manager: DeviceManager) -> Adapter:

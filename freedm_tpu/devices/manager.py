@@ -109,6 +109,16 @@ class DeviceManager:
             s = self._slots[name]
         s.adapter.set_command(name, signal, value)
 
+    def healthy(self) -> bool:
+        """At least one revealed device whose adapter has not errored —
+        the node-level health predicate of the failure detector
+        (:meth:`freedm_tpu.runtime.fleet.Fleet.refresh_liveness`)."""
+        with self._lock:
+            return any(
+                s.adapter.revealed and getattr(s.adapter, "error", None) is None
+                for s in self._slots.values()
+            )
+
     def get_net_value(self, type_name: str, signal: str) -> float:
         """Host-side sum over revealed devices of a type
         (``CDeviceManager::GetNetValue``); the jittable equivalent is

@@ -1,0 +1,113 @@
+"""Sample plug-and-play device controller (client half of the PnP
+session protocol).
+
+Reference: the FREEDM ``device-controller`` companion repository
+(``docs/devices/pnp_adapter.rst`` "Sample Device Controller"): a
+scriptable process that Hello-joins a DGI's factory port with a set of
+devices, then exchanges DeviceStates/DeviceCommands until disconnected.
+The script commands there (enable/change/work/dieHorribly) map to plain
+method calls here; tests drive them directly.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Dict, List, Optional, Tuple
+
+from freedm_tpu.core.config import NULL_COMMAND
+
+CRLF = "\r\n"
+
+
+class PnpClient:
+    """One device controller: owns devices, speaks the session protocol."""
+
+    def __init__(self, identifier: str, address: Tuple[str, int], timeout_s: float = 5.0):
+        self.identifier = identifier
+        self.address = address
+        self.timeout_s = timeout_s
+        # name -> (type, {state signal: value})
+        self.devices: Dict[str, Tuple[str, Dict[str, float]]] = {}
+        self.last_commands: Dict[Tuple[str, str], float] = {}
+        self._sock: Optional[socket.socket] = None
+
+    # -- script commands -----------------------------------------------------
+    def enable(self, type_name: str, name: str, **states: float) -> None:
+        """Add a device (the ``enable`` script command); reconnect to
+        refresh the Hello if already connected."""
+        self.devices[name] = (type_name, dict(states))
+        if self._sock is not None:
+            self.disconnect()
+
+    def change(self, name: str, signal: str, value: float) -> None:
+        self.devices[name][1][signal] = value
+
+    # -- protocol ------------------------------------------------------------
+    def connect(self) -> str:
+        """Hello → first reply header ('Start' on success)."""
+        self._sock = socket.create_connection(self.address, timeout=self.timeout_s)
+        lines = ["Hello", self.identifier]
+        lines += [f"{t} {n}" for n, (t, _) in self.devices.items()]
+        self._send(*lines)
+        reply = self._recv()
+        if not reply or reply[0] != "Start":
+            self.close()
+            return reply[0] if reply else ""
+        return "Start"
+
+    def exchange(self) -> Dict[Tuple[str, str], float]:
+        """One DeviceStates → DeviceCommands round; returns the non-NULL
+        commands as {(device, signal): value} (also kept in
+        ``last_commands``)."""
+        lines = ["DeviceStates"]
+        for name, (_, states) in self.devices.items():
+            for sig, val in states.items():
+                lines.append(f"{name} {sig} {val}")
+        self._send(*lines)
+        reply = self._recv()
+        if not reply or reply[0] != "DeviceCommands":
+            raise ConnectionError(f"expected DeviceCommands, got {reply[:1]}")
+        out: Dict[Tuple[str, str], float] = {}
+        for line in reply[1:]:
+            if not line.strip():
+                continue
+            name, sig, raw = line.split()
+            value = float(raw)
+            if abs(value - NULL_COMMAND) > 0.5:
+                out[(name, sig)] = value
+        self.last_commands = out
+        return out
+
+    def disconnect(self) -> None:
+        """PoliteDisconnect (graceful; the server frees the slots)."""
+        if self._sock is None:
+            return
+        try:
+            self._send("PoliteDisconnect")
+            self._recv()  # PoliteDisconnect / Accepted
+        except (OSError, ConnectionError):
+            pass
+        self.close()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # -- wire ----------------------------------------------------------------
+    def _send(self, *lines: str) -> None:
+        assert self._sock is not None, "not connected"
+        self._sock.sendall((CRLF.join(lines) + CRLF + CRLF).encode("ascii"))
+
+    def _recv(self) -> List[str]:
+        assert self._sock is not None, "not connected"
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = self._sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("server closed")
+            buf += chunk
+        return buf.split(b"\r\n\r\n", 1)[0].decode("ascii").split(CRLF)

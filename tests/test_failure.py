@@ -1,0 +1,175 @@
+"""Automatic failure detection: device health drives node liveness.
+
+The reference closes this loop with GM's AYC/AYT timeouts → Recovery
+(``gm/GroupManagement.cpp:513-552,851-893``) plus the transports'
+staleness detectors (RTDS socket death, PnP heartbeat).  Here the fleet
+derives each node's liveness from its device health every GM phase
+(``Fleet.refresh_liveness``): killing a plant server or silencing a PnP
+controller re-forms groups with **no** manual ``set_alive`` call.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from freedm_tpu.devices.adapters.plant import PlantAdapter
+from freedm_tpu.devices.adapters.pnp import PnpServer
+from freedm_tpu.devices.adapters.rtds import RtdsAdapter
+from freedm_tpu.devices.manager import DeviceManager
+from freedm_tpu.grid import cases
+from freedm_tpu.runtime import Fleet, NodeHandle, build_broker
+from freedm_tpu.sim.controller import PnpClient
+from freedm_tpu.sim.plantserver import PlantServer
+
+
+def wait_for(cond, timeout=5.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def make_rtds_node(gen_kw: float, load_kw: float):
+    """One DGI node backed by its own plant-server over a real socket."""
+    feeder = cases.vvc_9bus()
+    placements = {"SST": ("Sst", 2), "GEN": ("Drer", 1), "LOAD": ("Load", 0)}
+    plant = PlantAdapter(feeder, placements)
+    plant.set_generation("GEN", gen_kw)
+    plant.set_load("LOAD", load_kw)
+    plant.reveal_devices()
+    server = PlantServer(plant, period_s=0.01)
+    states = [("SST", "gateway"), ("GEN", "generation"), ("LOAD", "drain")]
+    host, port = server.add_port(states, [("SST", "gateway")])
+    server.start()
+    ad = RtdsAdapter(host, port, poll_s=0.01, socket_timeout_s=0.3)
+    for i, (d, s) in enumerate(states):
+        ad.bind_state(d, s, i)
+    ad.bind_command("SST", "gateway", 0)
+    manager = DeviceManager(capacity=8)
+    for name, (tname, _) in placements.items():
+        manager.add_device(name, tname, ad)
+    ad.start()
+    return manager, server, ad
+
+
+def test_plant_server_death_regroups_fleet_automatically():
+    nodes, servers, adapters = [], [], []
+    try:
+        for gen, load in [(30.0, 10.0), (10.0, 30.0), (20.0, 20.0)]:
+            m, srv, ad = make_rtds_node(gen, load)
+            nodes.append(m)
+            servers.append(srv)
+            adapters.append(ad)
+        fleet = Fleet(
+            [NodeHandle(f"n{i}:1", m) for i, m in enumerate(nodes)],
+            auto_liveness=True,
+        )
+        broker = build_broker(fleet)
+        # Wait for all adapters to reveal, then a full 3-node group.
+        wait_for(lambda: all(a.revealed for a in adapters), what="reveal")
+        broker.run(n_rounds=3)
+        g = broker.shared["group"]
+        assert int(g.n_groups) == 1 and int(g.group_size[0]) == 3
+
+        # Kill node 0's plant server mid-run.  NO set_alive anywhere:
+        # the dead socket errors the adapter, the next GM phase drops
+        # the node, and the survivors regroup.
+        servers[0].stop()
+        wait_for(lambda: adapters[0].error is not None, what="adapter error")
+        broker.run(n_rounds=3)
+        g = broker.shared["group"]
+        assert not fleet.nodes[0].alive
+        assert int(g.n_groups) == 1
+        assert int(g.coordinator[0]) == -1  # node 0 out of the group
+        assert np.asarray(g.group_mask)[1, 0] == 0
+    finally:
+        for a in adapters:
+            a.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_pnp_join_and_silence_regroup_fleet():
+    """Full PnP Done-criterion: Hello-join mid-run grows the group,
+    heartbeat silence shrinks it — group membership tracks the session
+    with no manual liveness management."""
+    from freedm_tpu.devices.adapters.fake import FakeAdapter
+
+    fake = FakeAdapter()
+    ma = DeviceManager(capacity=8)
+    ma.add_device("SST_A", "Sst", fake)
+    ma.add_device("GEN_A", "Drer", fake)
+    fake.reveal_devices()
+    fake.set_state("SST_A", "gateway", 0.0)
+    fake.set_state("GEN_A", "generation", 20.0)
+
+    mb = DeviceManager(capacity=8)
+    srv = PnpServer(mb, heartbeat_s=0.4).start()
+    try:
+        fleet = Fleet(
+            [NodeHandle("a:1", ma), NodeHandle("b:2", mb)], auto_liveness=True
+        )
+        broker = build_broker(fleet)
+        broker.run(n_rounds=2)
+        g = broker.shared["group"]
+        # Node B has no devices yet: it is down, A groups alone.
+        assert not fleet.nodes[1].alive
+        assert int(g.group_size[0]) == 1
+
+        c = PnpClient("ctrlB", srv.address)
+        c.enable("Sst", "sst", gateway=0.0)
+        c.enable("Load", "plant", drain=10.0)
+        assert c.connect() == "Start"
+        c.exchange()  # land the first DeviceStates before any round runs
+
+        import threading
+
+        pumping = threading.Event()
+        pumping.set()
+
+        def pump():
+            while pumping.is_set():
+                try:
+                    c.exchange()
+                except (ConnectionError, OSError):
+                    return
+                time.sleep(0.05)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        broker.run(n_rounds=3)
+        g = broker.shared["group"]
+        assert fleet.nodes[1].alive
+        assert int(g.n_groups) == 1 and int(g.group_size[0]) == 2
+        # The joined node's demand was served by LB.
+        assert int(broker.shared["lb_round"].state[1]) == -1  # DEMAND
+
+        # Silence → heartbeat reap → automatic regroup.
+        pumping.clear()
+        t.join(timeout=2)
+        wait_for(lambda: not mb.device_names(), timeout=3.0, what="reap")
+        broker.run(n_rounds=2)
+        g = broker.shared["group"]
+        assert not fleet.nodes[1].alive
+        assert int(g.group_size[0]) == 1
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_manual_disable_overrides_auto_liveness():
+    from freedm_tpu.devices.adapters.fake import FakeAdapter
+
+    fake = FakeAdapter()
+    m = DeviceManager(capacity=4)
+    m.add_device("SST", "Sst", fake)
+    fake.reveal_devices()
+    fleet = Fleet([NodeHandle("a:1", m)], auto_liveness=True)
+    fleet.refresh_liveness()
+    assert fleet.nodes[0].alive
+    fleet.set_alive(0, False)  # operator forces the node down
+    fleet.refresh_liveness()
+    assert not fleet.nodes[0].alive  # healthy devices do not resurrect it

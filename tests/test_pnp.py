@@ -1,0 +1,212 @@
+"""Plug-and-play session protocol tests.
+
+Reference behaviors under test (``docs/devices/pnp_adapter.rst``,
+``Broker/src/device/CPnpAdapter.cpp``, ``CAdapterFactory.cpp:522-760``):
+Hello → adapter creation → Start; DeviceStates answered by full
+DeviceCommands; NULL sentinels ignored both ways; malformed packets
+dropped with Error but the session lives; PoliteDisconnect frees slots
+gracefully; heartbeat silence reaps the adapter and frees its slots;
+duplicate live sessions rejected; unknown types BadRequest'd — and the
+dynamic devices feed a live LB fleet mid-run through real sockets.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from freedm_tpu.core.config import NULL_COMMAND
+from freedm_tpu.devices.adapters.pnp import PnpServer
+from freedm_tpu.devices.manager import DeviceManager
+from freedm_tpu.sim.controller import PnpClient
+from freedm_tpu.runtime import Fleet, NodeHandle, build_broker
+
+
+@pytest.fixture
+def server():
+    manager = DeviceManager(capacity=16)
+    events = []
+    srv = PnpServer(
+        manager,
+        heartbeat_s=0.4,
+        on_join=lambda ident, a: events.append(("join", ident)),
+        on_leave=lambda ident, reason: events.append(("leave", ident, reason)),
+    ).start()
+    yield srv, manager, events
+    srv.stop()
+
+
+def wait_for(cond, timeout=5.0, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_hello_start_states_commands_roundtrip(server):
+    srv, manager, events = server
+    c = PnpClient("ctrl1", srv.address)
+    c.enable("Sst", "sst", gateway=5.0)
+    c.enable("Drer", "solar", generation=12.5)
+    assert c.connect() == "Start"
+    assert ("join", "ctrl1") in events
+    # Devices registered under namespaced names, revealed, readable.
+    assert manager.device_names("Sst") == ("ctrl1:sst",)
+    assert c.exchange() == {}  # no commands staged yet
+    assert manager.get_state("ctrl1:sst", "gateway") == pytest.approx(5.0)
+    assert manager.get_state("ctrl1:solar", "generation") == pytest.approx(12.5)
+    # DGI stages a command; the next exchange delivers it.
+    manager.set_command("ctrl1:sst", "gateway", -3.0)
+    cmds = c.exchange()
+    assert cmds == {("sst", "gateway"): pytest.approx(-3.0)}
+    # NULL state values are ignored (previous reading kept).
+    c.change("sst", "gateway", NULL_COMMAND)
+    c.exchange()
+    assert manager.get_state("ctrl1:sst", "gateway") == pytest.approx(5.0)
+    c.disconnect()
+    wait_for(lambda: not manager.device_names(), what="slots freed")
+    assert ("leave", "ctrl1", "polite disconnect") in events
+
+
+def test_heartbeat_timeout_reaps_adapter_and_allows_rejoin(server):
+    srv, manager, events = server
+    c = PnpClient("ctrl2", srv.address)
+    c.enable("Load", "fridge", drain=2.0)
+    assert c.connect() == "Start"
+    c.exchange()
+    assert manager.device_names("Load") == ("ctrl2:fridge",)
+    # Go silent (socket open, no messages): the countdown must kill the
+    # adapter and free the slots without notice.
+    wait_for(
+        lambda: any(e[0] == "leave" and e[1] == "ctrl2" for e in events),
+        timeout=3.0,
+        what="heartbeat reap",
+    )
+    assert manager.device_names() == ()
+    assert srv.sessions_reaped == 1
+    c.close()
+    # The controller may restart the protocol from Hello.
+    c2 = PnpClient("ctrl2", srv.address)
+    c2.enable("Load", "fridge", drain=3.0)
+    assert c2.connect() == "Start"
+    c2.exchange()
+    assert manager.get_state("ctrl2:fridge", "drain") == pytest.approx(3.0)
+    c2.disconnect()
+
+
+def test_duplicate_session_rejected_and_bad_packets_survivable(server):
+    srv, manager, events = server
+    c = PnpClient("ctrl3", srv.address)
+    c.enable("Sst", "sst", gateway=0.0)
+    assert c.connect() == "Start"
+    # Same identifier, live session: rejected (EDuplicateSession).
+    dup = PnpClient("ctrl3", srv.address)
+    dup.enable("Sst", "sst", gateway=0.0)
+    assert dup.connect() == "Error"
+    # Unknown device type: BadRequest.
+    bad = PnpClient("ctrl4", srv.address)
+    bad.enable("Toaster", "t", heat=1.0)
+    assert bad.connect() == "BadRequest"
+    # Malformed DeviceStates (missing a state): Error, session survives.
+    c._send("DeviceStates", "sst gateway not-a-number")
+    reply = c._recv()
+    assert reply[0] == "Error"
+    assert c.exchange() == {}  # still alive
+    c.disconnect()
+
+
+def test_cli_runtime_starts_session_server():
+    # factory-port in the config starts the PnP server on the process's
+    # own node (PosixMain's StartSessionProtocol path).
+    from freedm_tpu.cli import build_runtime
+    from freedm_tpu.core.config import GlobalConfig
+
+    cfg = GlobalConfig(hostname="node0", port=50860, factory_port=0, address="127.0.0.1")
+    rt = build_runtime(cfg)
+    try:
+        srv = rt.factories[cfg.uuid].session_server
+        assert srv is not None
+        c = PnpClient("cli-ctrl", srv.address)
+        c.enable("Drer", "pv", generation=4.0)
+        assert c.connect() == "Start"
+        c.exchange()
+        assert rt.fleet.nodes[0].manager.get_state("cli-ctrl:pv", "generation") == pytest.approx(4.0)
+        c.disconnect()
+    finally:
+        rt.stop()
+
+
+def test_pnp_device_joins_lb_fleet_mid_run(server):
+    """A PnP controller Hello-joins mid-run, its devices flow into the
+    LB round (demand served), then silence reaps it and the fleet's
+    view of the node empties — all through sockets."""
+    srv, pnp_manager, events = server
+
+    # Node A: static supply (fake in-memory adapter). Node B: owns the
+    # PnP manager — its devices arrive dynamically.
+    from freedm_tpu.devices.adapters.fake import FakeAdapter
+
+    fake = FakeAdapter()
+    ma = DeviceManager(capacity=8)
+    ma.add_device("SST_A", "Sst", fake)
+    ma.add_device("GEN_A", "Drer", fake)
+    fake.reveal_devices()
+    fake.set_state("SST_A", "gateway", 0.0)
+    fake.set_state("GEN_A", "generation", 20.0)
+
+    fleet = Fleet(
+        [NodeHandle("a:1", ma), NodeHandle("b:2", pnp_manager)],
+        migration_step=1.0,
+    )
+    broker = build_broker(fleet)
+    broker.run(n_rounds=2)
+    out = broker.shared["lb_round"]
+    # Before the join: node B is empty, nothing to balance.
+    assert int(out.n_migrations) == 0
+
+    c = PnpClient("ctrlB", srv.address)
+    c.enable("Sst", "sst", gateway=0.0)
+    c.enable("Load", "plant", drain=10.0)
+    assert c.connect() == "Start"
+
+    # Pump exchanges on a thread so the heartbeat stays fresh while the
+    # broker compiles/runs (a real controller's periodic DeviceStates).
+    import threading
+
+    pumping = threading.Event()
+    pumping.set()
+
+    def pump():
+        while pumping.is_set():
+            try:
+                c.exchange()
+            except (ConnectionError, OSError):
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    broker.run(n_rounds=4)
+    # Node B's demand is visible and an import was commanded to its SST.
+    r = fleet.read_devices()
+    assert float(r["drain"][1]) == pytest.approx(10.0)
+    assert int(broker.shared["lb_round"].state[1]) == -1  # DEMAND
+    wait_for(
+        lambda: (c.last_commands.get(("sst", "gateway")) or 0.0) < 0.0,
+        what="import command over the wire",
+    )
+
+    # Silence: reap frees node B's devices; the fleet sees them vanish.
+    pumping.clear()
+    t.join(timeout=2)
+    wait_for(
+        lambda: any(e[0] == "leave" and e[1] == "ctrlB" for e in events),
+        timeout=3.0,
+        what="mid-run reap",
+    )
+    broker.run(n_rounds=2)
+    r = fleet.read_devices()
+    assert float(r["drain"][1]) == 0.0
+    c.close()
