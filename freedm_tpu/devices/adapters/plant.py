@@ -110,8 +110,11 @@ class PlantAdapter(Adapter):
         self.dt_hours = dt_hours
         self._rng = np.random.default_rng(seed)
         self._solve, _ = ladder.make_ladder_solver(feeder)
+        # Own copy: set_command('pload') mutates _s_base in place, and the
+        # feeder object is shared with the VVC model (whose staleness
+        # sentinel and base case must not drift with the plant).
         self._s_base = (
-            np.asarray(feeder.s_load, dtype=np.complex128)
+            np.array(feeder.s_load, dtype=np.complex128)
             if feeder_base_load
             else np.zeros((feeder.n_branches, 3), np.complex128)
         )

@@ -141,21 +141,25 @@ class PnpServer:
 
     # -- wire helpers --------------------------------------------------------
     @staticmethod
-    def _read_message(conn: socket.socket) -> List[str]:
+    def _read_message(conn: socket.socket, rbuf: bytearray) -> List[str]:
         """Read one ``\\r\\n\\r\\n``-terminated message; the socket's
         timeout is the heartbeat countdown (any read inactivity for
-        longer kills the session, ``CPnpAdapter::Timeout``)."""
-        buf = b""
-        while b"\r\n\r\n" not in buf:
+        longer kills the session, ``CPnpAdapter::Timeout``).
+
+        ``rbuf`` is the session's receive buffer: TCP gives no framing
+        guarantee, so bytes past the first terminator (a pipelined or
+        coalesced next message) stay buffered for the next call instead
+        of killing the session.
+        """
+        while b"\r\n\r\n" not in rbuf:
             chunk = conn.recv(4096)
             if not chunk:
                 raise ConnectionError("client closed")
-            buf += chunk
-            if len(buf) > 1 << 20:
+            rbuf += chunk
+            if len(rbuf) > 1 << 20:
                 raise PnpError("message too large")
-        text, rest = buf.split(b"\r\n\r\n", 1)
-        if rest:
-            raise PnpError("pipelined packets are not supported")
+        text, _, rest = bytes(rbuf).partition(b"\r\n\r\n")
+        rbuf[:] = rest
         return text.decode("ascii", errors="replace").split(CRLF)
 
     @staticmethod
@@ -174,10 +178,11 @@ class PnpServer:
 
     def _session(self, conn: socket.socket) -> None:
         ident = None
+        rbuf = bytearray()
         try:
             conn.settimeout(self.heartbeat_s)
             try:
-                hello = self._read_message(conn)
+                hello = self._read_message(conn, rbuf)
                 ident, adapter = self._handle_hello(hello)
             except BadRequest as e:
                 self._send(conn, "BadRequest", str(e))
@@ -191,12 +196,15 @@ class PnpServer:
                 conn.settimeout(self.socket_timeout_s)
                 self._send(conn, "Error", "Connection closed due to timeout.")
                 return
-            self._send(conn, "Start")
             self.sessions_started += 1
             logger.status(f"pnp session started: {ident} ({len(adapter.entries)} devices)")
+            # on_join strictly before Start: once the client sees Start
+            # it may proceed, so any observer must already know about
+            # the session (otherwise it races the client).
             if self.on_join is not None:
                 self.on_join(ident, adapter)
-            self._active(conn, ident, adapter)
+            self._send(conn, "Start")
+            self._active(conn, ident, adapter, rbuf)
         except (ConnectionError, OSError, socket.timeout):
             if ident is not None:
                 self._teardown(ident, "heartbeat timeout")
@@ -222,48 +230,61 @@ class PnpServer:
         if len(lines) < 2 or not lines[1].strip():
             raise BadRequest("Hello without controller identifier")
         ident = lines[1].strip()
+        # Reserve the identifier atomically (check + insert under one
+        # lock acquisition): two concurrent Hellos with the same id must
+        # not both pass, or the loser's teardown would reap the winner's
+        # live devices.
         with self._lock:
             if ident in self.adapters:
                 raise PnpError(f"Duplicate session for {ident}")
-        adapter = PnpAdapter(ident)
-        layout = self.manager.layout
-        sindex = cindex = 0
-        for line in lines[2:]:
-            if not line.strip():
-                continue
-            parts = line.split()
-            if len(parts) != 2:
-                raise BadRequest(f"malformed device line: {line!r}")
-            type_name, short = parts
-            if type_name not in layout.type_ids:
-                raise BadRequest(f"Unknown device type: {type_name}")
-            full = f"{ident}:{short}".replace(".", ":")
-            adapter.entries.append((short, full, type_name))
-            dtype_ = layout.type_of(type_name)
-            for sig in dtype_.states:
-                adapter.bind_state(full, sig, sindex)
-                sindex += 1
-            for sig in dtype_.commands:
-                adapter.bind_command(full, sig, cindex)
-                cindex += 1
-        if not adapter.entries:
-            raise BadRequest("Hello with no devices")
-        adapter.finalize_bindings()
+            self.adapters[ident] = None  # placeholder until built
         try:
-            for _, full, type_name in adapter.entries:
-                self.manager.add_device(full, type_name, adapter)
+            adapter = PnpAdapter(ident)
+            layout = self.manager.layout
+            sindex = cindex = 0
+            for line in lines[2:]:
+                if not line.strip():
+                    continue
+                parts = line.split()
+                if len(parts) != 2:
+                    raise BadRequest(f"malformed device line: {line!r}")
+                type_name, short = parts
+                if type_name not in layout.type_ids:
+                    raise BadRequest(f"Unknown device type: {type_name}")
+                full = f"{ident}:{short}".replace(".", ":")
+                adapter.entries.append((short, full, type_name))
+                dtype_ = layout.type_of(type_name)
+                for sig in dtype_.states:
+                    adapter.bind_state(full, sig, sindex)
+                    sindex += 1
+                for sig in dtype_.commands:
+                    adapter.bind_command(full, sig, cindex)
+                    cindex += 1
+            if not adapter.entries:
+                raise BadRequest("Hello with no devices")
+            adapter.finalize_bindings()
+            try:
+                for _, full, type_name in adapter.entries:
+                    self.manager.add_device(full, type_name, adapter)
+            except Exception:
+                self.manager.remove_adapter_devices(adapter)
+                raise
         except Exception:
-            self.manager.remove_adapter_devices(adapter)
+            with self._lock:
+                if self.adapters.get(ident) is None:
+                    self.adapters.pop(ident, None)
             raise
         adapter.reveal_devices()
         with self._lock:
             self.adapters[ident] = adapter
         return ident, adapter
 
-    def _active(self, conn: socket.socket, ident: str, adapter: PnpAdapter) -> None:
+    def _active(
+        self, conn: socket.socket, ident: str, adapter: PnpAdapter, rbuf: bytearray
+    ) -> None:
         """The active session loop: DeviceStates in, DeviceCommands out."""
         while not self._stop.is_set():
-            lines = self._read_message(conn)  # socket timeout = heartbeat
+            lines = self._read_message(conn, rbuf)  # socket timeout = heartbeat
             header = lines[0] if lines else ""
             if header == "DeviceStates":
                 try:

@@ -397,12 +397,15 @@ class VvcModule(DgiModule):
         else:
             import re
 
-            m = re.search(r"(\d+)", device)
-            if m is None:
+            # PnP devices are namespaced "ident:name" — a digit in the
+            # controller ident must not win, so parse only the bare name
+            # and take its last integer (Pl5_a → 5 even under "ctrl1:").
+            nums = re.findall(r"(\d+)", device.rsplit(":", 1)[-1])
+            if not nums:
                 raise ValueError(
                     f"VVC device {device!r}: no row_of entry and no integer in the name"
                 )
-            row = int(m.group(1))
+            row = int(nums[-1])
         # Range-check both paths: a row_of typo (e.g. -1) must not wrap
         # to the wrong branch silently.
         if not 0 <= row < self.feeder.n_branches:
@@ -448,14 +451,16 @@ class VvcModule(DgiModule):
                 for name in node.manager.device_names(f"Pload_{ph}"):
                     row = self._row(name)
                     val = node.manager.get_state(name, "pload")
-                    # Staleness sentinel: a reading still equal to the
-                    # configured default means the simulator hasn't
-                    # updated the signal — keep the default (reference's
-                    # exact-compare, with float tolerance for the f4
-                    # wire round-trip).
-                    if abs(val - s_load[row, pi].real) <= 1e-4 * max(
-                        1.0, abs(s_load[row, pi].real)
-                    ):
+                    # Staleness sentinel: the reference exact-compares
+                    # the reading against the row's default
+                    # ("Pl1_a" && xx == 80 → "Signal not updated!",
+                    # vvc/VoltVarCtrl.cpp:443-520).  A never-updated
+                    # RTDS buffer returns the default through the f4
+                    # wire, so the sentinel is the f4 round-trip of the
+                    # default — a live plant sitting at the (full-
+                    # precision) default value does NOT match and is
+                    # used.
+                    if val == float(np.float32(s_load[row, pi].real)):
                         self.stale_reads += 1
                     else:
                         s_load[row, pi] = val + 1j * s_load[row, pi].imag

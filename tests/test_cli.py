@@ -301,6 +301,53 @@ def test_vvc_row_of_override_is_range_checked():
         vvc._row("Q2_a")
 
 
+def test_vvc_row_ignores_pnp_namespace_digits():
+    # PnP devices are namespaced "ident:name"; a digit in the controller
+    # ident must not pick the branch row.
+    fleet, plant, feeder = build_vvc_plant_fleet()
+    vvc = VvcModule(fleet, feeder)
+    assert vvc._row("Q5_a") == 5
+    assert vvc._row("ctrl1:Q5_a") == 5
+    with pytest.raises(ValueError, match="no integer"):
+        vvc._row("ctrl1:Qx_a")
+
+
+def test_vvc_staleness_is_exact_f4_sentinel():
+    """A never-updated signal reads the f4 round-trip of the default →
+    stale; a plant legitimately at the full-precision default is used
+    (reference exact-compare, vvc/VoltVarCtrl.cpp:443-520)."""
+    fleet, plant, feeder = build_vvc_plant_fleet()
+    vvc = VvcModule(fleet, feeder)
+    default = float(np.asarray(feeder.s_load.real)[2, 0])  # -33.333... not f4-exact
+    assert default != float(np.float32(default))
+
+    readings = {}
+
+    def fake_get_state(name, sig):
+        return readings.get((name, sig), plant.get_state(name, sig))
+
+    manager = fleet.nodes[0].manager
+    # Wire-f4 round-trip of the default → stale (kept at default).
+    readings[("Pl2_a", "pload")] = float(np.float32(default))
+    # The exact float64 default → live, used as-is.
+    readings[("Pl2_b", "pload")] = default
+    orig = manager.get_state
+    manager.get_state = fake_get_state
+    try:
+        broker = build_broker(fleet, extra_modules=[vvc])
+        before = vvc.stale_reads
+        broker.run(n_rounds=1)
+    finally:
+        manager.get_state = orig
+    # Row 2 phase a counted stale; phase b (exact default) did not.
+    # Rows 1/4/7 have integer (f4-exact) defaults: those reads are
+    # indistinguishable from unset buffers and count stale, like the
+    # reference's "Pl1_a && xx == 80".
+    stale = vvc.stale_reads - before
+    n_f4_exact_rows = 4  # rows 0 (zero), 1, 4, 7 × 3 phases
+    assert stale == n_f4_exact_rows * 3 + 1
+
+
 def test_vvc_skips_rounds_without_actuation():
     # All Sst_x devices gone: VVC must skip (publishing a model-only
     # descent would claim control the plant never receives).
@@ -322,6 +369,16 @@ def test_plant_pload_command_sets_phase_load():
     before = manager.get_state("Pl2_a", "pload")
     manager.set_command("Pl2_a", "pload", before + 7.5)
     assert manager.get_state("Pl2_a", "pload") == pytest.approx(before + 7.5)
+
+
+def test_plant_pload_command_does_not_mutate_feeder():
+    # _s_base must be the plant's own copy: the feeder object is shared
+    # with the VVC controller model, whose base case and staleness
+    # sentinel must not drift when the plant's load is commanded.
+    fleet, plant, feeder = build_vvc_plant_fleet()
+    before = np.array(feeder.s_load)
+    fleet.nodes[0].manager.set_command("Pl2_a", "pload", 999.0)
+    assert np.array_equal(np.asarray(feeder.s_load), before)
 
 
 def test_build_runtime_rejects_unknown_owner(tmp_path):

@@ -117,6 +117,42 @@ def test_duplicate_session_rejected_and_bad_packets_survivable(server):
     c.disconnect()
 
 
+def test_pipelined_messages_in_one_segment(server):
+    """TCP gives no framing guarantee: Hello and the first DeviceStates
+    coalesced into one segment must both be processed, not kill the
+    session."""
+    import socket as socklib
+
+    srv, manager, events = server
+    s = socklib.create_connection(srv.address)
+    s.settimeout(5.0)
+    msg = (
+        "Hello\r\nctrlP\r\nSst sst\r\n\r\n"
+        "DeviceStates\r\nsst gateway 7.0\r\n\r\n"
+    )
+    s.sendall(msg.encode("ascii"))
+
+    rbuf = bytearray()
+
+    def recv_msg():
+        while b"\r\n\r\n" not in rbuf:
+            chunk = s.recv(4096)
+            if not chunk:
+                raise ConnectionError("server closed")
+            rbuf.extend(chunk)
+        text, _, rest = bytes(rbuf).partition(b"\r\n\r\n")
+        rbuf[:] = rest
+        return text.decode("ascii").split("\r\n")
+
+    assert recv_msg() == ["Start"]
+    reply = recv_msg()
+    assert reply[0] == "DeviceCommands"  # the pipelined states were served
+    assert manager.get_state("ctrlP:sst", "gateway") == pytest.approx(7.0)
+    s.sendall(b"PoliteDisconnect\r\n\r\n")
+    assert recv_msg()[0] == "PoliteDisconnect"
+    s.close()
+
+
 def test_cli_runtime_starts_session_server():
     # factory-port in the config starts the PnP server on the process's
     # own node (PosixMain's StartSessionProtocol path).
