@@ -58,8 +58,12 @@ class Runtime:
     fleet: Fleet
     factories: Dict[str, AdapterFactory]
     vvc: Optional[VvcModule] = None
+    endpoint: Optional[object] = None  # UdpEndpoint in federate mode
+    federation: Optional[object] = None
 
     def start(self) -> "Runtime":
+        if self.endpoint is not None:
+            self.endpoint.start()
         for f in self.factories.values():
             f.start()
         return self
@@ -67,6 +71,8 @@ class Runtime:
     def stop(self) -> None:
         for f in self.factories.values():
             f.stop()
+        if self.endpoint is not None:
+            self.endpoint.stop()
 
 
 def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
@@ -86,6 +92,9 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--logger-config", default=None, help="logger.cfg path")
     ap.add_argument("--timings-config", default=None, help="timings.cfg path")
     ap.add_argument("--topology-config", default=None, help="topology.cfg path")
+    ap.add_argument("--network-config", default=None, help="network.xml path")
+    ap.add_argument("--federate", action="store_true", default=None,
+                    help="treat add-host peers as remote processes over the DCN")
     ap.add_argument("--migration-step", type=float, default=None,
                     help="size of LB power migrations")
     ap.add_argument("--malicious-behavior", action="store_true", default=None,
@@ -116,6 +125,7 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("factory_port", "factory_port"), ("device_config", "device_config"),
         ("adapter_config", "adapter_config"), ("logger_config", "logger_config"),
         ("timings_config", "timings_config"), ("topology_config", "topology_config"),
+        ("network_config", "network_config"), ("federate", "federate"),
         ("migration_step", "migration_step"),
         ("malicious_behavior", "malicious_behavior"),
         ("check_invariant", "check_invariant"), ("verbose", "verbose"),
@@ -149,10 +159,14 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
 
     # Node axis: this process first, then peers in add-host order
     # (CConnectionManager::PutHost seeding, PosixMain.cpp:376-404).
+    # Federate mode: add-host entries are REMOTE processes (the
+    # reference's deployment shape); the local fleet is only this
+    # process's node(s).
     uuids: List[str] = [cfg.uuid]
-    for h in cfg.add_host:
-        if h not in uuids:
-            uuids.append(h)
+    if not cfg.federate:
+        for h in cfg.add_host:
+            if h not in uuids:
+                uuids.append(h)
 
     managers = {u: DeviceManager(layout) for u in uuids}
     factories = {u: AdapterFactory(managers[u]) for u in uuids}
@@ -160,6 +174,8 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         for spec in parse_adapter_xml(cfg.adapter_config):
             owner = spec.owner or cfg.uuid
             if owner not in factories:
+                if cfg.federate and owner in cfg.add_host:
+                    continue  # a remote process owns it; shared adapter.xml
                 raise ValueError(
                     f"adapter {spec.name!r}: owner {owner!r} is not a fleet node "
                     f"(nodes: {', '.join(uuids)})"
@@ -213,9 +229,41 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             socket_timeout_s=timings.dev_socket_timeout / 1000.0,
         )
 
+    endpoint = None
+    federation = None
+    if cfg.federate:
+        from freedm_tpu.dcn.endpoint import UdpEndpoint, load_network_config
+        from freedm_tpu.runtime.federation import Federation
+
+        peers = {}
+        for h in cfg.add_host:
+            host, _, port = h.rpartition(":")
+            if not host or not port.isdigit():
+                raise ValueError(
+                    f"federate mode needs add-host entries as host:port, got {h!r}"
+                )
+            peers[h] = (host, int(port))
+        bind_host = cfg.address or "0.0.0.0"
+        endpoint = UdpEndpoint(
+            cfg.uuid,
+            bind=(bind_host, cfg.port),
+            resend_time_s=timings.csrc_resend_time / 1000.0,
+            ttl_s=timings.csrc_default_timeout / 1000.0,
+        )
+        federation = Federation(
+            endpoint, peers, timings=timings, migration_step=cfg.migration_step
+        )
+        if cfg.network_config:
+            load_network_config(endpoint, cfg.network_config)
+
     invariant = omega_invariant() if cfg.check_invariant else None
-    broker = build_broker(fleet, timings, invariant=invariant, extra_modules=extra)
-    return Runtime(cfg, timings, broker, fleet, factories, vvc)
+    broker = build_broker(
+        fleet, timings, invariant=invariant, extra_modules=extra,
+        federation=federation,
+    )
+    if endpoint is not None:
+        endpoint.sink = broker.deliver
+    return Runtime(cfg, timings, broker, fleet, factories, vvc, endpoint, federation)
 
 
 def _round_summary(rt: Runtime) -> Dict[str, object]:
@@ -231,6 +279,18 @@ def _round_summary(rt: Runtime) -> Dict[str, object]:
     if vvc_out is not None:
         out["vvc_loss_kw"] = round(float(vvc_out.loss_after_kw), 6)
         out["vvc_improved"] = bool(vvc_out.improved)
+    readings = rt.fleet.last_readings
+    if readings is not None:
+        import numpy as np
+
+        out["gateway_total"] = round(float(np.sum(np.asarray(readings["gateway"]))), 6)
+    fed = rt.federation
+    if fed is not None:
+        out["fed_leader"] = fed.leader
+        out["fed_members"] = len(fed.members)
+        out["fed_state"] = fed.state
+        out["fed_migrations"] = fed.fed_migrations
+        out["fed_accepts"] = shared.get("dcn_accepts", 0)
     return out
 
 

@@ -188,6 +188,18 @@ class GlobalConfig:
     # Peers, as "host:port" strings (reference: add-host).
     add_host: List[str] = field(default_factory=list)
 
+    # Process model: False (default) hosts every add-host entry as a
+    # fleet row in this process (the single-process mesh emulation);
+    # True treats each add-host as a REMOTE process reachable over the
+    # DCN at its host:port — the reference's actual deployment shape —
+    # and federates groups/migrations with it
+    # (:mod:`freedm_tpu.runtime.federation`).
+    federate: bool = False
+
+    # network.xml reliability-injection config for the DCN endpoint
+    # (CConnectionManager::LoadNetworkConfig under CUSTOMNETWORK).
+    network_config: Optional[str] = None
+
     # Config file paths.
     device_config: Optional[str] = None
     adapter_config: Optional[str] = None

@@ -41,12 +41,18 @@ from freedm_tpu.devices.schema import read_xml_source
 
 @dataclass(frozen=True)
 class EntryBinding:
-    """One ``<entry>`` row: buffer index ↔ (type, device, signal)."""
+    """One ``<entry>`` row: buffer index ↔ (type, device, signal).
+
+    ``value`` is our extension: an initial state value, consumed by the
+    ``fake`` adapter so config-only rigs can seed device readings
+    without a live simulator.
+    """
 
     index: int  # 0-based (XML is 1-based, like the reference)
     type_name: str
     device: str
     signal: str
+    value: Optional[float] = None
 
 
 @dataclass(frozen=True)
@@ -89,6 +95,7 @@ def parse_adapter_xml(source: Union[str, Path]) -> Tuple[AdapterSpec, ...]:
                     type_name=e.findtext("type"),
                     device=e.findtext("device"),
                     signal=e.findtext("signal"),
+                    value=float(e.get("value")) if e.get("value") else None,
                 )
             )
         return tuple(out)
@@ -214,7 +221,12 @@ class AdapterFactory:
 
 
 def _make_fake(spec: AdapterSpec, manager: DeviceManager) -> Adapter:
-    return FakeAdapter()
+    seed = {
+        (e.device, e.signal): e.value
+        for e in spec.state + spec.command
+        if e.value is not None
+    }
+    return FakeAdapter(seed)
 
 
 def _make_rtds(spec: AdapterSpec, manager: DeviceManager) -> Adapter:

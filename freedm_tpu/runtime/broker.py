@@ -26,6 +26,7 @@ TPU-native differences:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -58,6 +59,10 @@ class Broker:
         self._timers: List[Tuple[float, str, Callable[[], None]]] = []
         self._timer_seq = 0
         self._timer_owner: Dict[str, str] = {}
+        # Phase queues are fed from two threads once a DCN endpoint is
+        # attached (its pump thread calls deliver() → schedule()); the
+        # lock covers only queue mutation, never task execution.
+        self._qlock = threading.Lock()
 
     # -- registration (CBroker::RegisterModule) ------------------------------
     def register_module(self, module: DgiModule, phase_time_ms: float) -> None:
@@ -94,7 +99,8 @@ class Broker:
         semantics); ``this_round=True`` targets the current round's
         still-pending phase queue."""
         ph = self._by_name[module_name]
-        (ph.queue if this_round else ph.next_queue).append(task)
+        with self._qlock:
+            (ph.queue if this_round else ph.next_queue).append(task)
 
     def allocate_timer(self, module_name: str) -> str:
         """Return a fresh timer handle bound to a module's phase.
@@ -180,8 +186,9 @@ class Broker:
         """Execute one full round: every phase in registration order."""
         for ph in self._phases:
             phase_start = time.time()
-            ph.queue.extend(ph.next_queue)
-            ph.next_queue = []
+            with self._qlock:
+                ph.queue.extend(ph.next_queue)
+                ph.next_queue = []
             self._fire_due_timers()
             ctx = PhaseContext(
                 round_index=self.round_index,
@@ -190,8 +197,12 @@ class Broker:
                 shared=self.shared,
             )
             # Drain queued work (messages + tasks), then the phase body.
-            while ph.queue:
-                task = ph.queue.pop(0)
+            # Tasks run outside the lock — they may schedule more work.
+            while True:
+                with self._qlock:
+                    if not ph.queue:
+                        break
+                    task = ph.queue.pop(0)
                 task()
             ph.module.run_phase(ctx)
             if realtime:

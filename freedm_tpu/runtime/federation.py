@@ -1,0 +1,637 @@
+"""Multi-process federation of the algorithm modules over the DCN.
+
+The reference DGI *is* N independent processes cooperating over UDP:
+group formation is the Garcia-Molina invitation election
+(``Broker/src/gm/GroupManagement.cpp:437-1330`` — Recovery / Check /
+Premerge / Merge / InviteGroupNodes / Reorganize / Timeout plus the
+AYC/AYT/Invite/Accept/PeerList handlers), power migrates between
+processes through the LB draft auction
+(``Broker/src/lb/LoadBalance.cpp:609-956`` — state announcement,
+DraftRequest → DraftAge → DraftSelect → DraftAccept/TooLate), and SC
+counts the Accept messages crossing its snapshot cut
+(``Broker/src/sc/StateCollection.cpp:539-558``).
+
+TPU-native split: *within* a process the fleet is one mesh program —
+groups are a jitted label propagation, LB one matching kernel — so the
+message protocols only survive at the process boundary, where they
+genuinely are distributed.  A :class:`Federation` rides the existing
+sans-IO SR transport (:mod:`freedm_tpu.dcn`) and federates *slices*
+(one process's whole fleet) instead of single SSTs:
+
+- **GM**: each process's broker is one participant in the invitation
+  election; the winner's process is the federation coordinator.  State
+  machine NORMAL/ELECTION/REORGANIZATION with the reference's message
+  vocabulary (``ayc``/``ayt`` probes + responses, ``invite``,
+  ``accept``, ``peer_list``), cadenced by the GM phase instead of
+  free-running boost timers: one :meth:`gm_step` per round is the
+  reference's Check/Timeout tick.
+- **LB**: after the local LB kernel balances the slice internally, the
+  slice's *total* imbalance (conserved under local migrations) drives a
+  process-level draft auction; an accepted draft moves one
+  ``migration_step`` of gateway between a chosen node of each slice.
+  The ``accept`` reply is routed to "lb", where the SC module's
+  subscription counts it as an in-transit Accept, exactly the
+  reference's cut semantics.
+- **SC**: every SC phase each process broadcasts its slice totals; the
+  union of fresh member states is the federated snapshot (the
+  synchronous-mesh stance applied across slices: all initiators at
+  once, no markers).
+
+Timeouts are hybrid: a deadline needs BOTH ``k`` elapsed rounds and a
+wall-clock minimum, so free-running tests (µs rounds) don't false-fire
+on one lost datagram and realtime fleets (seconds-long rounds) don't
+wait many rounds to notice a death.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, NamedTuple, Optional, Set, Tuple
+
+import numpy as np
+
+from freedm_tpu.core.config import Timings
+from freedm_tpu.dcn.endpoint import UdpEndpoint
+from freedm_tpu.runtime.messages import ModuleMessage
+
+# Federation GM states (GMAgent::EStatus, GroupManagement.hpp).
+NORMAL = "NORMAL"
+ELECTION = "ELECTION"
+REORGANIZATION = "REORGANIZATION"
+
+#: gm-recipient message types the federation consumes.
+GM_TYPES = frozenset(
+    {"ayc", "ayc_response", "ayt", "ayt_response", "invite", "accept", "peer_list"}
+)
+#: lb-recipient message types the federation consumes.  "accept" is
+#: deliberately shared with the SC subscription on "lb" so in-flight
+#: draft accepts are counted at the cut (StateCollection.cpp:539-558).
+LB_TYPES = frozenset(
+    {"state_change", "draft_request", "draft_age", "draft_select", "accept", "too_late"}
+)
+#: sc-recipient message types the federation consumes.
+SC_TYPES = frozenset({"sc_state"})
+
+
+def process_priority(uuid: str) -> int:
+    """Election priority = hash of the process uuid, the reference's
+    ``boost::hash<std::string>`` priority (GroupManagement.cpp:653-679).
+    md5 keeps it stable across interpreter runs (PYTHONHASHSEED-proof).
+    """
+    return int.from_bytes(hashlib.md5(uuid.encode()).digest()[:8], "big")
+
+
+class FederationView(NamedTuple):
+    """The process-level group as the modules see it."""
+
+    leader: str
+    members: Tuple[str, ...]  # sorted, includes self
+    state: str
+    is_coordinator: bool
+
+
+@dataclass
+class _Deadline:
+    """Hybrid round+wall-clock deadline (see module docstring)."""
+
+    round_index: int
+    wall: float
+
+    def expired(self, round_index: int, min_rounds: int, min_s: float) -> bool:
+        return (round_index - self.round_index) >= min_rounds and (
+            time.monotonic() - self.wall
+        ) >= min_s
+
+
+@dataclass
+class _PendingSelect:
+    """A DraftSelect in flight: exported power awaiting accept/too_late
+    (the reference's rollback window, LoadBalance.cpp:854-956)."""
+
+    amount: float
+    node_idx: int
+    deadline: _Deadline = field(default_factory=lambda: _Deadline(0, 0.0))
+
+
+class Federation:
+    """Process-level GM/LB/SC federation over a :class:`UdpEndpoint`.
+
+    ``peers`` maps remote process uuids (``host:port``) to their UDP
+    addresses; more peers are learned from invites/AYC responses like
+    the reference's ``CConnectionManager::PutHost`` path.
+    """
+
+    def __init__(
+        self,
+        endpoint: UdpEndpoint,
+        peers: Dict[str, Tuple[str, int]],
+        timings: Optional[Timings] = None,
+        migration_step: float = 1.0,
+        ttl_s: float = 10.0,
+    ):
+        t = timings or Timings()
+        self.endpoint = endpoint
+        self.uuid = endpoint.uuid
+        self.priority = process_priority(self.uuid)
+        self.migration_step = migration_step
+        self.ttl_s = ttl_s
+        # Wall-clock floors from timings.cfg (reference AYC/AYT/Invite
+        # response timeouts); the 2-round floor rides on top.
+        self.ayc_timeout_s = max(t.gm_ayc_response_timeout / 1000.0, 0.2)
+        self.ayt_timeout_s = max(t.gm_ayt_response_timeout / 1000.0, 0.2)
+        # Accepts are collected for the invite window; the invitee's
+        # Ready wait must comfortably outlast it or the two sides race
+        # (reference: INVITE_RESPONSE_TIMEOUT vs the recovery timer).
+        self.invite_timeout_s = max(t.gm_invite_response_timeout / 1000.0, 0.2)
+        self.ready_timeout_s = max(3 * self.invite_timeout_s, 0.8)
+        self.select_timeout_s = max(t.lb_request_timeout / 1000.0, 0.3)
+        self.member_timeout_s = max(2 * self.ayt_timeout_s, 0.5)
+        self.min_rounds = 2
+
+        self.known: Set[str] = set()
+        for uuid, addr in peers.items():
+            self.add_peer(uuid, addr)
+
+        # -- GM state (GMAgent members) --
+        self.state = NORMAL
+        self.leader = self.uuid
+        self._group_seq = 0
+        self.group_id = f"{self.uuid}#0"
+        self.members: Set[str] = {self.uuid}
+        self.coordinators: Set[str] = set()
+        self._pending_ayc: Dict[str, _Deadline] = {}
+        self._accepted: Set[str] = set()
+        self._member_seen: Dict[str, _Deadline] = {}
+        self._invite_since = _Deadline(0, time.monotonic())
+        self._ayt_ok = _Deadline(0, time.monotonic())
+        self._ayt_strikes = 0
+        self._reorg_since = _Deadline(0, time.monotonic())
+        self._round = 0
+        self.counters = {
+            "groups_formed": 0,
+            "groups_joined": 0,
+            "groups_broken": 0,
+            "elections": 0,
+        }
+
+        # -- LB state (LBAgent draft bookkeeping) --
+        self.lb_state = 0  # -1 demand / 0 normal / +1 supply (slice level)
+        self.demand_peers: Set[str] = set()
+        self._draft_ages: Dict[str, float] = {}
+        self._pending_select: Dict[str, _PendingSelect] = {}
+        self.fed_migrations = 0
+        self.fed_rollbacks = 0
+        # Per-local-node gateway delta accumulated by handlers this
+        # round; the LB module adds it to the kernel's output.
+        self._fed_delta: Optional[np.ndarray] = None
+        self._last_readings = None
+
+        # -- SC state --
+        self._peer_states: Dict[str, Tuple[Dict[str, float], _Deadline]] = {}
+
+    # ------------------------------------------------------------------
+    # plumbing
+    # ------------------------------------------------------------------
+    def add_peer(self, uuid: str, addr: Optional[Tuple[str, int]] = None) -> None:
+        if uuid == self.uuid:
+            return
+        if addr is None:
+            # Process uuids follow the reference's host:port discipline
+            # (PosixMain.cpp:73-77), so the UDP address is derivable —
+            # without it the endpoint would silently drop every frame
+            # for the peer until it messages us first.
+            host, _, port = uuid.rpartition(":")
+            if host and port.isdigit():
+                addr = (host, int(port))
+        self.endpoint.connect(uuid, addr)
+        self.known.add(uuid)
+
+    def _send(self, uuid: str, recipient: str, type_: str, **payload) -> None:
+        if uuid == self.uuid or uuid not in self.known:
+            return
+        msg = (
+            ModuleMessage(recipient, type_, payload, source=self.uuid)
+            .stamped()
+            .expiring(self.ttl_s)
+        )
+        try:
+            self.endpoint.send(uuid, msg)
+        except KeyError:
+            pass  # peer vanished between the check and the send
+
+    def _broadcast(self, uuids, recipient: str, type_: str, **payload) -> None:
+        for u in set(uuids):
+            self._send(u, recipient, type_, **payload)
+
+    def view(self) -> FederationView:
+        return FederationView(
+            leader=self.leader,
+            members=tuple(sorted(self.members)),
+            state=self.state,
+            is_coordinator=self.is_coordinator,
+        )
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.leader == self.uuid
+
+    def _now(self) -> _Deadline:
+        return _Deadline(self._round, time.monotonic())
+
+    # ------------------------------------------------------------------
+    # GM: the invitation election, one tick per GM phase
+    # ------------------------------------------------------------------
+    def gm_step(self, round_index: int) -> FederationView:
+        """The reference's timer loop collapsed onto the round cadence:
+        Check/Premerge/Merge for coordinators, Timeout (AYT) for
+        members, Reorganize one round after invites went out."""
+        self._round = round_index
+        if self.state == ELECTION:
+            # Hold the election open for the invite-response window so
+            # accepts can cross the wire even when rounds are µs-fast.
+            if self._invite_since.expired(round_index, 1, self.invite_timeout_s):
+                self._reorganize()
+        elif self.state == REORGANIZATION:
+            # Invited but the Ready/PeerList never came → Recovery
+            # (HandleInvite's recovery timer, GroupManagement.cpp:1128).
+            if self._reorg_since.expired(round_index, self.min_rounds, self.ready_timeout_s):
+                self.recovery()
+        elif self.is_coordinator:
+            self._check()
+        else:
+            self._timeout()
+        return self.view()
+
+    def _check(self) -> None:
+        """Coordinator tick: resolve the last AYC batch, evict silent
+        members, merge with lower-priority coordinators, probe again
+        (Check + Premerge + Merge, GroupManagement.cpp:513-772)."""
+        # Premerge's non-responder sweep.
+        changed = False
+        for u in [u for u, d in self._pending_ayc.items() if d.expired(self._round, self.min_rounds, self.ayc_timeout_s)]:
+            del self._pending_ayc[u]
+            if u in self.members:
+                self.members.discard(u)
+                changed = True
+        # Members that stopped AYT-ing are dead (the reference notices
+        # via the AYT group_id mismatch after its next election).
+        for u in list(self.members - {self.uuid}):
+            seen = self._member_seen.get(u)
+            if seen is not None and seen.expired(self._round, self.min_rounds, self.member_timeout_s):
+                self.members.discard(u)
+                self._member_seen.pop(u, None)
+                changed = True
+        if changed:
+            self.counters["groups_broken"] += 1
+            self._push_peer_list()
+        # Premerge's proportional wait, rank-resolved: only the highest-
+        # priority coordinator in sight merges; the others wait to be
+        # invited (wait_val_ = 0 iff myPriority is the max,
+        # GroupManagement.cpp:653-679).
+        if self.coordinators:
+            if all(process_priority(c) < self.priority for c in self.coordinators):
+                self._merge()
+                return
+            self.coordinators.clear()
+        # New AYC batch to every known peer outside my group.
+        for u in self.known - self.members:
+            if u not in self._pending_ayc:
+                self._send(u, "gm", "ayc", seq=self._round)
+                self._pending_ayc[u] = self._now()
+
+    def _merge(self) -> None:
+        """Invite every seen coordinator and my old members into a new
+        group (Merge + InviteGroupNodes, GroupManagement.cpp:710-813)."""
+        self.state = ELECTION
+        self.counters["elections"] += 1
+        self._group_seq += 1
+        self.group_id = f"{self.uuid}#{self._group_seq}"
+        targets = (self.coordinators | self.members) - {self.uuid}
+        self.coordinators.clear()
+        self._accepted = set()
+        self.members = {self.uuid}
+        self._invite_since = self._now()
+        addr = self.endpoint.address
+        self._broadcast(
+            targets,
+            "gm",
+            "invite",
+            group_id=self.group_id,
+            leader=self.uuid,
+            leader_addr=[addr[0], addr[1]],
+        )
+
+    def _reorganize(self) -> None:
+        """One round after invites: accepted peers are the group; push
+        the Ready/PeerList (Reorganize, GroupManagement.cpp:815-846)."""
+        self.members = {self.uuid} | self._accepted
+        self._accepted = set()
+        now = self._now()
+        for u in self.members - {self.uuid}:
+            self._member_seen[u] = now
+        self.state = NORMAL
+        self.counters["groups_formed"] += 1
+        self._push_peer_list()
+
+    def _timeout(self) -> None:
+        """Member tick: AYT the coordinator; silent/negative responses
+        beyond the strike budget → Recovery (Timeout + HandleResponseAYT,
+        GroupManagement.cpp:851-893,1210-1243)."""
+        if self._ayt_ok.expired(self._round, self.min_rounds, self.ayt_timeout_s):
+            self._ayt_strikes += 1
+            self._ayt_ok = self._now()
+            if self._ayt_strikes >= 2:
+                self.recovery()
+                return
+        self._send(self.leader, "gm", "ayt", group_id=self.group_id, seq=self._round)
+
+    def recovery(self) -> None:
+        """Fall back to a singleton group led by self (Recovery,
+        GroupManagement.cpp:437-466)."""
+        self.counters["groups_broken"] += 1
+        self._group_seq += 1
+        self.group_id = f"{self.uuid}#{self._group_seq}"
+        self.leader = self.uuid
+        self.members = {self.uuid}
+        self.state = NORMAL
+        self._ayt_strikes = 0
+        self._pending_ayc.clear()
+        self.coordinators.clear()
+        self._reset_lb()
+
+    def _push_peer_list(self) -> None:
+        self._broadcast(
+            self.members - {self.uuid},
+            "gm",
+            "peer_list",
+            group_id=self.group_id,
+            leader=self.uuid,
+            members=sorted(self.members),
+        )
+
+    # -- GM message handlers (HandleIncomingMessage switch) -------------
+    def handle_gm(self, msg: ModuleMessage) -> None:
+        src = msg.source
+        if not src or src == self.uuid:
+            return
+        self.known.add(src)  # ingress auto-registration learned it
+        p = msg.payload
+        t = msg.type
+        if t == "ayc":
+            # Reply yes iff coordinating in NORMAL (HandleAreYouCoordinator).
+            yes = self.is_coordinator and self.state == NORMAL
+            addr = self.endpoint.address
+            self._send(
+                src, "gm", "ayc_response",
+                answer="yes" if yes else "no",
+                leader=self.leader,
+                leader_addr=[addr[0], addr[1]] if yes else None,
+                seq=p.get("seq"),
+            )
+        elif t == "ayc_response":
+            if src not in self._pending_ayc:
+                return  # unsolicited (HandleResponseAYC's `expected`)
+            del self._pending_ayc[src]
+            if p.get("answer") == "yes":
+                self.coordinators.add(src)
+            else:
+                leader = p.get("leader")
+                if leader and leader != self.uuid:
+                    self.add_peer(leader)  # PutHost path
+                self.coordinators.discard(src)
+        elif t == "ayt":
+            ok = (
+                self.is_coordinator
+                and p.get("group_id") == self.group_id
+                and src in self.members
+            )
+            if ok:
+                self._member_seen[src] = self._now()
+            self._send(src, "gm", "ayt_response",
+                       answer="yes" if ok else "no", seq=p.get("seq"))
+        elif t == "ayt_response":
+            if p.get("answer") == "yes":
+                self._ayt_ok = self._now()
+                self._ayt_strikes = 0
+            elif src == self.leader:
+                self.recovery()
+        elif t == "invite":
+            self._handle_invite(src, p)
+        elif t == "accept":
+            # gm-recipient accept = invitation accept (HandleAccept).
+            if (
+                self.state == ELECTION
+                and self.is_coordinator
+                and p.get("group_id") == self.group_id
+            ):
+                self._accepted.add(src)
+        elif t == "peer_list":
+            if src == self.leader or p.get("leader") == self.leader:
+                self.members = set(p.get("members", [])) | {self.uuid}
+                if self.state == REORGANIZATION:
+                    self.counters["groups_joined"] += 1
+                self.state = NORMAL
+                self._ayt_ok = self._now()
+                self._ayt_strikes = 0
+
+    def _handle_invite(self, src: str, p: Dict) -> None:
+        """HandleInvite (GroupManagement.cpp:1072-1138): forward to my
+        old members if I led them, accept toward the new leader, wait
+        for Ready in REORGANIZATION."""
+        if self.state != NORMAL:
+            return
+        leader = p.get("leader", src)
+        addr = p.get("leader_addr")
+        if leader not in self.known and addr:
+            self.add_peer(leader, (addr[0], int(addr[1])))
+        old_members = self.members - {self.uuid}
+        was_coordinator = self.is_coordinator
+        self.group_id = p.get("group_id", "")
+        self.leader = leader
+        if was_coordinator and old_members:
+            self._broadcast(old_members, "gm", "invite", **p)
+        self._send(leader, "gm", "accept", group_id=self.group_id)
+        self.members = {self.uuid, leader}
+        self.state = REORGANIZATION
+        self._reorg_since = self._now()
+        self._ayt_ok = self._now()
+        self._ayt_strikes = 0
+        self._reset_lb()
+
+    # ------------------------------------------------------------------
+    # LB: the draft auction at slice granularity
+    # ------------------------------------------------------------------
+    def _reset_lb(self) -> None:
+        # Group changed: drafts against the old group are void.
+        self.demand_peers.clear()
+        self._draft_ages.clear()
+
+    def _ensure_delta(self, n: int) -> np.ndarray:
+        if self._fed_delta is None or self._fed_delta.shape[0] != n:
+            self._fed_delta = np.zeros(n)
+        return self._fed_delta
+
+    def _slice_imbalance(self) -> float:
+        """Total netgen − gateway over the local slice — conserved under
+        local LB migrations, so it is exactly what the slice can offer
+        to (or needs from) other processes."""
+        r = self._last_readings
+        if r is None:
+            return 0.0
+        return float(np.sum(np.asarray(r["netgen"]) - np.asarray(r["gateway"])))
+
+    def _pick_node(self, supply: bool) -> int:
+        """Choose which local node's gateway carries a federated step:
+        the biggest surplus (supply) or deficit (demand) node."""
+        r = self._last_readings
+        if r is None:
+            return 0
+        diff = np.asarray(r["netgen"]) - np.asarray(r["gateway"])
+        return int(np.argmax(diff) if supply else np.argmin(diff))
+
+    def lb_step(self, readings, n_local: int) -> np.ndarray:
+        """One LB-phase tick: classify the slice, announce/draft, and
+        return (consuming) the accumulated per-node gateway delta."""
+        self._last_readings = readings
+        step = self.migration_step
+        imbalance = self._slice_imbalance()
+        new_state = 1 if imbalance >= step else (-1 if imbalance <= -step else 0)
+        members = self.members - {self.uuid}
+        if self.state == NORMAL and members:
+            # Announce demand every round (idempotent — heals lost
+            # datagrams and late group joiners) and the exit from
+            # demand once (LBAgent's state announcements,
+            # LoadBalance.cpp:609-660).
+            if new_state == -1:
+                self._broadcast(members, "lb", "state_change", state="demand")
+            elif self.lb_state == -1:
+                self._broadcast(members, "lb", "state_change", state="normal")
+            if new_state == 1:
+                # Supply: pick the neediest known demand peer still in
+                # the group (DraftStandard's max-age choice) and select
+                # it; probe the rest for fresh ages.
+                ages = {
+                    u: a for u, a in self._draft_ages.items()
+                    if u in self.members and a >= step
+                }
+                if ages:
+                    target = max(ages, key=lambda u: ages[u])
+                    self._draft_ages.pop(target, None)
+                    if target not in self._pending_select:
+                        # Export starts now; TooLate rolls it back
+                        # (SendDraftSelect, LoadBalance.cpp:812-853).
+                        node = self._pick_node(supply=True)
+                        self._ensure_delta(n_local)[node] += step
+                        self._pending_select[target] = _PendingSelect(
+                            step, node, self._now()
+                        )
+                for u in self.demand_peers & self.members:
+                    if u not in self._pending_select:
+                        self._send(u, "lb", "draft_request")
+        self.lb_state = new_state
+        # Roll back selects nobody answered (lost peer / dropped link).
+        for u in list(self._pending_select):
+            ps = self._pending_select[u]
+            if ps.deadline.expired(self._round, self.min_rounds, self.select_timeout_s):
+                self._ensure_delta(n_local)[ps.node_idx] -= ps.amount
+                self.fed_rollbacks += 1
+                del self._pending_select[u]
+        # The actual sends for pending selects (sent once, here, so the
+        # delta accounting above stays single-writer).
+        for u, ps in self._pending_select.items():
+            if ps.deadline.round_index == self._round:
+                self._send(u, "lb", "draft_select", amount=ps.amount)
+        delta = self._ensure_delta(n_local)
+        self._fed_delta = None
+        return delta
+
+    @property
+    def fed_intransit(self) -> float:
+        """Exported-but-unconfirmed power (the reference's in-transit
+        window between DraftSelect and DraftAccept)."""
+        return float(sum(ps.amount for ps in self._pending_select.values()))
+
+    def handle_lb(self, msg: ModuleMessage, n_local: int) -> None:
+        src = msg.source
+        if not src or src == self.uuid:
+            return
+        p = msg.payload
+        t = msg.type
+        if t == "state_change":
+            if p.get("state") == "demand":
+                self.demand_peers.add(src)
+            else:
+                self.demand_peers.discard(src)
+        elif t == "draft_request":
+            # Reply with my age = slice deficit (SendDraftAge,
+            # LoadBalance.cpp:688-708).
+            age = max(-self._slice_imbalance(), 0.0)
+            self._send(src, "lb", "draft_age", age=age)
+        elif t == "draft_age":
+            if src in self.members:
+                self._draft_ages[src] = float(p.get("age", 0.0))
+        elif t == "draft_select":
+            amount = float(p.get("amount", 0.0))
+            if self.lb_state == -1 and src in self.members and amount > 0:
+                node = self._pick_node(supply=False)
+                self._ensure_delta(n_local)[node] -= amount
+                self._send(src, "lb", "accept", amount=amount)
+            else:
+                self._send(src, "lb", "too_late", amount=amount)
+        elif t == "accept":
+            ps = self._pending_select.pop(src, None)
+            if ps is not None:
+                self.fed_migrations += 1
+            else:
+                # Late accept: the select already timed out and rolled
+                # back, but the importer DID apply its -step (SR channels
+                # dedup, so this is no duplicate).  Re-apply the export
+                # or the federation's conserved total drifts by one step
+                # per loss-delayed accept.
+                amount = float(p.get("amount", 0.0))
+                if amount > 0:
+                    node = self._pick_node(supply=True)
+                    self._ensure_delta(n_local)[node] += amount
+                    self.fed_migrations += 1
+        elif t == "too_late":
+            ps = self._pending_select.pop(src, None)
+            if ps is not None:
+                # Roll the export back (HandleTooLate path).
+                self._ensure_delta(n_local)[ps.node_idx] -= ps.amount
+                self.fed_rollbacks += 1
+
+    # ------------------------------------------------------------------
+    # SC: federated slice snapshots
+    # ------------------------------------------------------------------
+    def sc_step(self, totals: Dict[str, float]) -> Dict[str, float]:
+        """Broadcast this slice's totals; aggregate fresh member states
+        into the federated snapshot (every process initiates at once —
+        the synchronous-mesh stance applied across slices)."""
+        members = self.members - {self.uuid}
+        if self.state == NORMAL and members:
+            self._broadcast(members, "sc", "sc_state", **totals)
+        agg = dict(totals)
+        agg["n_slices"] = 1
+        for u in members:
+            entry = self._peer_states.get(u)
+            if entry is None:
+                continue
+            state, seen = entry
+            if seen.expired(self._round, 3, 3 * self.ayt_timeout_s):
+                continue  # stale slice (partitioned peer)
+            for k, v in state.items():
+                agg[k] = agg.get(k, 0.0) + v
+            agg["n_slices"] += 1
+        return agg
+
+    def handle_sc(self, msg: ModuleMessage) -> None:
+        src = msg.source
+        if not src or src == self.uuid:
+            return
+        if msg.type == "sc_state":
+            self._peer_states[src] = (
+                {k: float(v) for k, v in msg.payload.items()},
+                self._now(),
+            )

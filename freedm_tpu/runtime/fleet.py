@@ -88,6 +88,9 @@ class Fleet:
         )
         self.priority = jnp.asarray(gm.node_priority(len(nodes)))
         self.plants: List = []  # adapters with a .step() to advance per round
+        # Last ingress snapshot (numpy-compatible dict) — the federation
+        # handlers pick migration nodes from it between phases.
+        self.last_readings: Optional[Dict[str, jnp.ndarray]] = None
 
     @property
     def n_nodes(self) -> int:
@@ -154,7 +157,7 @@ class Fleet:
             omegas = m.device_names("Omega")
             if omegas:
                 omega[i] = m.get_state(omegas[0], "frequency")
-        return {
+        self.last_readings = {
             "netgen": jnp.asarray(generation + storage - drain),
             "generation": jnp.asarray(generation),
             "storage": jnp.asarray(storage),
@@ -163,6 +166,7 @@ class Fleet:
             "fid_min": jnp.asarray(fid_min),
             "omega": jnp.asarray(omega),
         }
+        return self.last_readings
 
     def fid_states(self) -> jnp.ndarray:
         """Global FID closed/open vector in **topology order**.
@@ -232,15 +236,26 @@ class Fleet:
 
 
 class GmModule(DgiModule):
+    """Local group formation (one jitted kernel over the node axis) plus
+    the process-level invitation election when a
+    :class:`~freedm_tpu.runtime.federation.Federation` is attached."""
+
     name = "gm"
 
-    def __init__(self, fleet: Fleet):
+    def __init__(self, fleet: Fleet, federation=None):
         self.fleet = fleet
+        self.fed = federation
         self.last: Optional[gm.GroupState] = None
         self.counters = {"elections": 0, "groups_broken": 0}
         # Kernels must run compiled: eager op-by-op dispatch on TPU costs
         # ~1000x (each jnp op is a device round-trip).
         self._form = jax.jit(gm.form_groups)
+
+    def handle_message(self, msg, ctx=None) -> None:
+        from freedm_tpu.runtime.federation import GM_TYPES
+
+        if self.fed is not None and msg.type in GM_TYPES:
+            self.fed.handle_gm(msg)
 
     def run_phase(self, ctx: PhaseContext) -> None:
         fleet = self.fleet
@@ -262,14 +277,20 @@ class GmModule(DgiModule):
             self.counters["groups_broken"] += int(c.groups_broken)
         self.last = group
         ctx.shared["group"] = group
+        if self.fed is not None:
+            # The DCN-boundary election ticks once per GM phase (the
+            # reference's Check/Timeout timer cadence).
+            ctx.shared["federation"] = self.fed.gm_step(ctx.round_index)
 
 
 class ScModule(DgiModule):
     name = "sc"
 
-    def __init__(self, fleet: Fleet):
+    def __init__(self, fleet: Fleet, federation=None):
         self.fleet = fleet
+        self.fed = federation
         self._accepts = 0  # DCN-boundary Accepts seen on "lb"/"vvc"
+        self.total_accepts = 0  # cumulative, for operator tables
         self._collect = jax.jit(sc.collect)
 
     def handle_message(self, msg, ctx=None) -> None:
@@ -279,6 +300,12 @@ class ScModule(DgiModule):
         # lb_intransit ledger instead.
         if msg.type == "accept":
             self._accepts += 1
+            self.total_accepts += 1
+        elif self.fed is not None:
+            from freedm_tpu.runtime.federation import SC_TYPES
+
+            if msg.type in SC_TYPES:
+                self.fed.handle_sc(msg)
 
     def run_phase(self, ctx: PhaseContext) -> None:
         fleet = self.fleet
@@ -301,19 +328,37 @@ class ScModule(DgiModule):
         # belongs to, like the reference's num_intransit_accepts field.
         ctx.shared["dcn_accepts"] = self._accepts
         self._accepts = 0
+        if self.fed is not None:
+            # Federated cut: this slice's totals exchanged with the
+            # other member processes (CollectedStateMessage fields).
+            totals = {
+                "gateway": float(jnp.sum(r["gateway"])),
+                "generation": float(jnp.sum(r["generation"])),
+                "storage": float(jnp.sum(r["storage"])),
+                "drain": float(jnp.sum(r["drain"])),
+                "intransit": float(jnp.sum(intransit)) + self.fed.fed_intransit,
+            }
+            ctx.shared["fed_collected"] = self.fed.sc_step(totals)
 
 
 class LbModule(DgiModule):
     name = "lb"
 
-    def __init__(self, fleet: Fleet, invariant=None):
+    def __init__(self, fleet: Fleet, invariant=None, federation=None):
         self.fleet = fleet
         self.invariant = invariant  # callable(readings) -> [] 0/1 gate
+        self.fed = federation
         self.total_migrations = 0
         self.rounds = 0
         self._round = jax.jit(
             partial(lb.lb_round, migration_step=fleet.migration_step)
         )
+
+    def handle_message(self, msg, ctx=None) -> None:
+        from freedm_tpu.runtime.federation import LB_TYPES
+
+        if self.fed is not None and msg.type in LB_TYPES:
+            self.fed.handle_lb(msg, self.fleet.n_nodes)
 
     def run_phase(self, ctx: PhaseContext) -> None:
         fleet = self.fleet
@@ -329,7 +374,15 @@ class LbModule(DgiModule):
             malicious=fleet.malicious,
             invariant_ok=gate,
         )
-        fleet.write_gateways(np.asarray(out.gateway))
+        gateway = np.asarray(out.gateway)
+        if self.fed is not None:
+            # Cross-process drafts: the slice-level auction's accepted
+            # steps land on chosen local nodes on top of the kernel's
+            # within-slice balance (SendDraftSelect → SetPStar,
+            # lb/LoadBalance.cpp:812-853,1000-1075).
+            gateway = gateway + self.fed.lb_step(r, fleet.n_nodes)
+            ctx.shared["fed_intransit"] = self.fed.fed_intransit
+        fleet.write_gateways(gateway)
         ctx.shared["lb_intransit"] = out.intransit
         ctx.shared["lb_round"] = out
         self.total_migrations += int(out.n_migrations)
@@ -525,15 +578,18 @@ def build_broker(
     config: Optional[GlobalConfig] = None,
     invariant=None,
     extra_modules: Sequence[DgiModule] = (),
+    federation=None,
 ) -> Broker:
     """Wire the standard module stack (PosixMain.cpp:346-435 parity:
     GM, SC, LB phases in order with timings.cfg budgets, SC subscribed
-    to lb/vvc, plus fleet egress)."""
+    to lb/vvc, plus fleet egress).  ``federation`` attaches the
+    process-level GM/LB/SC protocols
+    (:class:`freedm_tpu.runtime.federation.Federation`)."""
     t = timings or Timings()
     broker = Broker()
-    gm_mod = GmModule(fleet)
-    sc_mod = ScModule(fleet)
-    lb_mod = LbModule(fleet, invariant=invariant)
+    gm_mod = GmModule(fleet, federation=federation)
+    sc_mod = ScModule(fleet, federation=federation)
+    lb_mod = LbModule(fleet, invariant=invariant, federation=federation)
     broker.register_module(gm_mod, t.gm_phase_time)
     broker.register_module(sc_mod, t.sc_phase_time)
     broker.register_module(lb_mod, t.lb_phase_time)
