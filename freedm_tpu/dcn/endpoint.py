@@ -86,21 +86,25 @@ class UdpEndpoint:
         self,
         uuid: str,
         addr: Optional[Tuple[str, int]] = None,
-        reliability: int = 100,
+        reliability: Optional[int] = None,
     ) -> SrChannel:
+        """Register (or update) a peer.  ``reliability=None`` keeps an
+        existing peer's injected loss setting — re-learning a peer from
+        protocol traffic must not silently reset network.xml."""
         with self._lock:
             st = self._peers.get(uuid)
             if st is None:
                 st = _PeerState(
                     SrChannel(uuid, self.resend_time_s, self.ttl_s, src_uuid=self.uuid),
                     addr,
-                    reliability,
+                    100 if reliability is None else reliability,
                 )
                 self._peers[uuid] = st
             else:
                 if addr is not None:
                     st.addr = addr
-                st.reliability = reliability
+                if reliability is not None:
+                    st.reliability = reliability
             return st.channel
 
     def transport_for(self, uuid: str) -> Callable[[str, ModuleMessage], None]:

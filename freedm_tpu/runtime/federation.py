@@ -310,6 +310,9 @@ class Federation:
         self.group_id = f"{self.uuid}#{self._group_seq}"
         targets = (self.coordinators | self.members) - {self.uuid}
         self.coordinators.clear()
+        # Probes outstanding against the OLD group are void: a stale
+        # non-response must not evict a freshly merged member.
+        self._pending_ayc.clear()
         self._accepted = set()
         self.members = {self.uuid}
         self._invite_since = self._now()
@@ -328,6 +331,7 @@ class Federation:
         the Ready/PeerList (Reorganize, GroupManagement.cpp:815-846)."""
         self.members = {self.uuid} | self._accepted
         self._accepted = set()
+        self._pending_ayc.clear()
         now = self._now()
         for u in self.members - {self.uuid}:
             self._member_seen[u] = now

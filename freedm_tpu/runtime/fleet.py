@@ -40,10 +40,72 @@ import jax.numpy as jnp
 import numpy as np
 
 from freedm_tpu.core.config import OMEGA_NOMINAL, GlobalConfig, Timings
+from freedm_tpu.devices import tensor as dt
 from freedm_tpu.devices.manager import DeviceManager
 from freedm_tpu.modules import gm, lb, sc
 from freedm_tpu.runtime.broker import Broker
 from freedm_tpu.runtime.module import DgiModule, PhaseContext
+
+
+def _make_ingress(layout):
+    """Compile the fleet-ingress reduction: stacked per-node device
+    tensors → the per-node scalars every module phase consumes.
+
+    This is the jittable counterpart of LB's ``ReadDevices``
+    (``lb/LoadBalance.cpp:382-402``) executed for the whole node axis at
+    once — masked sums over the padded tensor instead of per-device
+    Python loops (``CDeviceManager::GetNetValue``).
+    """
+    type_ids = dict(layout.type_ids)
+
+    def tid_of(name):
+        return type_ids.get(name, -99)  # never matches a live row
+
+    def idx_of(sig):
+        try:
+            return layout.signal_index(sig)
+        except (KeyError, ValueError):
+            return None
+
+    specs = {
+        "generation": (tid_of("Drer"), idx_of("generation")),
+        "storage": (tid_of("Desd"), idx_of("storage")),
+        "drain": (tid_of("Load"), idx_of("drain")),
+        "gateway": (tid_of("Sst"), idx_of("gateway")),
+    }
+    fid_tid, fid_idx = tid_of("Fid"), idx_of("state")
+    om_tid, om_idx = tid_of("Omega"), idx_of("frequency")
+
+    def ingress(state, tid, dev_alive, node_alive):
+        # state [N,cap,ns], tid [N,cap], dev_alive [N,cap], node_alive [N]
+        out = {}
+        for key, (t, s) in specs.items():
+            if s is None:
+                out[key] = jnp.zeros(state.shape[0], state.dtype)
+                continue
+            m = (tid == t).astype(state.dtype) * dev_alive
+            out[key] = jnp.sum(m * state[:, :, s], axis=1) * node_alive
+        out["netgen"] = out["generation"] + out["storage"] - out["drain"]
+        live = dev_alive * node_alive[:, None]
+        if fid_idx is None:
+            out["fid_min"] = jnp.ones(state.shape[0], state.dtype)
+        else:
+            fm = (tid == fid_tid).astype(state.dtype) * live
+            fv = jnp.where(fm > 0, state[:, :, fid_idx], jnp.inf)
+            fmin = jnp.min(fv, axis=1)
+            out["fid_min"] = jnp.where(jnp.isfinite(fmin), fmin, 1.0)
+        if om_idx is None:
+            out["omega"] = jnp.full(state.shape[0], OMEGA_NOMINAL, state.dtype)
+        else:
+            om = (tid == om_tid).astype(state.dtype) * live
+            cnt = jnp.sum(om, axis=1)
+            tot = jnp.sum(om * state[:, :, om_idx], axis=1)
+            out["omega"] = jnp.where(
+                cnt > 0, tot / jnp.maximum(cnt, 1.0), OMEGA_NOMINAL
+            )
+        return out
+
+    return jax.jit(ingress)
 
 
 @dataclass
@@ -91,6 +153,11 @@ class Fleet:
         # Last ingress snapshot (numpy-compatible dict) — the federation
         # handlers pick migration nodes from it between phases.
         self.last_readings: Optional[Dict[str, jnp.ndarray]] = None
+        # Per-node DeviceTensors from the last ingress: the live command
+        # path writes into these and replays them through
+        # manager.apply_commands (egress).
+        self._snapshots: Optional[List[dt.DeviceTensor]] = None
+        self._ingress = None  # compiled lazily from the shared layout
 
     @property
     def n_nodes(self) -> int:
@@ -127,45 +194,50 @@ class Fleet:
 
     # -- device ingress ------------------------------------------------------
     def read_devices(self) -> Dict[str, jnp.ndarray]:
-        """Per-node scalars from each node's devices.
+        """Per-node scalars from each node's devices, via the tensor.
 
-        Mirrors LB's ``ReadDevices`` (net generation = DRER + DESD −
-        Load, gateway from SST, ``lb/LoadBalance.cpp:382-402``) plus the
-        FID states GM needs and the Omega frequency the invariant
-        checks.
+        Each node's :meth:`DeviceManager.snapshot` pumps its adapters
+        into a :class:`~freedm_tpu.devices.tensor.DeviceTensor`; the
+        stacked tensors feed ONE jitted masked reduction over the node
+        axis (the "modules read the tensor on device" stance).  Mirrors
+        LB's ``ReadDevices`` (net generation = DRER + DESD − Load,
+        gateway from SST, ``lb/LoadBalance.cpp:382-402``) plus the FID
+        states GM needs and the Omega frequency the invariant checks.
         """
-        n = self.n_nodes
-        generation = np.zeros(n)
-        storage = np.zeros(n)
-        drain = np.zeros(n)
-        gateway = np.zeros(n)
-        fid_min = np.ones(n)
-        # Nodes without an Omega device read the nominal frequency (a
-        # NaN here would silently fail any numeric invariant gate).
-        omega = np.full(n, OMEGA_NOMINAL)
-        for i, node in enumerate(self.nodes):
-            if not node.alive:
-                continue
-            m = node.manager
-            generation[i] = m.get_net_value("Drer", "generation")
-            storage[i] = m.get_net_value("Desd", "storage")
-            drain[i] = m.get_net_value("Load", "drain")
-            gateway[i] = m.get_net_value("Sst", "gateway")
-            fids = m.device_names("Fid")
-            if fids:
-                fid_min[i] = min(m.get_state(f, "state") for f in fids)
-            omegas = m.device_names("Omega")
-            if omegas:
-                omega[i] = m.get_state(omegas[0], "frequency")
-        self.last_readings = {
-            "netgen": jnp.asarray(generation + storage - drain),
-            "generation": jnp.asarray(generation),
-            "storage": jnp.asarray(storage),
-            "drain": jnp.asarray(drain),
-            "gateway": jnp.asarray(gateway),
-            "fid_min": jnp.asarray(fid_min),
-            "omega": jnp.asarray(omega),
-        }
+        lay = self.nodes[0].manager.layout
+        for node in self.nodes[1:]:
+            other = node.manager.layout
+            if other is not lay and (
+                other.signals != lay.signals or other.type_ids != lay.type_ids
+            ):
+                # Same column vocabulary AND type-id assignment, or the
+                # stacked kernel (compiled from nodes[0]'s layout) would
+                # silently read wrong columns for this node.
+                raise ValueError(
+                    "fleet nodes must share one device layout for tensor ingress"
+                )
+        snaps = [node.manager.snapshot() for node in self.nodes]
+        self._snapshots = snaps
+        # Nodes may carry different capacities (PnP headroom differs);
+        # pad every tensor to the fleet max so one stacked kernel serves
+        # all — padding rows are dead (alive=0) and reduce to nothing.
+        cap = max(s.capacity for s in snaps)
+
+        def pad(x, fill=0):
+            short = cap - x.shape[0]
+            if short == 0:
+                return x
+            widths = ((0, short),) + ((0, 0),) * (x.ndim - 1)
+            return jnp.pad(x, widths, constant_values=fill)
+
+        if self._ingress is None:
+            self._ingress = _make_ingress(lay)
+        self.last_readings = self._ingress(
+            jnp.stack([pad(s.state) for s in snaps]),
+            jnp.stack([pad(s.type_id, -1) for s in snaps]),
+            jnp.stack([pad(s.alive) for s in snaps]),
+            self.alive_mask(),
+        )
         return self.last_readings
 
     def fid_states(self) -> jnp.ndarray:
@@ -218,12 +290,27 @@ class Fleet:
     def write_gateways(self, gateway: np.ndarray) -> None:
         """Push per-node gateway setpoints to each node's SSTs
         (``SetPStar`` → ``SetCommand("gateway")``,
-        ``lb/LoadBalance.cpp:1000-1075``)."""
+        ``lb/LoadBalance.cpp:1000-1075``) — written into the ingress
+        DeviceTensor and replayed through
+        :meth:`DeviceManager.apply_commands` (the tensor egress pump)."""
         for i, node in enumerate(self.nodes):
             if not node.alive:
                 continue
-            for name in node.manager.device_names("Sst"):
-                node.manager.set_command(name, "gateway", float(gateway[i]))
+            lay = node.manager.layout
+            if "Sst" not in lay.type_ids:
+                continue
+            snap = (
+                self._snapshots[i]
+                if self._snapshots is not None
+                else node.manager.snapshot()
+            )
+            t = dt.set_commands(
+                dt.clear_commands(snap),
+                lay.type_ids["Sst"],
+                lay.signal_index("gateway"),
+                jnp.asarray(float(gateway[i]), snap.command.dtype),
+            )
+            node.manager.apply_commands(t)
 
     def step_plants(self) -> None:
         for p in self.plants:
@@ -350,6 +437,16 @@ class LbModule(DgiModule):
         self.fed = federation
         self.total_migrations = 0
         self.rounds = 0
+        self.syncs = 0
+        # Prediction state (LBAgent::m_PredictedGateway /
+        # m_PowerDifferential): migrations build on the *predicted*
+        # gateway — which counts a malicious node's accepted-but-dropped
+        # steps — until a collected snapshot resynchronizes it against
+        # the actual device cut (Synchronize, lb/LoadBalance.cpp:1216-1231).
+        self.predicted: Optional[np.ndarray] = None  # [N]
+        self.power_differential: Optional[np.ndarray] = None  # [N] per-group K
+        self.normal: Optional[np.ndarray] = None  # [N] per-node target
+        self._synchronized = False
         self._round = jax.jit(
             partial(lb.lb_round, migration_step=fleet.migration_step)
         )
@@ -360,21 +457,60 @@ class LbModule(DgiModule):
         if self.fed is not None and msg.type in LB_TYPES:
             self.fed.handle_lb(msg, self.fleet.n_nodes)
 
+    def synchronize(self, collected: sc.CollectedState, readings) -> None:
+        """HandleCollectedState → Synchronize
+        (``lb/LoadBalance.cpp:1160-1231``): reset the power-differential
+        prediction from the consistent cut and the predicted gateway
+        from the actual device readings."""
+        self.power_differential = np.asarray(sc.invariant_total(collected))
+        self.normal = np.asarray(
+            lb.synchronize(
+                readings["gateway"],
+                sc.invariant_total(collected),
+                collected.members,
+            )
+        )
+        self.predicted = np.asarray(readings["gateway"])
+        self._synchronized = True
+        self.syncs += 1
+
     def run_phase(self, ctx: PhaseContext) -> None:
         fleet = self.fleet
         group: Optional[gm.GroupState] = ctx.shared.get("group")
         if group is None:
             return
         r = ctx.shared.get("readings") or fleet.read_devices()
+        # Close the SC→LB loop: a FRESH collected cut from this round's
+        # SC phase resynchronizes the prediction before migrating (a
+        # stale cut left in the blackboard after SC skipped must not).
+        cs: Optional[sc.CollectedState] = ctx.shared.get("collected")
+        if cs is not None and cs is not getattr(self, "_last_cs", None):
+            self.synchronize(cs, r)
+            self._last_cs = cs
         gate = None if self.invariant is None else self.invariant(r)
+        # Between synchronizations LB trusts its own prediction (the
+        # reference's m_PredictedGateway), not the devices.
+        if self._synchronized or self.predicted is None:
+            gw_in = r["gateway"]
+        else:
+            gw_in = jnp.asarray(self.predicted)
         out = self._round(
             r["netgen"],
-            r["gateway"],
+            gw_in,
             group.group_mask,
             malicious=fleet.malicious,
             invariant_ok=gate,
         )
-        gateway = np.asarray(out.gateway)
+        # Predicted gateway counts every *accepted* step (a malicious
+        # drop is invisible until the next collected cut):
+        # gateway_in + supply_delta − demand_accepted.
+        self.predicted = np.asarray(out.gateway + out.intransit)
+        self._synchronized = False
+        # Device writes apply only the honestly-actuated deltas on top
+        # of the ACTUAL readings — a malicious node's device never moves
+        # (it only accepted), which is exactly what makes the prediction
+        # drift until the next cut resynchronizes it.
+        gateway = np.asarray(r["gateway"] + (out.gateway - gw_in))
         if self.fed is not None:
             # Cross-process drafts: the slice-level auction's accepted
             # steps land on chosen local nodes on top of the kernel's
