@@ -258,11 +258,20 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
 
     invariant = omega_invariant() if cfg.check_invariant else None
     broker = build_broker(
-        fleet, timings, invariant=invariant, extra_modules=extra,
+        fleet, timings, config=cfg, invariant=invariant, extra_modules=extra,
         federation=federation,
     )
     if endpoint is not None:
+        from freedm_tpu.runtime.clocksync import ClockSynchronizer
+
         endpoint.sink = broker.deliver
+        # Federated processes phase-lock their realtime schedulers via
+        # the clock synchronizer (CBroker::m_synchronizer).  Sharing the
+        # federation's live peer set means leaders discovered at runtime
+        # get challenged too.
+        broker.attach_clock_sync(
+            ClockSynchronizer(cfg.uuid, federation.known, endpoint.send)
+        )
     return Runtime(cfg, timings, broker, fleet, factories, vvc, endpoint, federation)
 
 

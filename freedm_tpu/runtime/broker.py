@@ -48,7 +48,15 @@ class _Phase:
 class Broker:
     """Round-robin phase scheduler over registered modules."""
 
-    def __init__(self, clock_skew_s: float = 0.0):
+    def __init__(self, clock_skew_s: float = 0.0, clock: Callable[[], float] = time.time):
+        # ``clock`` is injectable so clock-sync tests can run brokers on
+        # deliberately offset host clocks.
+        self._clock = clock
+        self.clock_sync = None  # ClockSynchronizer (CBroker::m_synchronizer)
+        # The configured skew (freedm.cfg clock-skew-us) is a base the
+        # synchronizer's measured offset composes with, not a value it
+        # may clobber.
+        self._base_skew_s = clock_skew_s
         self.dispatcher = Dispatcher()
         self._phases: List[_Phase] = []
         self._by_name: Dict[str, _Phase] = {}
@@ -77,6 +85,16 @@ class Broker:
             module.name,
             lambda msg, m=module: m.handle_message(msg),
         )
+
+    def attach_clock_sync(self, clk) -> None:
+        """Attach a :class:`~freedm_tpu.runtime.clocksync.ClockSynchronizer`:
+        its messages bypass the phase queues (immediate dispatch — the
+        reference's unscheduled clk module, ``CDispatcher.cpp:68-103``)
+        and its measured offset feeds the phase alignment
+        (``SetClockSkew``)."""
+        self.clock_sync = clk
+        clk.clock = self._clock
+        self.dispatcher.register("clk", "clk", clk.handle_message, immediate=True)
 
     def subscribe(self, recipient: str, module: DgiModule) -> None:
         """Extra subscription (SC listening on "lb"/"vvc",
@@ -166,24 +184,38 @@ class Broker:
         for _, handle, task in due:
             self.schedule(self._timer_owner.get(handle, handle), task, this_round=True)
 
-    def _align(self) -> None:
-        """Wait for the next wall-clock round boundary (plus skew) when
-        off it — ChangePhase's time-of-day alignment so federated
-        brokers phase-lock without coordination.  Within the
-        ALIGNMENT_DURATION tolerance we are on-boundary (a round that
-        just ended on time) and no wait happens; past it (start-up, or a
-        phase overrun) we resynchronize by waiting out the remainder —
-        the reference's skip-to-catch-up."""
+    def _align(self) -> Optional[float]:
+        """Wait for the next wall-clock round boundary (on the skewed
+        virtual clock) when off it — ChangePhase's time-of-day alignment
+        so federated brokers phase-lock without coordination.  Within
+        the ALIGNMENT_DURATION tolerance we are on-boundary (a round
+        that just ended on time) and no wait happens; past it (start-up,
+        or a phase overrun) we resynchronize by waiting out the
+        remainder — the reference's skip-to-catch-up.  Returns the
+        boundary's virtual time (the round's alignment base)."""
         round_s = self.round_length_ms / 1000.0
         if round_s <= 0:
-            return
-        now = time.time() + self.clock_skew_s
+            return None
+        now = self._clock() + self.clock_skew_s
         into = now % round_s
         if into > ALIGNMENT_DURATION_MS / 1000.0:
             time.sleep(round_s - into)
+            return now + (round_s - into)
+        return now - into
 
-    def run_round(self, realtime: bool = False) -> None:
-        """Execute one full round: every phase in registration order."""
+    def run_round(self, realtime: bool = False, aligned_start: Optional[float] = None) -> None:
+        """Execute one full round: every phase in registration order.
+
+        Under realtime, EVERY phase boundary re-aligns to the shared
+        virtual clock (``aligned_start`` + the cumulative phase budget)
+        — the reference's per-phase ``ChangePhase`` alignment
+        (``CBroker.cpp:423-519``).  A phase overrun therefore skips
+        sleeps until caught up instead of shifting all later phases,
+        keeping federated brokers in the same phase mid-round.
+        """
+        if realtime and aligned_start is None:
+            aligned_start = self._clock() + self.clock_skew_s
+        budget_sum = 0.0
         for ph in self._phases:
             phase_start = time.time()
             with self._qlock:
@@ -206,11 +238,17 @@ class Broker:
                 task()
             ph.module.run_phase(ctx)
             if realtime:
-                spent = time.time() - phase_start
-                budget = ph.time_ms / 1000.0
-                if spent < budget:
-                    time.sleep(budget - spent)
+                budget_sum += ph.time_ms / 1000.0
+                target = aligned_start + budget_sum
+                now_v = self._clock() + self.clock_skew_s
+                if now_v < target:
+                    time.sleep(target - now_v)
         self.round_index += 1
+
+    def _apply_skew(self, offset_s: float) -> None:
+        """SetClockSkew: the synchronizer's measured offset feeds phase
+        alignment, on top of the configured base skew."""
+        self.clock_skew_s = self._base_skew_s + offset_s
 
     def run(self, n_rounds: Optional[int] = None, realtime: bool = False) -> int:
         """Run rounds until ``n_rounds`` or :meth:`stop`.
@@ -219,11 +257,14 @@ class Broker:
         """
         done = 0
         while not self._stop and (n_rounds is None or done < n_rounds):
+            if self.clock_sync is not None:
+                self.clock_sync.poll(apply=self._apply_skew)
+            boundary = None
             if realtime:
                 # Re-align EVERY round (ChangePhase does, CBroker.cpp:423-519):
                 # a phase overrun must not accumulate skew across rounds, or
                 # federated brokers drift out of phase-lock.
-                self._align()
-            self.run_round(realtime=realtime)
+                boundary = self._align()
+            self.run_round(realtime=realtime, aligned_start=boundary)
             done += 1
         return done

@@ -1,0 +1,214 @@
+"""Clock synchronization for federated realtime brokers.
+
+Reference: ``CClockSynchronizer`` (``Broker/src/CClockSynchronizer.cpp:165-369``)
+— every QUERY_INTERVAL each process sends a challenge (``Exchange``) to
+every peer; peers answer *immediately* (the clk module is unscheduled —
+``CDispatcher`` immediate delivery) with their raw clock reading and
+their offset table; the requester appends two (remote, local) sample
+points per response — one at challenge time, one at response time, so
+the half-RTT lag cancels — keeps ≤ 200 responses per peer, and fits a
+linear regression whose intercept is the peer clock offset and whose
+slope − 1 is the relative skew.  Transitive entries from the peer's
+table are adopted at reduced weight (−0.1 per hop).  The weighted
+average over all peers becomes this process's offset
+(``SetClockSkew``), which the broker's phase alignment adds to
+wall-clock time so federated processes change phases together.
+
+Differences here: times are float seconds (no ptime arithmetic), the
+transport is the DCN endpoint's SR channel, and the exchange cadence is
+driven by :meth:`poll` from the broker loop instead of an asio timer.
+The regression math follows the reference exactly, including its
+"points in the past, intercept from now" trick and the lag adjustment.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from freedm_tpu.runtime.messages import ModuleMessage
+
+#: ≤ this many challenge/response samples per peer enter the regression
+#: (MAX_REGRESSION_ENTRIES, CClockSynchronizer.cpp:47).
+MAX_REGRESSION_ENTRIES = 200
+#: Seconds between exchange rounds (QUERY_INTERVAL = 10000 ms).
+QUERY_INTERVAL_S = 10.0
+
+CLK_TYPES = frozenset({"exchange", "exchange_response"})
+
+
+@dataclass
+class _Entry:
+    offset: float  # peer_clock − my_clock, seconds
+    skew: float  # relative clock rate − 1
+    weight: float
+
+
+class ClockSynchronizer:
+    """Pairwise challenge/response clock agreement over the DCN.
+
+    ``send(uuid, msg)`` is the transport (usually
+    ``endpoint.send``); ``clock`` is injectable so tests can give two
+    synchronizers deliberately offset clocks.  Thread-safe: responses
+    arrive on the endpoint pump thread (immediate dispatch), polls run
+    on the broker thread.
+    """
+
+    def __init__(
+        self,
+        uuid: str,
+        peers,
+        send: Callable[[str, ModuleMessage], None],
+        clock: Callable[[], float] = time.time,
+        query_interval_s: float = QUERY_INTERVAL_S,
+        ttl_s: float = 4.0,
+    ):
+        self.uuid = uuid
+        # Kept by reference, snapshotted per exchange: a live set (e.g.
+        # Federation.known) lets runtime-discovered peers join the sync.
+        self.peers = peers
+        self._send = send
+        self.clock = clock
+        self.query_interval_s = query_interval_s
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        # (my uuid → peer uuid) tables, self entry pinned (offset 0, w 1).
+        self._table: Dict[str, _Entry] = {uuid: _Entry(0.0, 0.0, 1.0)}
+        self._queries: Dict[str, Tuple[int, float]] = {}
+        self._responses: Dict[str, List[Tuple[float, float]]] = {}
+        self._k = 0
+        self._last_exchange = 0.0
+        self.offset_s = 0.0  # my virtual-clock offset (m_myoffset)
+        self.skew = 0.0
+        self.exchanges = 0
+
+    # -- outgoing ------------------------------------------------------------
+    def poll(self, apply: Optional[Callable[[float], None]] = None) -> None:
+        """Fire an exchange round when the query interval elapsed
+        (the asio exchange timer collapsed onto the broker loop);
+        ``apply`` receives the updated offset (SetClockSkew)."""
+        now = time.monotonic()
+        if now - self._last_exchange < self.query_interval_s:
+            return
+        self._last_exchange = now
+        self.exchange()
+        if apply is not None:
+            apply(self.offset_s)
+
+    def exchange(self) -> None:
+        """Challenge every peer and refresh my offset/skew from the
+        current table (Exchange, CClockSynchronizer.cpp:296-369)."""
+        peers = [u for u in list(self.peers) if u != self.uuid]
+        with self._lock:
+            self._k += 1
+            k = self._k
+            for uuid in peers:
+                self._queries[uuid] = (k, self.clock())
+            # Weighted average over the table = my offset/skew.
+            self._table[self.uuid] = _Entry(0.0, 0.0, 1.0)
+            wsum = sum(e.weight for e in self._table.values())
+            if wsum > 0:
+                self.offset_s = (
+                    sum(e.weight * e.offset for e in self._table.values()) / wsum
+                )
+                self.skew = (
+                    sum(e.weight * e.skew for e in self._table.values()) / wsum
+                )
+        for uuid in peers:
+            self._post(uuid, "exchange", query=k)
+        self.exchanges += 1
+
+    def _post(self, uuid: str, type_: str, **payload) -> None:
+        msg = (
+            ModuleMessage("clk", type_, payload, source=self.uuid)
+            .stamped()
+            .expiring(self.ttl_s)
+        )
+        try:
+            self._send(uuid, msg)
+        except KeyError:
+            pass  # unknown peer: the endpoint never connected it
+
+    # -- incoming (immediate dispatch) ---------------------------------------
+    def handle_message(self, msg: ModuleMessage, ctx=None) -> None:
+        if msg.type == "exchange":
+            # Answer instantly with my raw (unsynchronized) reading and
+            # my table (HandleExchange + CreateExchangeResponse).
+            with self._lock:
+                table = [
+                    {"uuid": u, "offset": e.offset, "skew": e.skew, "weight": e.weight}
+                    for u, e in self._table.items()
+                ]
+            self._post(
+                msg.source,
+                "exchange_response",
+                response=msg.payload.get("query"),
+                sendtime=self.clock(),
+                table=table,
+            )
+        elif msg.type == "exchange_response":
+            self._handle_response(msg)
+
+    def _handle_response(self, msg: ModuleMessage) -> None:
+        """The regression (HandleExchangeResponse,
+        CClockSynchronizer.cpp:165-290), reference math preserved."""
+        sender = msg.source
+        now = self.clock()
+        p = msg.payload
+        remote = float(p.get("sendtime", 0.0))
+        with self._lock:
+            q = self._queries.get(sender)
+            if q is None or q[0] != p.get("response"):
+                return  # stale or unsolicited
+            challenge = q[1]
+            del self._queries[sender]
+            rlist = self._responses.setdefault(sender, [])
+            # Two points per response: remote reading vs challenge-side
+            # and response-side local times — the RTT straddle.
+            rlist.append((remote, challenge))
+            rlist.append((remote, now))
+            if len(rlist) > 2 * MAX_REGRESSION_ENTRIES:
+                del rlist[:2]
+            base = now
+            n = len(rlist)
+            sumx = sum(x - base for x, _ in rlist)
+            sumy = sum(y - base for _, y in rlist)
+            # Alternating sum: (response-side − challenge-side) local
+            # times = one RTT per pair; /n gives the half-RTT lag.
+            sumlag = 0.0
+            even = False
+            for _, y in rlist:
+                sumlag += (y - base) if even else -(y - base)
+                even = not even
+            lag = sumlag / n
+            xbar = sumx / n
+            ybar = sumy / n
+            tmp3 = sum((x - base - xbar) * (y - base - ybar) for x, y in rlist)
+            tmp4 = sum((x - base - xbar) ** 2 for x, _ in rlist)
+            fij = (tmp3 / tmp4) if tmp4 != 0.0 else 1.0
+            alpha = ybar - fij * xbar
+            alpha = alpha + lag if alpha <= 0 else alpha - lag
+            self._table[sender] = _Entry(-alpha, fij - 1.0, 1.0)
+            # Transitive entries: the peer's view of third processes,
+            # composed with my offset to the peer, trust reduced.
+            for te in p.get("table", ()):
+                u = te.get("uuid")
+                if u in (sender, self.uuid) or u is None:
+                    continue
+                wjl = float(te.get("weight", 0.0)) - 0.1
+                cur = self._table.get(u)
+                # Only adopt — a rejected entry must not leave a
+                # zero-weight placeholder that rebroadcasts forever.
+                if (0.0 if cur is None else cur.weight) < wjl:
+                    self._table[u] = _Entry(
+                        -alpha + float(te.get("offset", 0.0)),
+                        (fij - 1.0) + float(te.get("skew", 0.0)),
+                        wjl,
+                    )
+
+    # -- virtual clock -------------------------------------------------------
+    def virtual_now(self) -> float:
+        """This process's synchronized clock reading."""
+        return self.clock() + self.offset_s

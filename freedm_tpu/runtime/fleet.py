@@ -722,7 +722,9 @@ def build_broker(
     process-level GM/LB/SC protocols
     (:class:`freedm_tpu.runtime.federation.Federation`)."""
     t = timings or Timings()
-    broker = Broker()
+    broker = Broker(
+        clock_skew_s=(config.clock_skew_us / 1e6 if config is not None else 0.0)
+    )
     gm_mod = GmModule(fleet, federation=federation)
     sc_mod = ScModule(fleet, federation=federation)
     lb_mod = LbModule(fleet, invariant=invariant, federation=federation)

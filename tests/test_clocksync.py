@@ -1,0 +1,147 @@
+"""Clock synchronizer tests (VERDICT r3 item 3).
+
+Reference: ``CClockSynchronizer`` (``Broker/src/CClockSynchronizer.cpp:165-369``)
+— pairwise challenge/response, ≤200-sample linear regression, weighted
+offset/skew average feeding the broker's phase alignment.  Two realtime
+brokers with injected host-clock offsets must phase-lock within
+ALIGNMENT_DURATION.
+"""
+
+import threading
+import time
+
+import pytest
+
+from freedm_tpu.core.config import ALIGNMENT_DURATION_MS
+from freedm_tpu.dcn.endpoint import UdpEndpoint
+from freedm_tpu.runtime import Broker, DgiModule
+from freedm_tpu.runtime.clocksync import ClockSynchronizer
+from freedm_tpu.runtime.messages import ModuleMessage
+
+from test_federation import free_udp_ports
+
+
+def wire_pair(offset_a, offset_b):
+    """Two synchronizers on offset clocks, wired back-to-back (no UDP)."""
+    clocks = {
+        "a": lambda: time.time() + offset_a,
+        "b": lambda: time.time() + offset_b,
+    }
+    clks = {}
+
+    def send(src):
+        def _send(uuid, msg):
+            clks[uuid].handle_message(msg)
+
+        return _send
+
+    clks["a"] = ClockSynchronizer("a", ["b"], send("a"), clock=clocks["a"])
+    clks["b"] = ClockSynchronizer("b", ["a"], send("b"), clock=clocks["b"])
+    return clks["a"], clks["b"]
+
+
+def test_pairwise_exchange_agrees_virtual_clocks():
+    """±300 ms host offsets: after a few exchange rounds both virtual
+    clocks read the same time (each side meets halfway)."""
+    a, b = wire_pair(-0.3, +0.3)
+    for _ in range(4):
+        a.exchange()
+        b.exchange()
+        time.sleep(0.02)  # x-spread for the regression
+    # Offsets each ≈ half the 600 ms gap, in opposite directions.
+    assert a.offset_s == pytest.approx(0.3, abs=0.02)
+    assert b.offset_s == pytest.approx(-0.3, abs=0.02)
+    assert abs(a.virtual_now() - b.virtual_now()) < 0.02
+
+
+def test_regression_handles_many_samples_and_cap():
+    a, b = wire_pair(-0.1, +0.1)
+    for _ in range(250):  # beyond MAX_REGRESSION_ENTRIES
+        a.exchange()
+    assert len(a._responses["b"]) <= 400
+    assert a.offset_s == pytest.approx(0.1, abs=0.02)
+
+
+def test_transitive_table_entries_adopted():
+    """A peer's offset table seeds third-party entries at reduced trust
+    (HandleExchangeResponse table loop)."""
+    a, b = wire_pair(0.0, +0.2)
+    # b knows a third process "c" at +0.5 relative to itself.
+    from freedm_tpu.runtime.clocksync import _Entry
+
+    b._table["c"] = _Entry(0.5, 0.0, 1.0)
+    for _ in range(3):
+        a.exchange()
+        time.sleep(0.01)
+    assert "c" in a._table
+    # a's view of c = (b − a) + (c − b) ≈ 0.2 + 0.5.
+    assert a._table["c"].offset == pytest.approx(0.7, abs=0.03)
+    assert a._table["c"].weight == pytest.approx(0.9)
+
+
+class PhaseRecorder(DgiModule):
+    name = "rec"
+
+    def __init__(self):
+        self.starts = []
+
+    def run_phase(self, ctx):
+        self.starts.append(time.time())
+
+
+def test_realtime_brokers_phase_lock(tmp_path):
+    """Two realtime brokers on hosts whose clocks disagree by 400 ms
+    phase-lock: once synchronized, their round boundaries land within
+    ALIGNMENT_DURATION of each other (ChangePhase parity)."""
+    pa, pb = free_udp_ports(2)
+    uuid_a, uuid_b = f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"
+    offsets = {uuid_a: -0.2, uuid_b: +0.2}
+    brokers, recs, eps = {}, {}, {}
+    for uuid, port, peer in ((uuid_a, pa, uuid_b), (uuid_b, pb, uuid_a)):
+        clock = (lambda off: lambda: time.time() + off)(offsets[uuid])
+        broker = Broker(clock=clock)
+        rec = PhaseRecorder()
+        broker.register_module(rec, 1000)  # one 1 s phase = the round
+        ep = UdpEndpoint(uuid, bind=("127.0.0.1", port), sink=broker.deliver)
+        ep.connect(peer, ("127.0.0.1", int(peer.rsplit(":", 1)[1])))
+        clk = ClockSynchronizer(uuid, [peer], ep.send, query_interval_s=0.4)
+        broker.attach_clock_sync(clk)
+        ep.start()
+        brokers[uuid], recs[uuid], eps[uuid] = broker, rec, ep
+    threads = [
+        threading.Thread(target=lambda b=b: b.run(n_rounds=8, realtime=True))
+        for b in brokers.values()
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        # Without sync the 400 ms clock gap would keep the 1 s rounds
+        # 400 ms apart; with sync the final boundaries agree.
+        sa, sb = recs[uuid_a].starts, recs[uuid_b].starts
+        assert len(sa) == len(sb) == 8
+        last_diff = abs(sa[-1] - sb[-1]) % 1.0
+        last_diff = min(last_diff, 1.0 - last_diff)
+        assert last_diff <= ALIGNMENT_DURATION_MS / 1000.0, (sa, sb)
+        # And both brokers actually measured/applied a skew.
+        for uuid, broker in brokers.items():
+            assert broker.clock_skew_s == pytest.approx(-offsets[uuid], abs=0.05)
+    finally:
+        for ep in eps.values():
+            ep.stop()
+
+
+def test_immediate_dispatch_for_clk_messages():
+    """clk responses must not wait for a phase: the dispatcher delivers
+    them immediately (unscheduled module, CDispatcher.cpp:68-103)."""
+    broker = Broker()
+    got = []
+    clk = ClockSynchronizer("x", [], lambda u, m: got.append((u, m)))
+    broker.attach_clock_sync(clk)
+    broker.deliver(
+        ModuleMessage("clk", "exchange", {"query": 7}, source="y").stamped()
+    )
+    # Handled synchronously — no run_round happened.
+    assert got and got[0][0] == "y"
+    assert got[0][1].payload["response"] == 7
