@@ -19,8 +19,26 @@ TPU-first choices:
   are solver *arguments*, so a 1024-scenario Monte-Carlo batch or a
   118-way N-1 contingency screen is one ``vmap`` (Ybus re-assembles
   per-lane on device; reference re-forms it on host each round).
-* **Dense [2n, 2n] Jacobian solve on the MXU.**  At transmission sizes
-  (10²-10³ buses) batched dense LU beats sparse bookkeeping on TPU.
+* **Hand-assembled dense [2n, 2n] Jacobian, solved on the MXU.**  The
+  standard polar blocks (∂P/∂θ, ∂P/∂V, ∂Q/∂θ, ∂Q/∂V) assemble from two
+  [n, n] intermediates shared with the residual itself — no ``jacfwd``,
+  whose 2n forward passes cost O(n) more memory and flops.  At
+  transmission sizes (10²–10³ buses, batched) dense LU beats sparse
+  bookkeeping on TPU.
+
+**Memory plan for 10k+ meshed buses** (SURVEY §7 hard part (i)): the
+dense Jacobian is 8n² f32 bytes — 64 MB at n = 2k (fits, batched), but
+1.6 GB at n = 10k, so one lane fits a v5e chip while a 1024-lane batch
+does not.  The scale-out path, in order: (1) shard the *batch* axis
+over the mesh with ``pjit`` (each lane's LU stays chip-local — the
+shipped default, see ``freedm_tpu.parallel``); (2) matrix-free
+Newton–Krylov — residual JVPs via ``jax.jvp`` need only the [n, n]
+Ybus (O(n²) → O(n+m) with a ``segment_sum`` matvec), trading LU
+robustness for GMRES + preconditioning; (3) reduce fill: RCM-order the
+buses, then a banded LU as a Pallas kernel over the [2n, band] storage.
+The radial 10k case never needs any of this — the ladder sweep
+(:mod:`freedm_tpu.pf.ladder`) is O(n) — so (2)/(3) are documented
+design, not shipped code.
 """
 
 from __future__ import annotations
@@ -99,9 +117,43 @@ def make_newton_solver(
         f_q = jnp.where(v_free > 0, q_calc - q_sched, v - v_set)
         return jnp.concatenate([f_p, f_q])
 
+    eye2n = jnp.eye(2 * n)
+
     def _newton_step(x, y, p_sched, q_sched):
-        f = _residual(x, y, p_sched, q_sched)
-        jac = jax.jacfwd(_residual)(x, y, p_sched, q_sched)
+        """One NR update with the hand-assembled polar Jacobian.
+
+        With E_ij = θ_i − θ_j and the two shared intermediates
+
+            C_ij = V_i V_j (G_ij cos E_ij + B_ij sin E_ij)   (ΣC = P)
+            A_ij = V_i V_j (G_ij sin E_ij − B_ij cos E_ij)   (ΣA = Q)
+
+        the standard blocks collapse to (diagonals folded in):
+
+            ∂P/∂θ = A − diag(Q)        ∂P/∂V = C/Vⱼ + diag(P/V)
+            ∂Q/∂θ = −C + diag(P)       ∂Q/∂V = A/Vⱼ + diag(Q/V)
+
+        Rows of pinned quantities (slack θ, PV/slack V) are identity —
+        exactly the derivative of the masked residual, which
+        ``tests/test_newton.py`` checks against ``jax.jacfwd``.
+        """
+        theta, v = x[:n], x[n:]
+        ct, st = jnp.cos(theta), jnp.sin(theta)
+        cos_e = ct[:, None] * ct[None, :] + st[:, None] * st[None, :]
+        sin_e = st[:, None] * ct[None, :] - ct[:, None] * st[None, :]
+        vo = v[:, None] * v[None, :]
+        c_mat = vo * (y.re * cos_e + y.im * sin_e)
+        a_mat = vo * (y.re * sin_e - y.im * cos_e)
+        p_calc = jnp.sum(c_mat, axis=1)
+        q_calc = jnp.sum(a_mat, axis=1)
+        f_p = jnp.where(th_free > 0, p_calc - p_sched, theta)
+        f_q = jnp.where(v_free > 0, q_calc - q_sched, v - v_set)
+        f = jnp.concatenate([f_p, f_q])
+        h = a_mat - jnp.diag(q_calc)
+        nn = c_mat / v[None, :] + jnp.diag(p_calc / v)
+        j2 = -c_mat + jnp.diag(p_calc)
+        ll = a_mat / v[None, :] + jnp.diag(q_calc / v)
+        jac = jnp.block([[h, nn], [j2, ll]])
+        jac = jnp.where(free[:, None] > 0, jac, eye2n.astype(jac.dtype))
         dx = jnp.linalg.solve(jac, -f)
         return x + dx, jnp.max(jnp.abs(f * free))
 

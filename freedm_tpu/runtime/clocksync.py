@@ -63,7 +63,6 @@ class ClockSynchronizer:
         send: Callable[[str, ModuleMessage], None],
         clock: Callable[[], float] = time.time,
         query_interval_s: float = QUERY_INTERVAL_S,
-        ttl_s: float = 4.0,
     ):
         self.uuid = uuid
         # Kept by reference, snapshotted per exchange: a live set (e.g.
@@ -72,7 +71,6 @@ class ClockSynchronizer:
         self._send = send
         self.clock = clock
         self.query_interval_s = query_interval_s
-        self.ttl_s = ttl_s
         self._lock = threading.Lock()
         # (my uuid → peer uuid) tables, self entry pinned (offset 0, w 1).
         self._table: Dict[str, _Entry] = {uuid: _Entry(0.0, 0.0, 1.0)}
@@ -121,11 +119,12 @@ class ClockSynchronizer:
         self.exchanges += 1
 
     def _post(self, uuid: str, type_: str, **payload) -> None:
-        msg = (
-            ModuleMessage("clk", type_, payload, source=self.uuid)
-            .stamped()
-            .expiring(self.ttl_s)
-        )
+        # Deliberately NO wall-clock expiration: the dispatcher checks
+        # TTLs against the receiver's *unsynchronized* clock, so any
+        # skew beyond the TTL would drop every clk message — the exact
+        # condition the synchronizer exists to correct.  Freshness is
+        # enforced by the query-id match in _handle_response instead.
+        msg = ModuleMessage("clk", type_, payload, source=self.uuid).stamped()
         try:
             self._send(uuid, msg)
         except KeyError:

@@ -145,3 +145,30 @@ def test_immediate_dispatch_for_clk_messages():
     # Handled synchronously — no run_round happened.
     assert got and got[0][0] == "y"
     assert got[0][1].payload["response"] == 7
+
+
+def test_large_skew_survives_dispatcher_expiry():
+    """Hosts 10 s apart must still synchronize: clk messages carry no
+    wall-clock TTL, or the dispatcher's expiry check (made against the
+    receiver's UNsynchronized clock) would drop every exchange — the
+    exact condition the synchronizer exists to correct."""
+    brokers, clks = {}, {}
+
+    def send(src):
+        def _send(uuid, msg):
+            brokers[uuid].deliver(msg)  # dispatch path incl. expiry check
+
+        return _send
+
+    offs = {"a": -5.0, "b": +5.0}
+    for u, peer in (("a", "b"), ("b", "a")):
+        clock = (lambda o: lambda: time.time() + o)(offs[u])
+        brokers[u] = Broker(clock=clock)
+        clks[u] = ClockSynchronizer(u, [peer], send(u), clock=clock)
+        brokers[u].attach_clock_sync(clks[u])
+    for _ in range(4):
+        clks["a"].exchange()
+        clks["b"].exchange()
+        time.sleep(0.02)
+    assert clks["a"].offset_s == pytest.approx(5.0, abs=0.05)
+    assert clks["b"].offset_s == pytest.approx(-5.0, abs=0.05)
