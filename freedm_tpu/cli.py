@@ -205,7 +205,9 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         # Deployed fleets (device transports configured) detect node
         # failure from device health automatically; a node with no live
         # devices — adapter died, PnP reaped, not yet joined — is down.
-        auto_liveness=bool(cfg.adapter_config or cfg.factory_port is not None),
+        auto_liveness=bool(
+            cfg.adapter_config or cfg.factory_port is not None or cfg.mqtt_id
+        ),
     )
 
     vvc = None
@@ -219,6 +221,24 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             raise ValueError(f"unknown vvc feeder case {cfg.vvc_case!r}") from None
         vvc = VvcModule(fleet, feeder)
         extra.append(vvc)
+
+    if cfg.mqtt_id:
+        # MQTT plug-and-play on this node (the reference wires mqtt-id/
+        # mqtt-address/mqtt-subscribe into CMqttAdapter; these knobs
+        # were previously parsed but unconsumed).
+        from freedm_tpu.devices.factory import AdapterSpec
+
+        factories[cfg.uuid].create_adapter(
+            AdapterSpec(
+                name=f"mqtt-{cfg.mqtt_id}",
+                type="mqtt",
+                info={
+                    "id": cfg.mqtt_id,
+                    "address": cfg.mqtt_address,
+                    "subscribe": ",".join(cfg.mqtt_subscribe),
+                },
+            )
+        )
 
     if cfg.factory_port is not None:
         # Plug-and-play session server on this node's factory

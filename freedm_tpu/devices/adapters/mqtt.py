@@ -1,0 +1,372 @@
+"""MQTT plug-and-play adapter.
+
+Reference: ``CMqttAdapter`` (``Broker/src/device/CMqttAdapter.hpp:44-110``,
+``CMqttAdapter.cpp``) — an asynchronous MQTT client that:
+
+- subscribes to the ``join/#`` and ``leave/#`` channels to discover
+  plug-and-play devices (plus any configured extra subscriptions);
+- publishes ``join/DGIClient/1`` = "Connect" at start and
+  ``leave/DGIClient/1`` = "disconnect" at stop;
+- on ``join/<device>/...`` ACKs with ``<device>/1/ACK`` = "ACK" and
+  waits for the device's ``<device>/1/JSON`` self-description, from
+  which it registers the device (states from AOUT/DOUT groups, commands
+  from AIN/DIN);
+- tracks live state from ``<device>/1/AOUT/<idx>`` / ``DOUT`` topics
+  through the JSON's index reference;
+- ``SetCommand`` publishes the value on ``<device>/1/<idx>``;
+- on ``leave/<device>`` removes the device from the manager.
+
+The reference links Paho; here a minimal MQTT 3.1.1 client over a
+stdlib socket (CONNECT/CONNACK, SUBSCRIBE/SUBACK, QoS-0 PUBLISH,
+PINGREQ/PINGRESP, DISCONNECT) keeps the adapter dependency-free —
+``tests/test_mqtt.py`` runs it against an in-process broker stub.
+
+JSON device description (the reference's property tree, concretized)::
+
+    {"type": "Sst",
+     "AOUT": {"1": "gateway"},      # index -> state signal
+     "AIN":  {"1": "gateway"}}      # index -> command signal
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from freedm_tpu.core import logging as dgilog
+from freedm_tpu.devices.adapters.base import Adapter
+
+logger = dgilog.get_logger(__name__)
+
+# MQTT 3.1.1 control packet types (spec §2.2.1).
+CONNECT, CONNACK, PUBLISH, SUBSCRIBE, SUBACK = 1, 2, 3, 8, 9
+UNSUBSCRIBE, UNSUBACK, PINGREQ, PINGRESP, DISCONNECT = 10, 11, 12, 13, 14
+
+
+def encode_remaining_length(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        byte = n % 128
+        n //= 128
+        out.append(byte | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def encode_string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def packet(ptype: int, flags: int, payload: bytes) -> bytes:
+    return bytes([ptype << 4 | flags]) + encode_remaining_length(len(payload)) + payload
+
+
+def topic_matches(pattern: str, topic: str) -> bool:
+    """MQTT topic filter match (spec §4.7): ``+`` one level, ``#`` rest."""
+    pp, tp = pattern.split("/"), topic.split("/")
+    for i, part in enumerate(pp):
+        if part == "#":
+            return True
+        if i >= len(tp):
+            return False
+        if part != "+" and part != tp[i]:
+            return False
+    return len(pp) == len(tp)
+
+
+class MqttClient:
+    """Tiny blocking MQTT 3.1.1 client with a reader thread."""
+
+    def __init__(
+        self,
+        client_id: str,
+        host: str,
+        port: int,
+        on_message: Callable[[str, bytes], None],
+        keepalive_s: int = 60,
+        timeout_s: float = 5.0,
+    ):
+        self.client_id = client_id
+        self.on_message = on_message
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._wlock = threading.Lock()
+        self._packet_id = 0
+        self._stop = threading.Event()
+        self.error: Optional[Exception] = None
+        # CONNECT: protocol "MQTT" level 4, clean session.
+        var = encode_string("MQTT") + bytes([4, 0x02]) + struct.pack(">H", keepalive_s)
+        self._send(packet(CONNECT, 0, var + encode_string(client_id)))
+        ptype, _, body = self._read_packet()
+        if ptype != CONNACK or len(body) < 2 or body[1] != 0:
+            raise ConnectionError(f"MQTT CONNACK refused: {body!r}")
+        # The connect timeout must not outlive the handshake: traffic is
+        # device-driven, so idle gaps are normal and a timed-out recv
+        # would kill the reader thread.
+        self._sock.settimeout(None)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        # Keepalive: the 3.1.1 spec obliges the CLIENT to transmit
+        # within 1.5× the advertised interval or a compliant broker
+        # drops the connection.
+        self._pinger = threading.Thread(
+            target=self._keepalive, args=(max(keepalive_s / 2.0, 1.0),), daemon=True
+        )
+        self._pinger.start()
+
+    def _keepalive(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.ping()
+            except OSError:
+                return
+
+    def _send(self, data: bytes) -> None:
+        with self._wlock:
+            self._sock.sendall(data)
+
+    def _read_exactly(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("MQTT connection closed")
+            buf += chunk
+        return buf
+
+    def _read_packet(self) -> Tuple[int, int, bytes]:
+        head = self._read_exactly(1)[0]
+        length, shift = 0, 0
+        while True:
+            b = self._read_exactly(1)[0]
+            length |= (b & 0x7F) << shift
+            shift += 7
+            if not (b & 0x80):
+                break
+            if shift > 21:
+                raise ConnectionError("malformed remaining length")
+        return head >> 4, head & 0x0F, self._read_exactly(length) if length else b""
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ptype, _flags, body = self._read_packet()
+            except (OSError, ConnectionError) as e:
+                if not self._stop.is_set():
+                    self.error = e
+                return
+            if ptype == PUBLISH:
+                tlen = struct.unpack(">H", body[:2])[0]
+                topic = body[2 : 2 + tlen].decode()
+                payload = body[2 + tlen :]  # QoS 0: no packet id
+                try:
+                    self.on_message(topic, payload)
+                except Exception:
+                    logger.error("MQTT message handler failed", exc_info=True)
+            elif ptype == PINGREQ:
+                self._send(packet(PINGRESP, 0, b""))
+            # CONNACK handled in ctor; SUBACK/UNSUBACK are fire-and-forget.
+
+    def subscribe(self, topics: List[str], qos: int = 0) -> None:
+        self._packet_id += 1
+        body = struct.pack(">H", self._packet_id)
+        for t in topics:
+            body += encode_string(t) + bytes([qos])
+        self._send(packet(SUBSCRIBE, 0x02, body))
+
+    def publish(self, topic: str, payload: str) -> None:
+        self._send(packet(PUBLISH, 0, encode_string(topic) + payload.encode()))
+
+    def ping(self) -> None:
+        self._send(packet(PINGREQ, 0, b""))
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._send(packet(DISCONNECT, 0, b""))
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class MqttAdapter(Adapter):
+    """Join-channel plug-and-play over MQTT (CMqttAdapter parity).
+
+    ``address`` accepts the reference's ``tcp://host:port`` form.
+    Devices are registered in the ``manager`` as they join (namespaced
+    like PnP would be left to topic names — MQTT device names are
+    already broker-global) and removed when they leave.
+    """
+
+    def __init__(
+        self,
+        manager,
+        client_id: str = "DGIClient",
+        address: str = "tcp://localhost:1883",
+        subscriptions: Tuple[str, ...] = (),
+    ):
+        super().__init__()
+        self.manager = manager
+        self.client_id = client_id
+        self.subscriptions = tuple(subscriptions)
+        addr = address[6:] if address.startswith("tcp://") else address
+        host, _, port = addr.rpartition(":")
+        self.host, self.port = host or "localhost", int(port or 1883)
+        self.client: Optional[MqttClient] = None
+        self._lock = threading.Lock()
+        # device -> {signal: value}; device -> {"AOUT/1": signal}.
+        self._values: Dict[str, Dict[str, float]] = {}
+        self._index_ref: Dict[str, Dict[str, str]] = {}
+        self._cmd_index: Dict[str, Dict[str, str]] = {}
+        self.error: Optional[Exception] = None
+
+    def register_device(self, name: str) -> None:
+        # Dynamic plug-and-play: joins arrive after reveal by design
+        # (unlike buffer adapters, whose device set is fixed at create).
+        self._devices.append(name)
+
+    def can_command(self, device: str, signal: str) -> bool:
+        with self._lock:
+            return signal in self._cmd_index.get(device, {})
+
+    # -- lifecycle (CMqttAdapter::Start/Stop) --------------------------------
+    def start(self) -> None:
+        try:
+            self.client = MqttClient(
+                self.client_id, self.host, self.port, self._handle
+            )
+            subs = ["join/#", "leave/#"]
+            for s in self.subscriptions:
+                subs += [f"{s}/1/JSON", f"{s}/1/AOUT/#", f"{s}/1/DOUT/#", f"{s}/1/ACK"]
+            self.client.subscribe(subs, qos=0)
+            self.client.publish(f"join/{self.client_id}/1", "Connect")
+        except (OSError, ConnectionError) as e:
+            # Error, not crash (ConnectionLost parity): the failure
+            # detector sees adapter.error and marks the node unhealthy.
+            self.error = e
+            logger.error(f"MQTT broker unreachable at {self.host}:{self.port}: {e}")
+            return
+        self.reveal_devices()
+
+    def stop(self) -> None:
+        if self.client is not None:
+            try:
+                self.client.publish(f"leave/{self.client_id}/1", "disconnect")
+            except OSError:
+                pass
+            self.client.close()
+            self.client = None
+
+    # -- message handling (CMqttAdapter::HandleMessage) ----------------------
+    def _handle(self, topic: str, payload: bytes) -> None:
+        message = payload.decode(errors="replace")
+        parts = topic.split("/")
+        if topic.startswith("join/") and len(parts) >= 2:
+            device = parts[1]
+            if device == self.client_id:
+                return  # my own join announcement
+            with self._lock:
+                known = device in self._values
+                if not known:
+                    self._values[device] = {}
+            if not known:
+                self.client.subscribe(
+                    [f"{device}/1/JSON", f"{device}/1/AOUT/#", f"{device}/1/DOUT/#"]
+                )
+            else:
+                logger.info(f"duplicate MQTT join for {device}")
+            # ACK every join, duplicates included: ACKs are QoS-0, and a
+            # device whose first ACK was lost (or that reconnected
+            # without a leave) re-joins and waits for the ACK before
+            # publishing its JSON — dropping it would wedge the
+            # handshake forever.
+            self.client.publish(f"{device}/1/ACK", "ACK")
+        elif topic.startswith("leave/") and len(parts) >= 2:
+            device = parts[1]
+            with self._lock:
+                known = self._values.pop(device, None) is not None
+                self._index_ref.pop(device, None)
+                self._cmd_index.pop(device, None)
+            if known:
+                try:
+                    self.manager.remove_device(device)
+                except KeyError:
+                    pass
+                if device in self._devices:
+                    self._devices.remove(device)
+        elif len(parts) >= 3 and parts[2] == "JSON":
+            self._create_device(parts[0], message)
+        elif len(parts) >= 4 and parts[2] in ("AOUT", "DOUT"):
+            device, idx = parts[0], f"{parts[2]}/{parts[3]}"
+            try:
+                value = float(message)
+            except ValueError:
+                logger.warn(f"bad MQTT value on {topic}: {message!r}")
+                return
+            with self._lock:
+                ref = self._index_ref.get(device, {})
+                signal = ref.get(idx)
+                if signal is None:
+                    logger.warn(f"MQTT signal ({device}, {idx}) does not exist")
+                    return
+                self._values[device][signal] = value
+        # everything else (our own ACK echoes etc.) is dropped silently
+
+    def _create_device(self, device: str, spec_json: str) -> None:
+        """CreateDevice from the JSON self-description
+        (CMqttAdapter.cpp CreateDevice): AOUT/DOUT groups are states,
+        AIN/DIN are commands."""
+        with self._lock:
+            if device in self._index_ref:
+                logger.info(f"dropped JSON for duplicate MQTT device {device}")
+                return
+        try:
+            spec = json.loads(spec_json)
+            type_name = spec["type"]
+            ref: Dict[str, str] = {}
+            cmd: Dict[str, str] = {}
+            for group in ("AOUT", "DOUT", "DEV_CHAR"):
+                for idx, signal in spec.get(group, {}).items():
+                    ref[f"{group}/{idx}"] = signal
+            for group in ("AIN", "DIN"):
+                for idx, signal in spec.get(group, {}).items():
+                    cmd[signal] = idx
+        except (ValueError, KeyError, AttributeError, TypeError) as e:
+            logger.error(f"bad MQTT JSON for {device}: {e}")
+            return
+        with self._lock:
+            self._index_ref[device] = ref
+            self._cmd_index[device] = cmd
+            self._values.setdefault(device, {})
+            for signal in ref.values():
+                self._values[device].setdefault(signal, 0.0)
+        try:
+            self.manager.add_device(device, type_name, self)
+        except (ValueError, RuntimeError) as e:
+            logger.error(f"cannot register MQTT device {device}: {e}")
+            with self._lock:
+                self._index_ref.pop(device, None)
+                self._cmd_index.pop(device, None)
+
+    # -- Adapter surface -----------------------------------------------------
+    def get_state(self, device: str, signal: str) -> float:
+        # Surface a dead reader thread to the failure detector.
+        if self.error is None and self.client is not None and self.client.error:
+            self.error = self.client.error
+        with self._lock:
+            return float(self._values.get(device, {}).get(signal, 0.0))
+
+    def set_command(self, device: str, signal: str, value: float) -> None:
+        """Publish on the device's indexed command topic
+        (``CMqttAdapter::SetCommand`` → ``<device>/1/<idx>``)."""
+        with self._lock:
+            idx = self._cmd_index.get(device, {}).get(signal)
+        if idx is None or self.client is None:
+            return
+        self.client.publish(f"{device}/1/{idx}", repr(float(value)))

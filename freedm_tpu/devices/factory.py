@@ -102,7 +102,14 @@ def parse_adapter_xml(source: Union[str, Path]) -> Tuple[AdapterSpec, ...]:
 
     specs = []
     for node in root.findall("adapter"):
-        info = {c.tag: (c.text or "").strip() for c in node.find("info")} if node.find("info") is not None else {}
+        # Repeated <info> tags (e.g. several <subscribe> entries, the
+        # reference's form) accumulate comma-separated; unique tags
+        # behave as plain values.
+        info: Dict[str, str] = {}
+        if node.find("info") is not None:
+            for c in node.find("info"):
+                v = (c.text or "").strip()
+                info[c.tag] = f"{info[c.tag]},{v}" if c.tag in info else v
         specs.append(
             AdapterSpec(
                 name=node.get("name"),
@@ -131,6 +138,7 @@ class AdapterFactory:
         self.session_server = None  # PnP (CAdapterFactory::m_server)
         self.register_type("fake", _make_fake)
         self.register_type("rtds", _make_rtds)
+        self.register_type("mqtt", _make_mqtt)
 
     def register_type(self, type_name: str, ctor: AdapterCtor) -> None:
         self._registry[type_name] = ctor
@@ -227,6 +235,23 @@ def _make_fake(spec: AdapterSpec, manager: DeviceManager) -> Adapter:
         if e.value is not None
     }
     return FakeAdapter(seed)
+
+
+def _make_mqtt(spec: AdapterSpec, manager: DeviceManager) -> Adapter:
+    """mqtt adapter from ``<info>``: address (tcp://host:port), optional
+    id and repeated subscribe entries — the reference's mqtt branch
+    (``CAdapterFactory.cpp:100-107``, enabled here)."""
+    from freedm_tpu.devices.adapters.mqtt import MqttAdapter
+
+    subs = tuple(
+        s.strip() for s in spec.info.get("subscribe", "").split(",") if s.strip()
+    )
+    return MqttAdapter(
+        manager,
+        client_id=spec.info.get("id", spec.name or "DGIClient"),
+        address=spec.info.get("address", "tcp://localhost:1883"),
+        subscriptions=subs,
+    )
 
 
 def _make_rtds(spec: AdapterSpec, manager: DeviceManager) -> Adapter:
