@@ -129,13 +129,9 @@ class MqttClient:
             self._sock.sendall(data)
 
     def _read_exactly(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("MQTT connection closed")
-            buf += chunk
-        return buf
+        from freedm_tpu.devices.adapters.rtds import read_exactly
+
+        return read_exactly(self._sock, n)
 
     def _read_packet(self) -> Tuple[int, int, bytes]:
         head = self._read_exactly(1)[0]
@@ -369,4 +365,10 @@ class MqttAdapter(Adapter):
             idx = self._cmd_index.get(device, {}).get(signal)
         if idx is None or self.client is None:
             return
-        self.client.publish(f"{device}/1/{idx}", repr(float(value)))
+        try:
+            self.client.publish(f"{device}/1/{idx}", repr(float(value)))
+        except OSError as e:
+            # Error-not-crash: apply_commands calls this inside the
+            # manager lock and the broker round; latch for the failure
+            # detector instead of killing the process.
+            self.error = e

@@ -1,0 +1,162 @@
+"""OpenDSS text-protocol adapter.
+
+Reference: ``COpenDssAdapter`` (``Broker/src/device/COpenDssAdapter.hpp:52-118``,
+``COpenDssAdapter.cpp``) — one of the fork's two signature additions: a
+TCP client that each ``DEV_RTDS_DELAY`` tick reads a text blob of
+comma-separated ``key : value`` pairs from an OpenDSS co-simulation
+("Bus : 1,Node1 : 2,Basekv : 88.88,Magnitude1 : 8088.8,…") and exposes
+it to the modules, while ``sendCommand`` writes text commands back.
+The VVC agent polls ``GetData()`` and sends a command every round
+(``vvc/VoltVarCtrl.cpp:334-336``).
+
+Here the adapter is a :class:`BufferAdapter`: the received pairs fill
+the state buffer *in entry-index order* (the same ``adapter.xml``
+``<state>`` table as rtds, text instead of big-endian floats), and
+non-NULL commands are sent back as ``Device.signal : value`` pairs.
+Like the RTDS adapter it defers device reveal until the first
+successful exchange, latches transport errors instead of crashing, and
+runs its own thread.
+
+The VVC hook is structural: Pload/Sst devices bound to an opendss
+adapter make the VVC phase read its text data and scatter Q setpoints
+back as text commands — exercised end-to-end in
+``tests/test_opendss.py`` against a scripted fake OpenDSS server.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from freedm_tpu.core import logging as dgilog
+from freedm_tpu.core.config import NULL_COMMAND
+from freedm_tpu.devices.adapters.base import BufferAdapter
+
+logger = dgilog.get_logger(__name__)
+
+BUFFER_SIZE = 1024  # reference COpenDssAdapter::BUFFER_SIZE
+
+
+def parse_pairs(text: str):
+    """Parse ``k : v, k : v, …`` into ``[(key, float), …]``, skipping
+    malformed pairs (the co-sim side is not under our control)."""
+    out = []
+    for part in text.split(","):
+        if ":" not in part:
+            continue
+        key, _, val = part.partition(":")
+        try:
+            out.append((key.strip(), float(val.strip())))
+        except ValueError:
+            continue
+    return out
+
+
+def format_pairs(pairs) -> str:
+    return ",".join(f"{k} : {v}" for k, v in pairs)
+
+
+class OpenDssAdapter(BufferAdapter):
+    """Lock-step text exchange with an OpenDSS co-simulation."""
+
+    #: Reveal happens after the first successful data parse, like the
+    #: RTDS defer-until-buffer-initialized handshake.
+    defer_reveal = True
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        poll_s: float = 0.050,  # DEV_RTDS_DELAY
+        socket_timeout_s: float = 1.000,
+    ):
+        super().__init__()
+        self.host = host
+        self.port = port
+        self.poll_s = poll_s
+        self.socket_timeout_s = socket_timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._rxbuf = ""  # partial-line carry between recv() calls
+        self.exchanges = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        self.finalize_bindings()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if self._sock is not None:
+            self._sock.close()
+
+    # -- the exchange loop (COpenDssAdapter::Run) ----------------------------
+    def _run(self) -> None:
+        try:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.socket_timeout_s
+            )
+        except OSError as e:
+            self.error = e
+            logger.error(f"opendss at {self.host}:{self.port} unreachable: {e}")
+            return
+        while not self._stop.is_set():
+            try:
+                self._exchange_once()
+            except OSError as e:
+                # Error-not-crash: latch for the failure detector.
+                self.error = e
+                logger.error(f"opendss exchange failed: {e}")
+                return
+            self._stop.wait(self.poll_s)
+
+    def _exchange_once(self) -> None:
+        # Commands first (the reference's sendCommand path): every
+        # non-NULL command as a "Device.signal : value" pair.
+        cmd = self.command_buffer()
+        pairs = []
+        for (device, signal), idx in sorted(
+            self._command_index.items(), key=lambda kv: kv[1]
+        ):
+            v = cmd[idx]
+            if abs(v - NULL_COMMAND) > 0.5:
+                pairs.append((f"{device}.{signal}", float(v)))
+        if pairs:
+            self._sock.sendall((format_pairs(pairs) + "\n").encode())
+        # Then the state read.  TCP gives no message boundaries, so
+        # blobs are newline-framed: parsing an unframed recv() would
+        # install values truncated at a read boundary ("Mag1 : 70" from
+        # "Mag1 : 7088.5") or positionally shifted — only complete
+        # lines are consumed, partial tails carry to the next tick.
+        try:
+            data = self._sock.recv(BUFFER_SIZE)
+        except socket.timeout:
+            return  # quiet tick: OpenDSS had nothing new
+        if not data:
+            raise ConnectionError("opendss closed the connection")
+        self._rxbuf += data.decode(errors="replace")
+        if "\n" not in self._rxbuf:
+            return
+        # Use the freshest complete blob; keep any partial tail.
+        *lines, self._rxbuf = self._rxbuf.split("\n")
+        blob = next((l for l in reversed(lines) if l.strip()), None)
+        if blob is None:
+            return
+        values = [v for _, v in parse_pairs(blob)]
+        if len(values) < self.state_size:
+            logger.warn(
+                f"opendss sent {len(values)} values, need {self.state_size}"
+            )
+            return
+        import numpy as np
+
+        self.install_state(np.asarray(values[: self.state_size], np.float32))
+        self.exchanges += 1
+        if not self.revealed:
+            # First good exchange: the buffer is initialized.
+            self.reveal_devices()

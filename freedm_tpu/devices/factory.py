@@ -139,6 +139,7 @@ class AdapterFactory:
         self.register_type("fake", _make_fake)
         self.register_type("rtds", _make_rtds)
         self.register_type("mqtt", _make_mqtt)
+        self.register_type("opendss", _make_opendss)
 
     def register_type(self, type_name: str, ctor: AdapterCtor) -> None:
         self._registry[type_name] = ctor
@@ -251,6 +252,23 @@ def _make_mqtt(spec: AdapterSpec, manager: DeviceManager) -> Adapter:
         client_id=spec.info.get("id", spec.name or "DGIClient"),
         address=spec.info.get("address", "tcp://localhost:1883"),
         subscriptions=subs,
+    )
+
+
+def _make_opendss(spec: AdapterSpec, manager: DeviceManager) -> Adapter:
+    """opendss adapter from ``<info>``: host, port, optional poll/
+    timeout — the reference's opendss branch (``CAdapterFactory.cpp``)."""
+    from freedm_tpu.devices.adapters.opendss import OpenDssAdapter
+
+    try:
+        host, port = spec.info["host"], int(spec.info["port"])
+    except KeyError as e:
+        raise ValueError(f"opendss adapter {spec.name!r} needs <info> {e}") from None
+    return OpenDssAdapter(
+        host,
+        port,
+        poll_s=float(spec.info.get("poll", 0.050)),
+        socket_timeout_s=float(spec.info.get("timeout", 1.000)),
     )
 
 

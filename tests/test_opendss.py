@@ -1,0 +1,210 @@
+"""OpenDSS text-protocol adapter tests (VERDICT r3 item 7).
+
+A scripted fake OpenDSS TCP server serves the reference's text blobs
+("Bus : 1,Node1 : 2,…", ``COpenDssAdapter.cpp``) and records the text
+commands written back — including the VVC hook: a VVC round reading
+Pload values from the adapter and scattering Q setpoints as text
+(``vvc/VoltVarCtrl.cpp:334-336``).
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from freedm_tpu.devices.adapters.opendss import (
+    OpenDssAdapter,
+    format_pairs,
+    parse_pairs,
+)
+from freedm_tpu.devices.manager import DeviceManager
+
+
+class FakeOpenDss:
+    """Scripted server: sends a state blob per connection read cycle,
+    records every received command line."""
+
+    def __init__(self, state_text):
+        self.state_text = state_text
+        self.commands = []
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(1)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            sock.settimeout(0.2)
+            buf = ""
+            try:
+                while not self._stop.is_set():
+                    # Push the current state blob (newline-framed), then
+                    # drain commands.
+                    sock.sendall((self.state_text + "\n").encode())
+                    try:
+                        data = sock.recv(4096)
+                        if not data:
+                            break
+                        buf += data.decode()
+                        while "\n" in buf:
+                            line, _, buf = buf.partition("\n")
+                            if line.strip():
+                                self.commands.append(line.strip())
+                    except socket.timeout:
+                        pass
+            except OSError:
+                pass
+            finally:
+                sock.close()
+
+    def stop(self):
+        self._stop.set()
+        self._srv.close()
+
+
+def wait_for(cond, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def test_parse_and_format_pairs():
+    pairs = parse_pairs("Bus : 1,Node1 : 2,Basekv : 88.88,junk,bad : x")
+    assert pairs == [("Bus", 1.0), ("Node1", 2.0), ("Basekv", 88.88)]
+    assert format_pairs([("A.b", 1.5)]) == "A.b : 1.5"
+
+
+def test_state_read_and_command_write_cycle():
+    srv = FakeOpenDss("Mag1 : 7088.5,Angle1 : -2.0")
+    manager = DeviceManager()
+    a = OpenDssAdapter("127.0.0.1", srv.port, poll_s=0.01)
+    manager.add_device("BUS1", "Sst", a)
+    a.bind_state("BUS1", "gateway", 0)
+    a.bind_command("BUS1", "gateway", 0)
+    try:
+        a.start()
+        # Reveal is deferred until the first good exchange.
+        assert wait_for(lambda: a.revealed)
+        assert manager.get_state("BUS1", "gateway") == pytest.approx(7088.5)
+        # A command goes out as a text pair.
+        manager.set_command("BUS1", "gateway", 42.0)
+        assert wait_for(lambda: any("BUS1.gateway : 42.0" == c for c in srv.commands))
+        assert a.error is None
+    finally:
+        a.stop()
+        srv.stop()
+
+
+def test_unreachable_server_latches_error():
+    a = OpenDssAdapter("127.0.0.1", 1, poll_s=0.01, socket_timeout_s=0.2)
+    a.bind_state("X", "gateway", 0)
+    a.start()
+    assert wait_for(lambda: a.error is not None)
+    assert not a.revealed
+    a.stop()
+
+
+def test_short_state_blob_is_skipped_not_fatal():
+    srv = FakeOpenDss("OnlyOne : 5.0")
+    a = OpenDssAdapter("127.0.0.1", srv.port, poll_s=0.01)
+    a.bind_state("D", "gateway", 0)
+    a.bind_state("D", "storage", 1)  # needs 2 values, server sends 1
+    try:
+        a.start()
+        time.sleep(0.2)
+        assert a.error is None  # tolerated, just skipped
+        assert not a.revealed  # never initialized
+    finally:
+        a.stop()
+        srv.stop()
+
+
+def test_vvc_hook_reads_opendss_and_scatters_q():
+    """The reference pokes OpenDSS from the VVC agent
+    (VoltVarCtrl.cpp:334-336); here the hook is structural: Pload/Sst_x
+    devices on an opendss adapter make the VVC phase consume the text
+    data and actuate text commands."""
+    from freedm_tpu.grid import cases
+    from freedm_tpu.runtime import Fleet, NodeHandle, VvcModule, build_broker
+
+    feeder = cases.vvc_9bus()
+    # Serve Pload readings for row 3 (differ from the defaults so the
+    # staleness sentinel passes them through), plus a Q device row.
+    srv = FakeOpenDss("Pl3_a : 55.0,Pl3_b : 66.0,Pl3_c : 77.0")
+    manager = DeviceManager()
+    a = OpenDssAdapter("127.0.0.1", srv.port, poll_s=0.01)
+    for i, ph in enumerate("abc"):
+        manager.add_device(f"Pl3_{ph}", f"Pload_{ph}", a)
+        a.bind_state(f"Pl3_{ph}", "pload", i)
+        manager.add_device(f"Q4_{ph}", f"Sst_{ph}", a)
+        a.bind_state(f"Q4_{ph}", "gateway", 3 + i)
+        a.bind_command(f"Q4_{ph}", "gateway", i)
+    srv.state_text = (
+        "Pl3_a : 55.0,Pl3_b : 66.0,Pl3_c : 77.0,"
+        "Q4_a : 0.0,Q4_b : 0.0,Q4_c : 0.0"
+    )
+    try:
+        a.start()
+        assert wait_for(lambda: a.revealed)
+        fleet = Fleet([NodeHandle("n0:50860", manager)])
+        vvc = VvcModule(fleet, feeder)
+        broker = build_broker(fleet, extra_modules=[vvc])
+        broker.run(n_rounds=3)
+        # The live Pload readings were consumed (not flagged stale) and
+        # the VVC actuated row 4's Q devices.
+        assert vvc.rounds == 3
+        q = np.asarray(vvc.q_kvar)
+        assert np.abs(q[4]).sum() > 0.0
+        # The Q setpoints crossed the wire as text commands.
+        assert wait_for(lambda: any(c.startswith("Q4_") for c in srv.commands))
+    finally:
+        a.stop()
+        srv.stop()
+
+
+def test_segmented_stream_does_not_corrupt_state():
+    """A blob split across TCP segments must not install truncated
+    values ("Mag1 : 70" from "Mag1 : 7088.5") — only complete
+    newline-framed lines are consumed."""
+
+    class SegmentingServer(FakeOpenDss):
+        def _serve(self):
+            sock, _ = self._srv.accept()
+            sock.settimeout(0.2)
+            try:
+                # One blob, deliberately split mid-float.
+                sock.sendall(b"Mag1 : 70")
+                time.sleep(0.15)
+                sock.sendall(b"88.5,Angle1 : -2.0\n")
+                while not self._stop.is_set():
+                    time.sleep(0.05)
+            except OSError:
+                pass
+            finally:
+                sock.close()
+
+    srv = SegmentingServer("")
+    a = OpenDssAdapter("127.0.0.1", srv.port, poll_s=0.01)
+    a.bind_state("BUS1", "gateway", 0)
+    a.bind_state("BUS1", "storage", 1)
+    try:
+        a.start()
+        assert wait_for(lambda: a.revealed)
+        # The truncated "70" was never installed; the full value was.
+        assert a.get_state("BUS1", "gateway") == pytest.approx(7088.5)
+        assert a.get_state("BUS1", "storage") == pytest.approx(-2.0)
+    finally:
+        a.stop()
+        srv.stop()
