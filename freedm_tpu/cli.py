@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -95,6 +96,12 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--network-config", default=None, help="network.xml path")
     ap.add_argument("--federate", action="store_true", default=None,
                     help="treat add-host peers as remote processes over the DCN")
+    ap.add_argument("--checkpoint", default=None, metavar="PATH",
+                    help="write a round-boundary checkpoint to PATH")
+    ap.add_argument("--checkpoint-every", type=int, default=None, metavar="N",
+                    help="checkpoint every N rounds (default 1)")
+    ap.add_argument("--resume", action="store_true", default=None,
+                    help="resume from the checkpoint file if it exists")
     ap.add_argument("--migration-step", type=float, default=None,
                     help="size of LB power migrations")
     ap.add_argument("--malicious-behavior", action="store_true", default=None,
@@ -126,6 +133,8 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("adapter_config", "adapter_config"), ("logger_config", "logger_config"),
         ("timings_config", "timings_config"), ("topology_config", "topology_config"),
         ("network_config", "network_config"), ("federate", "federate"),
+        ("checkpoint", "checkpoint"), ("checkpoint_every", "checkpoint_every"),
+        ("resume", "resume"),
         ("migration_step", "migration_step"),
         ("malicious_behavior", "malicious_behavior"),
         ("check_invariant", "check_invariant"), ("verbose", "verbose"),
@@ -292,6 +301,25 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         broker.attach_clock_sync(
             ClockSynchronizer(cfg.uuid, federation.known, endpoint.send)
         )
+    if cfg.resume and not cfg.checkpoint:
+        raise ValueError(
+            "--resume needs a checkpoint path (set `checkpoint` in "
+            "freedm.cfg or pass --checkpoint)"
+        )
+    if cfg.checkpoint:
+        from freedm_tpu.runtime import checkpoint as ckpt
+
+        broker.register_module(
+            ckpt.CheckpointModule(
+                broker, fleet, cfg.checkpoint, every=cfg.checkpoint_every
+            ),
+            0,
+        )
+        if cfg.resume and os.path.exists(cfg.checkpoint):
+            ckpt.restore_state(ckpt.load(cfg.checkpoint), broker, fleet)
+            logger.status(
+                f"resumed from {cfg.checkpoint} at round {broker.round_index}"
+            )
     return Runtime(cfg, timings, broker, fleet, factories, vvc, endpoint, federation)
 
 

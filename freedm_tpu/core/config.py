@@ -200,6 +200,12 @@ class GlobalConfig:
     # (CConnectionManager::LoadNetworkConfig under CUSTOMNETWORK).
     network_config: Optional[str] = None
 
+    # Round-boundary checkpointing (SURVEY §5 required addition; the
+    # reference loses LB/VVC warm state with the process).
+    checkpoint: Optional[str] = None
+    checkpoint_every: int = 1
+    resume: bool = False
+
     # Config file paths.
     device_config: Optional[str] = None
     adapter_config: Optional[str] = None

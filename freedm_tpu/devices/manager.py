@@ -97,6 +97,14 @@ class DeviceManager:
         with self._lock:
             return self._slots[name].row
 
+    def slot_map(self) -> Dict[str, int]:
+        """Atomic name→row map of revealed devices (checkpointing must
+        not race a PnP/MQTT removal between listing and row lookup)."""
+        with self._lock:
+            return {
+                n: s.row for n, s in self._slots.items() if s.adapter.revealed
+            }
+
     def get_state(self, name: str, signal: str) -> float:
         # Resolve the slot under the lock (a PnP-timeout thread may be
         # removing devices concurrently); call the adapter outside it.
@@ -108,6 +116,32 @@ class DeviceManager:
         with self._lock:
             s = self._slots[name]
         s.adapter.set_command(name, signal, value)
+
+    def restore_slots(self, rows: Dict[str, int]) -> None:
+        """Re-assign tensor rows from a checkpoint so DeviceTensor rows
+        stay stable across a restart.  Devices not named keep their
+        rows; named devices move to their saved row when it is free
+        (in-range collisions with unnamed devices keep the current
+        assignment — the data is still correct, just re-rowed)."""
+        with self._lock:
+            named = [n for n in rows if n in self._slots]
+            taken = {
+                s.row for n, s in self._slots.items() if n not in named
+            }
+            for n in named:
+                want = rows[n]
+                if 0 <= want < self.capacity and want not in taken:
+                    self._slots[n].row = want
+                else:
+                    taken_all = taken | {self._slots[m].row for m in named if m != n}
+                    if self._slots[n].row in taken_all:
+                        # Displaced: take the lowest free row.
+                        free = (r for r in range(self.capacity) if r not in taken_all)
+                        self._slots[n].row = next(free)
+                taken.add(self._slots[n].row)
+            used = {s.row for s in self._slots.values()}
+            self._free = [r for r in range(self.capacity) if r not in used]
+            heapq.heapify(self._free)
 
     def healthy(self) -> bool:
         """At least one revealed device whose adapter has not errored —
