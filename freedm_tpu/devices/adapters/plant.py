@@ -127,6 +127,7 @@ class PlantAdapter(Adapter):
         self._charge_kw = np.zeros(nb)
         self._q_inj_kvar = np.zeros((nb, 3))  # VVC per-phase injections
         self._fid_closed: Dict[str, float] = {}
+        self._group_status: Dict[str, float] = {}
         self._omega = NOMINAL_OMEGA
         self._v_mag: Optional[np.ndarray] = None
         self._loss_kw = float("nan")
@@ -221,6 +222,14 @@ class PlantAdapter(Adapter):
             return float(self._omega)
         if (tname, signal) == ("Fid", "state"):
             return float(self._fid_closed.get(device, 1.0))
+        if tname == "Logger" and signal in ("dgiEnable", "groupStatus"):
+            # The rig-side observability taps: dgiEnable reads 1 (DGI
+            # authorized) and the last written group bitfield reads
+            # back so the simulator/operator can see the group state
+            # (docs/modules/group_management.rst:31-38).
+            if signal == "dgiEnable":
+                return 1.0
+            return float(self._group_status.get(device, 0.0))
         raise KeyError(f"unknown state signal {signal!r} for {tname} device {device!r}")
 
     def set_command(self, device: str, signal: str, value: float) -> None:
@@ -243,6 +252,8 @@ class PlantAdapter(Adapter):
             self._charge_kw[node] = float(value)
         elif (tname, signal) == ("Fid", "state"):
             self._fid_closed[device] = 1.0 if value > 0.5 else 0.0
+        elif (tname, signal) == ("Logger", "groupStatus"):
+            self._group_status[device] = float(value)
         else:
             raise KeyError(f"unknown command signal {signal!r} for {tname} device {device!r}")
 

@@ -39,12 +39,65 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from freedm_tpu.core import logging as dgilog
 from freedm_tpu.core.config import OMEGA_NOMINAL, GlobalConfig, Timings
 from freedm_tpu.devices import tensor as dt
 from freedm_tpu.devices.manager import DeviceManager
 from freedm_tpu.modules import gm, lb, sc
 from freedm_tpu.runtime.broker import Broker
 from freedm_tpu.runtime.module import DgiModule, PhaseContext
+
+logger = dgilog.get_logger(__name__)
+
+
+def _group_status_from_np(is_coord: bool, mask_row: np.ndarray) -> float:
+    """Bitfield from host arrays: bit 0 = I coordinate, bit j+1 = fleet
+    node j up in my group (31-node cap, the reference's 32-bit field);
+    the uint32 bit pattern reinterpreted as the wire's f32."""
+    field = 1 if is_coord else 0
+    for j in np.nonzero(mask_row > 0)[0]:
+        if j < 31:
+            field |= 1 << (int(j) + 1)
+    return float(np.uint32(field).view(np.float32))
+
+
+def group_status_float(i: int, group: gm.GroupState) -> float:
+    """Node *i*'s group bitfield as the f32 the Logger device carries
+    (``GMAgent::SystemState``, ``GroupManagement.cpp:341-414``)."""
+    return _group_status_from_np(
+        bool(np.asarray(group.is_coordinator)[i]), np.asarray(group.group_mask)[i]
+    )
+
+
+class _TableLogger:
+    """Change- and rate-gated Status tables.
+
+    The reference prints SystemState/LoadTable once per Check cycle
+    (seconds); free-running rounds are ms-fast, so tables render and
+    print only when (a) Status is enabled, (b) at most once per
+    ``min_interval_s``, and (c) the content actually changed — an
+    un-drained stderr pipe must never be able to block the fleet on
+    identical spam."""
+
+    def __init__(self, min_interval_s: float = 1.0):
+        self.min_interval_s = min_interval_s
+        self._last: Optional[str] = None
+        self._last_t = 0.0
+
+    def maybe_log(self, render) -> None:
+        if logger.level < 4:
+            return
+        import time
+
+        now = time.monotonic()
+        if now - self._last_t < self.min_interval_s:
+            return
+        table = render()
+        if table == self._last:
+            return
+        self._last = table
+        self._last_t = now
+        logger.status(table)
 
 
 def _make_ingress(layout):
@@ -334,6 +387,7 @@ class GmModule(DgiModule):
         self.fed = federation
         self.last: Optional[gm.GroupState] = None
         self.counters = {"elections": 0, "groups_broken": 0}
+        self._tables = _TableLogger()
         # Kernels must run compiled: eager op-by-op dispatch on TPU costs
         # ~1000x (each jnp op is a device round-trip).
         self._form = jax.jit(gm.form_groups)
@@ -368,6 +422,59 @@ class GmModule(DgiModule):
             # The DCN-boundary election ticks once per GM phase (the
             # reference's Check/Timeout timer cadence).
             ctx.shared["federation"] = self.fed.gm_step(ctx.round_index)
+        # Group-state export to the simulator: every Logger device gets
+        # its node's bitfield (group_management.rst:31-38).  Host
+        # conversion happens ONCE (two transfers), not per device —
+        # eager per-element indexing of jitted outputs costs a device
+        # round-trip each.
+        loggers = [
+            (i, node, node.manager.device_names("Logger"))
+            for i, node in enumerate(fleet.nodes)
+            if node.alive
+        ]
+        if any(names for _, _, names in loggers):
+            coord_np = np.asarray(group.is_coordinator)
+            mask_np = np.asarray(group.group_mask)
+            for i, node, names in loggers:
+                value = _group_status_from_np(bool(coord_np[i]), mask_np[i])
+                for name in names:
+                    try:
+                        node.manager.set_command(name, "groupStatus", value)
+                    except KeyError:
+                        pass  # a rig exposing dgiEnable without the command
+        self._tables.maybe_log(self.system_state)
+
+    def system_state(self) -> str:
+        """The fleet-wide SYSTEM STATE table
+        (``GMAgent::SystemState``, ``GroupManagement.cpp:341-414``):
+        per-node liveness/role as every reference process would print
+        it, plus FID net state."""
+        fleet = self.fleet
+        group = self.last
+        lines = ["- SYSTEM STATE", "SYSTEM NODES"]
+        if group is None:
+            lines.append("(no group formed yet)")
+            return "\n".join(lines)
+        coord = np.asarray(group.coordinator)
+        for i, node in enumerate(fleet.nodes):
+            if not node.alive:
+                state = "Down"
+            elif coord[i] == i:
+                state = "Up (Coordinator)"
+            else:
+                state = f"Up (In Group of {fleet.nodes[int(coord[i])].uuid})"
+            lines.append(f"Node: {node.uuid} State: {state}")
+        lines.append(f"Groups: {int(group.n_groups)}")
+        fid = fleet.fid_states()
+        if fid.shape[0]:
+            lines.append(f"FID state: {float(jnp.sum(fid))}")
+        if self.fed is not None:
+            v = self.fed.view()
+            lines.append(
+                f"Federation: leader {v.leader}, members {len(v.members)}, "
+                f"state {v.state}"
+            )
+        return "\n".join(lines)
 
 
 class ScModule(DgiModule):
@@ -447,6 +554,9 @@ class LbModule(DgiModule):
         self.power_differential: Optional[np.ndarray] = None  # [N] per-group K
         self.normal: Optional[np.ndarray] = None  # [N] per-node target
         self._synchronized = False
+        self._tables = _TableLogger()
+        self._last_out = None
+        self._last_readings = None
         self._round = jax.jit(
             partial(lb.lb_round, migration_step=fleet.migration_step)
         )
@@ -523,6 +633,53 @@ class LbModule(DgiModule):
         ctx.shared["lb_round"] = out
         self.total_migrations += int(out.n_migrations)
         self.rounds += 1
+        self._last_out = out
+        self._last_readings = r
+        self._tables.maybe_log(self.load_table)
+
+    def load_table(self) -> str:
+        """The LOAD TABLE (``LBAgent::LoadTable``,
+        ``lb/LoadBalance.cpp:454-534``) for the whole fleet: net device
+        totals, then every node's SUPPLY/DEMAND/NORMAL role with its
+        gateway, net generation, and predicted K."""
+        fleet = self.fleet
+        r = self._last_readings
+        out = self._last_out
+        lines = ["------- LOAD TABLE (Power Management) -------"]
+        if r is None or out is None:
+            lines.append("(no LB round yet)")
+            return "\n".join(lines)
+        counts = {
+            t: sum(len(n.manager.device_names(t)) for n in fleet.nodes)
+            for t in ("Drer", "Desd", "Load")
+        }
+        lines.append(
+            f"  Net DRER ({counts['Drer']:02d}):  "
+            f"{float(jnp.sum(r['generation'])):.2f}"
+        )
+        lines.append(
+            f"  Net Desd ({counts['Desd']:02d}):  "
+            f"{float(jnp.sum(r['storage'])):.2f}"
+        )
+        lines.append(
+            f"  Net Load ({counts['Load']:02d}):  "
+            f"{float(jnp.sum(r['drain'])):.2f}"
+        )
+        lines.append("  ---------------------------------------------")
+        names = {lb.SUPPLY: "SUPPLY", lb.DEMAND: "DEMAND", lb.NORMAL: "NORMAL"}
+        state = np.asarray(out.state)
+        gw = np.asarray(r["gateway"])
+        ng = np.asarray(r["netgen"])
+        k = self.power_differential
+        for i, node in enumerate(fleet.nodes):
+            role = names.get(int(state[i]), "????") if node.alive else " DOWN "
+            ki = f"{float(k[i]):.2f}" if k is not None else "--"
+            lines.append(
+                f"  ({role}) {node.uuid}  gateway {gw[i]:.2f}  "
+                f"netgen {ng[i]:.2f}  K {ki}"
+            )
+        lines.append("  ---------------------------------------------")
+        return "\n".join(lines)
 
 
 class VvcModule(DgiModule):

@@ -153,7 +153,7 @@ def test_atomic_save_survives_kill_mid_run(tmp_path):
     proc = subprocess.Popen(
         [sys.executable, "-m", "freedm_tpu", "-c", str(cfg_file),
          "--summary-every", "5"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env, text=True,
     )
     # Wait for a few rounds' worth of summaries, then kill hard.
     lines = []

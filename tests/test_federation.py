@@ -277,6 +277,12 @@ class _Proc:
         )
         self._t = threading.Thread(target=self._pump, daemon=True)
         self._t.start()
+        # stderr must drain too (operator tables log there), or the
+        # child blocks on a full pipe.
+        self._te = threading.Thread(
+            target=lambda: [None for _ in self.proc.stderr], daemon=True
+        )
+        self._te.start()
 
     def _pump(self):
         for line in self.proc.stdout:
