@@ -265,3 +265,9 @@ class PlantAdapter(Adapter):
     def set_load(self, device: str, kw: float) -> None:
         _, node = self.placements[device]
         self._load_kw[node] = kw
+
+    def set_storage(self, device: str, kwh: float) -> None:
+        """Install an externally simulated storage LEVEL (kWh) — not
+        the charge-rate command."""
+        _, node = self.placements[device]
+        self._storage_kwh[node] = kwh

@@ -52,13 +52,23 @@ logger = dgilog.get_logger(__name__)
 
 def _group_status_from_np(is_coord: bool, mask_row: np.ndarray) -> float:
     """Bitfield from host arrays: bit 0 = I coordinate, bit j+1 = fleet
-    node j up in my group (31-node cap, the reference's 32-bit field);
-    the uint32 bit pattern reinterpreted as the wire's f32."""
+    node j up in my group.  Carried as the *integer-valued* float
+    (decode: ``int(value)``), not the reference's raw bit-reinterpret —
+    reinterpreted patterns whose exponent bits land on NaN get silently
+    quietened by any f32↔f64 hop (observed: bits 23-30 set, bit 22
+    clear → bit 22 flips on), corrupting membership.  Exact through an
+    f32 wire up to 23 bits → 22 nodes; larger fleets truncate with a
+    warning (the reference caps at 31 the same way)."""
     field = 1 if is_coord else 0
+    truncated = False
     for j in np.nonzero(mask_row > 0)[0]:
-        if j < 31:
+        if j < 22:
             field |= 1 << (int(j) + 1)
-    return float(np.uint32(field).view(np.float32))
+        else:
+            truncated = True
+    if truncated:
+        logger.warn("group bitfield truncated: >22 nodes in group")
+    return float(field)
 
 
 def group_status_float(i: int, group: gm.GroupState) -> float:

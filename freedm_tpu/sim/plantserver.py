@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from freedm_tpu.core import logging as dgilog
 from freedm_tpu.core.config import NULL_COMMAND
 from freedm_tpu.devices.adapters.plant import PlantAdapter
 from freedm_tpu.devices.adapters.rtds import WIRE_DTYPE, read_exactly
@@ -37,15 +38,30 @@ from freedm_tpu.utils.textio import read_source
 
 Binding = Tuple[str, str]  # (device, signal)
 
+logger = dgilog.get_logger(__name__)
+
 
 @dataclass
 class _Port:
-    """One served adapter port: its socket + buffer⇄table bindings."""
+    """One served adapter port: its socket + buffer⇄table bindings.
+
+    ``protocol``: "rtds" = the byte-oriented lock-step float exchange;
+    "pscad" = the header-based simulation protocol
+    (``pscad-interface-master/src/CSimulationAdapter.cpp``).
+    """
 
     states: List[Binding]  # index order = buffer order
     commands: List[Binding]
     server: socket.socket = None  # type: ignore[assignment]
     threads: List[threading.Thread] = field(default_factory=list)
+    protocol: str = "rtds"
+
+
+#: PSCAD simulation protocol framing (CSimulationAdapter.hpp:65 and
+#: DeviceTable.hpp:42: 5-byte header, 8-byte double signal values —
+#: native byte order in the reference, which deployed little-endian).
+SIM_HEADER_SIZE = 5
+SIM_DTYPE = np.dtype("<f8")
 
 
 class PlantServer:
@@ -66,13 +82,24 @@ class PlantServer:
         states: Sequence[Binding],
         commands: Sequence[Binding],
         bind: Tuple[str, int] = ("127.0.0.1", 0),
+        protocol: str = "rtds",
     ) -> Tuple[str, int]:
-        """Declare a served port; returns its bound (host, port)."""
+        """Declare a served port; returns its bound (host, port).
+
+        ``protocol="rtds"``: the DGI-side lock-step float exchange.
+        ``protocol="pscad"``: the line-oriented simulation protocol a
+        PSCAD co-simulation drives (RST/SET push states into the plant,
+        GET reads back what the DGI commanded).
+        """
+        if protocol not in ("rtds", "pscad"):
+            raise ValueError(f"unknown port protocol {protocol!r}")
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(bind)
         srv.listen(4)
-        self._ports.append(_Port(list(states), list(commands), server=srv))
+        self._ports.append(
+            _Port(list(states), list(commands), server=srv, protocol=protocol)
+        )
         return srv.getsockname()
 
     def port_address(self, i: int) -> Tuple[str, int]:
@@ -112,9 +139,10 @@ class PlantServer:
                 conn, _ = p.server.accept()
             except OSError:
                 return
-            t = threading.Thread(
-                target=self._serve_conn, args=(p, conn), daemon=True
+            target = (
+                self._serve_sim_conn if p.protocol == "pscad" else self._serve_conn
             )
+            t = threading.Thread(target=target, args=(p, conn), daemon=True)
             t.start()
             p.threads.append(t)
 
@@ -145,6 +173,77 @@ class PlantServer:
                 self.exchanges += 1
         except (ConnectionError, OSError):
             pass  # client went away; the acceptor keeps serving
+        finally:
+            conn.close()
+
+    # -- the PSCAD simulation protocol ---------------------------------------
+    def _apply_external(self, device: str, signal: str, value: float) -> None:
+        """Install an externally simulated state into the plant: Load
+        drain, Drer generation, and Desd storage have dedicated inputs;
+        everything else flows through the command path (Fid state,
+        Pload pload, …).  Un-installable signals (e.g. Omega frequency,
+        which only physics produces) are skipped with a warning — one
+        bad binding must not kill the connection or the rest of the
+        message."""
+        tname = self.plant.placements[device][0]
+        if (tname, signal) == ("Load", "drain"):
+            self.plant.set_load(device, value)
+        elif (tname, signal) == ("Drer", "generation"):
+            self.plant.set_generation(device, value)
+        elif (tname, signal) == ("Desd", "storage"):
+            self.plant.set_storage(device, value)
+        else:
+            try:
+                self.plant.set_command(device, signal, value)
+            except KeyError:
+                logger.warn(
+                    f"simulation pushed un-installable state "
+                    f"{device}.{signal}; skipped"
+                )
+
+    def _serve_sim_conn(self, p: _Port, conn: socket.socket) -> None:
+        """Header-based exchange (CSimulationAdapter::HandleConnection):
+        5-byte header, then SET/RST push ``len(states)`` doubles into
+        the plant (RST also seeds commands from the same values — the
+        reference's COMMAND_TABLE ← STATE_TABLE copy) and GET replies
+        with ``len(commands)`` doubles of the DGI-commanded values."""
+        conn.settimeout(None)
+        try:
+            while not self._stop.is_set():
+                header = read_exactly(conn, SIM_HEADER_SIZE)
+                kind = header.rstrip(b"\x00 ").decode(errors="replace")
+                if kind in ("RST", "SET"):
+                    raw = read_exactly(conn, len(p.states) * SIM_DTYPE.itemsize)
+                    vals = np.frombuffer(raw, SIM_DTYPE)
+                    with self._plant_lock:
+                        for (device, signal), v in zip(p.states, vals):
+                            self._apply_external(device, signal, float(v))
+                        if kind == "RST":
+                            for (device, signal), v in zip(p.states, vals):
+                                try:
+                                    self.plant.set_command(device, signal, float(v))
+                                except KeyError:
+                                    pass  # state without a command path
+                elif kind == "GET":
+                    with self._plant_lock:
+                        vals = [
+                            self.plant.get_state(device, signal)
+                            for device, signal in p.commands
+                        ]
+                    conn.sendall(np.asarray(vals, SIM_DTYPE).tobytes())
+                else:
+                    # An unknown verb's payload length is unknowable, so
+                    # the stream cannot resync — close the connection
+                    # (the client reconnects) instead of misparsing the
+                    # payload as headers forever.
+                    logger.warn(
+                        f"unrecognized simulation header {header!r}; "
+                        "closing connection"
+                    )
+                    return
+                self.exchanges += 1
+        except (ConnectionError, OSError):
+            pass
         finally:
             conn.close()
 
@@ -223,7 +322,12 @@ def load_rig(source: Union[str, "os.PathLike[str]"]) -> PlantServer:
                 raise ValueError(f"{kind} entry indices are not dense 0..n-1")
             return [(e.get("device"), e.get("signal")) for e in entries]
 
-        server.add_port(table("state"), table("command"), bind=("127.0.0.1", port))
+        server.add_port(
+            table("state"),
+            table("command"),
+            bind=("127.0.0.1", port),
+            protocol=a.get("protocol", "rtds"),
+        )
     return server
 
 

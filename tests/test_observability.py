@@ -50,7 +50,7 @@ def test_group_status_bitfield_written_to_logger_device():
     broker.run(n_rounds=3)
     group = broker.shared["group"]
     raw = fakes[0].get_state("LOG", "groupStatus")
-    field = np.float32(raw).view(np.uint32)
+    field = int(raw)  # integer-valued float encoding (decode: int())
     # Both nodes form one group: bits 1 and 2 (values 2, 4) are set;
     # bit 0 reflects whether node 0 coordinates.
     assert field & 2, f"self-up bit missing: {field:b}"
@@ -107,12 +107,12 @@ def test_plantserver_exposes_group_bitfield_over_wire():
     )
     server.start()
     try:
-        bitfield = float(np.uint32(0b111).view(np.float32))
+        bitfield = float(0b111)  # integer-valued float encoding
         with socket.create_connection(addr, timeout=5) as s:
             s.sendall(np.asarray([bitfield], WIRE_DTYPE).tobytes())
             raw = read_exactly(s, 2 * 4)
         states = np.frombuffer(raw, WIRE_DTYPE)
         assert states[0] == 1.0  # dgiEnable
-        assert np.float32(states[1]).view(np.uint32) == 0b111
+        assert int(states[1]) == 0b111
     finally:
         server.stop()

@@ -1,0 +1,132 @@
+"""PSCAD simulation-protocol tests (VERDICT r3 missing #6).
+
+The plantserver now also speaks the line-oriented simulation protocol
+of ``pscad-interface-master/src/CSimulationAdapter.cpp``: a PSCAD
+co-simulation pushes measured states (5-byte RST/SET header + doubles)
+and polls the DGI-commanded values (GET), alongside the RTDS byte
+protocol the DGI side uses.
+"""
+
+import socket
+
+import numpy as np
+import pytest
+
+from freedm_tpu.core.config import NULL_COMMAND
+from freedm_tpu.devices.adapters.plant import PlantAdapter
+from freedm_tpu.devices.adapters.rtds import WIRE_DTYPE, read_exactly
+from freedm_tpu.grid import cases
+from freedm_tpu.sim.plantserver import SIM_DTYPE, SIM_HEADER_SIZE, PlantServer
+
+
+def header(kind: str) -> bytes:
+    return kind.encode().ljust(SIM_HEADER_SIZE, b"\x00")
+
+
+@pytest.fixture
+def rig():
+    plant = PlantAdapter(
+        cases.vvc_9bus(),
+        {"LOAD_A": ("Load", 0), "DRER_A": ("Drer", 1), "SST1": ("Sst", 2)},
+    )
+    plant.reveal_devices()
+    server = PlantServer(plant, period_s=0.01)
+    sim_addr = server.add_port(
+        states=[("LOAD_A", "drain"), ("DRER_A", "generation")],
+        commands=[("SST1", "gateway")],
+        protocol="pscad",
+    )
+    rtds_addr = server.add_port(
+        states=[("LOAD_A", "drain"), ("SST1", "gateway")],
+        commands=[("SST1", "gateway")],
+    )
+    server.start()
+    yield plant, server, sim_addr, rtds_addr
+    server.stop()
+
+
+def test_set_pushes_states_into_the_plant(rig):
+    plant, server, sim_addr, _ = rig
+    with socket.create_connection(sim_addr, timeout=5) as s:
+        s.sendall(header("SET") + np.asarray([25.0, 40.0], SIM_DTYPE).tobytes())
+        # Second message on the same connection (the protocol loops).
+        s.sendall(header("SET") + np.asarray([26.0, 41.0], SIM_DTYPE).tobytes())
+        s.sendall(header("GET"))
+        read_exactly(s, SIM_DTYPE.itemsize)  # sync: both SETs processed
+    assert plant.get_state("LOAD_A", "drain") == 26.0
+    assert plant.get_state("DRER_A", "generation") == 41.0
+
+
+def test_get_reads_back_dgi_commands(rig):
+    plant, server, sim_addr, _ = rig
+    plant.set_command("SST1", "gateway", 7.5)  # what the DGI commanded
+    with socket.create_connection(sim_addr, timeout=5) as s:
+        s.sendall(header("GET"))
+        raw = read_exactly(s, 1 * SIM_DTYPE.itemsize)
+    assert np.frombuffer(raw, SIM_DTYPE)[0] == 7.5
+
+
+def test_rst_seeds_commands_from_states(rig):
+    plant, server, sim_addr, _ = rig
+    with socket.create_connection(sim_addr, timeout=5) as s:
+        s.sendall(header("RST") + np.asarray([30.0, 45.0], SIM_DTYPE).tobytes())
+        s.sendall(header("GET"))
+        read_exactly(s, SIM_DTYPE.itemsize)
+    assert plant.get_state("LOAD_A", "drain") == 30.0
+
+
+def test_unknown_header_closes_connection_but_server_survives(rig):
+    """An unknown verb's payload length is unknowable: the connection
+    closes (no stream desync) and a reconnect is served normally."""
+    plant, server, sim_addr, _ = rig
+    with socket.create_connection(sim_addr, timeout=5) as s:
+        s.sendall(header("XYZ"))
+        assert s.recv(1) == b""  # server closed the desynced stream
+    with socket.create_connection(sim_addr, timeout=5) as s:
+        s.sendall(header("GET"))
+        raw = read_exactly(s, SIM_DTYPE.itemsize)
+    assert len(raw) == SIM_DTYPE.itemsize
+
+
+def test_pscad_and_rtds_ports_cohabit(rig):
+    """A PSCAD-side load change is visible through the DGI's RTDS port
+    on the same plant — the two protocols share one physics."""
+    plant, server, sim_addr, rtds_addr = rig
+    with socket.create_connection(sim_addr, timeout=5) as sim:
+        sim.sendall(header("SET") + np.asarray([33.0, 0.0], SIM_DTYPE).tobytes())
+        sim.sendall(header("GET"))
+        read_exactly(sim, SIM_DTYPE.itemsize)
+    with socket.create_connection(rtds_addr, timeout=5) as dgi:
+        cmds = np.full(1, NULL_COMMAND, WIRE_DTYPE)
+        dgi.sendall(cmds.tobytes())
+        raw = read_exactly(dgi, 2 * 4)
+    states = np.frombuffer(raw, WIRE_DTYPE)
+    assert states[0] == pytest.approx(33.0)
+
+
+def test_load_rig_builds_pscad_port(tmp_path):
+    xml = """<rig case="vvc_9bus" period="0.02">
+      <device name="LOAD_A" type="Load" node="0" value="10"/>
+      <adapter port="0" protocol="pscad">
+        <state device="LOAD_A" signal="drain" index="0"/>
+      </adapter>
+    </rig>"""
+    from freedm_tpu.sim.plantserver import load_rig
+
+    server = load_rig(xml)
+    assert server._ports[0].protocol == "pscad"
+    server.start()
+    try:
+        addr = server.port_address(0)
+        with socket.create_connection(addr, timeout=5) as s:
+            s.sendall(header("SET") + np.asarray([12.0], SIM_DTYPE).tobytes())
+        import time
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if server.plant.get_state("LOAD_A", "drain") == 12.0:
+                break
+            time.sleep(0.01)
+        assert server.plant.get_state("LOAD_A", "drain") == 12.0
+    finally:
+        server.stop()
