@@ -8,8 +8,7 @@ sequence number, a content hash, an optional kill number, an expiration
 stamp, and (for MESSAGE) the embedded module message.
 
 The encoding here is canonical JSON inside a fixed header — small,
-debuggable, and language-neutral (the C++ runtime codec in
-``native/`` speaks the same format).  Datagrams are capped at
+debuggable, and language-neutral.  Datagrams are capped at
 ``MAX_PACKET_SIZE`` like the reference (``CGlobalConfiguration.hpp:108``,
 ``IProtocol.cpp:87-92``).
 """

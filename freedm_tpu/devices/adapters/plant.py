@@ -128,6 +128,10 @@ class PlantAdapter(Adapter):
         self._q_inj_kvar = np.zeros((nb, 3))  # VVC per-phase injections
         self._fid_closed: Dict[str, float] = {}
         self._group_status: Dict[str, float] = {}
+        # Every accepted set_command, verbatim — the "command table"
+        # view a PSCAD co-simulation polls (command and state stores
+        # differ for some signals, e.g. Desd charge rate vs level).
+        self._last_commands: Dict[Tuple[str, str], float] = {}
         self._omega = NOMINAL_OMEGA
         self._v_mag: Optional[np.ndarray] = None
         self._loss_kw = float("nan")
@@ -232,7 +236,17 @@ class PlantAdapter(Adapter):
             return float(self._group_status.get(device, 0.0))
         raise KeyError(f"unknown state signal {signal!r} for {tname} device {device!r}")
 
+    def last_command(self, device: str, signal: str) -> float:
+        """The most recent commanded value for a signal, falling back to
+        the live state when nothing was ever commanded — the command
+        table a PSCAD GET reads (CTableManager's COMMAND_TABLE)."""
+        try:
+            return self._last_commands[(device, signal)]
+        except KeyError:
+            return self.get_state(device, signal)
+
     def set_command(self, device: str, signal: str, value: float) -> None:
+        self._last_commands[(device, signal)] = float(value)
         tname, node = self.placements[device]
         if tname in _PHASE_OF:
             kind, phase = _PHASE_OF[tname]

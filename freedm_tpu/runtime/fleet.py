@@ -57,17 +57,17 @@ def _group_status_from_np(is_coord: bool, mask_row: np.ndarray) -> float:
     reinterpreted patterns whose exponent bits land on NaN get silently
     quietened by any f32↔f64 hop (observed: bits 23-30 set, bit 22
     clear → bit 22 flips on), corrupting membership.  Exact through an
-    f32 wire up to 23 bits → 22 nodes; larger fleets truncate with a
+    f32 wire up to 2^24 → 23 nodes; larger fleets truncate with a
     warning (the reference caps at 31 the same way)."""
     field = 1 if is_coord else 0
     truncated = False
     for j in np.nonzero(mask_row > 0)[0]:
-        if j < 22:
+        if j < 23:
             field |= 1 << (int(j) + 1)
         else:
             truncated = True
     if truncated:
-        logger.warn("group bitfield truncated: >22 nodes in group")
+        logger.warn("group bitfield truncated: >23 nodes in group")
     return float(field)
 
 

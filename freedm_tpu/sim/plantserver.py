@@ -185,21 +185,21 @@ class PlantServer:
         which only physics produces) are skipped with a warning — one
         bad binding must not kill the connection or the rest of the
         message."""
-        tname = self.plant.placements[device][0]
-        if (tname, signal) == ("Load", "drain"):
-            self.plant.set_load(device, value)
-        elif (tname, signal) == ("Drer", "generation"):
-            self.plant.set_generation(device, value)
-        elif (tname, signal) == ("Desd", "storage"):
-            self.plant.set_storage(device, value)
-        else:
-            try:
+        try:
+            tname = self.plant.placements[device][0]
+            if (tname, signal) == ("Load", "drain"):
+                self.plant.set_load(device, value)
+            elif (tname, signal) == ("Drer", "generation"):
+                self.plant.set_generation(device, value)
+            elif (tname, signal) == ("Desd", "storage"):
+                self.plant.set_storage(device, value)
+            else:
                 self.plant.set_command(device, signal, value)
-            except KeyError:
-                logger.warn(
-                    f"simulation pushed un-installable state "
-                    f"{device}.{signal}; skipped"
-                )
+        except KeyError:
+            logger.warn(
+                f"simulation pushed un-installable state "
+                f"{device}.{signal}; skipped"
+            )
 
     def _serve_sim_conn(self, p: _Port, conn: socket.socket) -> None:
         """Header-based exchange (CSimulationAdapter::HandleConnection):
@@ -225,9 +225,12 @@ class PlantServer:
                                 except KeyError:
                                     pass  # state without a command path
                 elif kind == "GET":
+                    # The COMMAND table view: what the DGI commanded,
+                    # not the plant state (they differ for e.g. Desd
+                    # charge rate vs storage level).
                     with self._plant_lock:
                         vals = [
-                            self.plant.get_state(device, signal)
+                            self.plant.last_command(device, signal)
                             for device, signal in p.commands
                         ]
                     conn.sendall(np.asarray(vals, SIM_DTYPE).tobytes())

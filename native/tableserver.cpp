@@ -1,0 +1,309 @@
+// tableserver — native co-simulation table server.
+//
+// C++17 equivalent of the reference's pscad-interface
+// (pscad-interface-master/src/{PosixMain,CTableManager,CRtdsAdapter,
+// CSimulationAdapter}.cpp): shared state/command device tables behind
+// reader/writer locks, served over TCP to
+//
+//   * DGI processes speaking the RTDS lock-step byte protocol
+//     (receive big-endian f32 command buffer, apply non-NULL entries,
+//     reply with the big-endian f32 state buffer), and
+//   * a PSCAD co-simulation speaking the header protocol
+//     (5-byte RST/SET/GET header; SET/RST push little-endian f64
+//     states, RST also seeds commands from them, GET reads commands).
+//
+// The Python plantserver (freedm_tpu/sim/plantserver.py) serves the
+// same two protocols backed by LIVE JAX physics; this native server is
+// the static-table variant for co-sim hosts that must not carry a
+// Python/JAX runtime — exactly the reference's deployment shape, where
+// pscad-interface ran beside the simulator as a standalone C++ process.
+//
+// Config (one line per port, stdin or a file; '#' comments):
+//   rtds  <port> states <dev.sig> ... commands <dev.sig> ...
+//   pscad <port> states <dev.sig> ... commands <dev.sig> ...
+//   seed  <dev.sig> <value>
+// After setup, prints one JSON line {"tableserver": [[host, port], ...]}
+// to stdout (port 0 binds ephemerally), then serves until SIGTERM.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <mutex>
+#include <shared_mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// IAdapter::NULL_COMMAND (Broker/src/device/IAdapter.hpp).
+constexpr float kNullCommand = 1.0e8f;
+constexpr std::size_t kSimHeaderSize = 5;  // CSimulationAdapter.hpp:65
+
+// ----------------------------------------------------------------------
+// CTableManager equivalent: two tables behind one shared_mutex each.
+// ----------------------------------------------------------------------
+class DeviceTable {
+ public:
+  void Set(const std::string& key, double value) {
+    std::unique_lock lock(mutex_);
+    values_[key] = value;
+  }
+  double Get(const std::string& key) const {
+    std::shared_lock lock(mutex_);
+    auto it = values_.find(key);
+    return it == values_.end() ? 0.0 : it->second;
+  }
+
+ private:
+  mutable std::shared_mutex mutex_;
+  std::map<std::string, double> values_;
+};
+
+DeviceTable g_state_table;
+DeviceTable g_command_table;
+std::atomic<bool> g_stop{false};
+
+// ----------------------------------------------------------------------
+// Socket helpers.
+// ----------------------------------------------------------------------
+bool ReadExactly(int fd, void* buf, std::size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t got = ::read(fd, p, n);
+    if (got <= 0) return false;
+    p += got;
+    n -= static_cast<std::size_t>(got);
+  }
+  return true;
+}
+
+bool WriteAll(int fd, const void* buf, std::size_t n) {
+  const auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t put = ::write(fd, p, n);
+    if (put <= 0) return false;
+    p += put;
+    n -= static_cast<std::size_t>(put);
+  }
+  return true;
+}
+
+// Big-endian f32 <-> host (the RTDS wire dtype, CRtdsAdapter's
+// EndianSwapIfNeeded).
+float BeToFloat(const unsigned char* b) {
+  uint32_t v = (uint32_t(b[0]) << 24) | (uint32_t(b[1]) << 16) |
+               (uint32_t(b[2]) << 8) | uint32_t(b[3]);
+  float f;
+  std::memcpy(&f, &v, 4);
+  return f;
+}
+
+void FloatToBe(float f, unsigned char* b) {
+  uint32_t v;
+  std::memcpy(&v, &f, 4);
+  b[0] = (v >> 24) & 0xff;
+  b[1] = (v >> 16) & 0xff;
+  b[2] = (v >> 8) & 0xff;
+  b[3] = v & 0xff;
+}
+
+struct PortSpec {
+  std::string protocol;  // "rtds" | "pscad"
+  int port = 0;
+  std::vector<std::string> states;    // buffer order = index order
+  std::vector<std::string> commands;
+};
+
+// ----------------------------------------------------------------------
+// The DGI half: CRtdsAdapter's peer. Commands first, then states —
+// matching the DGI adapter's send-then-read (CRtdsAdapter::Run).
+// ----------------------------------------------------------------------
+void ServeRtdsConn(const PortSpec& spec, int fd) {
+  std::vector<unsigned char> cmd_buf(spec.commands.size() * 4);
+  std::vector<unsigned char> state_buf(spec.states.size() * 4);
+  while (!g_stop.load()) {
+    if (!spec.commands.empty()) {
+      if (!ReadExactly(fd, cmd_buf.data(), cmd_buf.size())) break;
+      for (std::size_t i = 0; i < spec.commands.size(); ++i) {
+        float v = BeToFloat(&cmd_buf[i * 4]);
+        // NULL_COMMAND entries leave the table untouched.
+        if (std::abs(v - kNullCommand) > 0.5f) {
+          g_command_table.Set(spec.commands[i], v);
+        }
+      }
+    }
+    for (std::size_t i = 0; i < spec.states.size(); ++i) {
+      FloatToBe(static_cast<float>(g_state_table.Get(spec.states[i])),
+                &state_buf[i * 4]);
+    }
+    if (!spec.states.empty() &&
+        !WriteAll(fd, state_buf.data(), state_buf.size())) {
+      break;
+    }
+    if (spec.commands.empty() && spec.states.empty()) break;
+  }
+  ::close(fd);
+}
+
+// ----------------------------------------------------------------------
+// The simulation half: CSimulationAdapter's protocol.
+// ----------------------------------------------------------------------
+void ServeSimConn(const PortSpec& spec, int fd) {
+  char header[kSimHeaderSize];
+  while (!g_stop.load()) {
+    if (!ReadExactly(fd, header, kSimHeaderSize)) break;
+    std::string kind(header, strnlen(header, kSimHeaderSize));
+    if (kind == "RST" || kind == "SET") {
+      std::vector<double> vals(spec.states.size());
+      if (!spec.states.empty() &&
+          !ReadExactly(fd, vals.data(), vals.size() * sizeof(double))) {
+        break;
+      }
+      for (std::size_t i = 0; i < spec.states.size(); ++i) {
+        g_state_table.Set(spec.states[i], vals[i]);
+      }
+      if (kind == "RST") {
+        // CTableManager::UpdateTable(COMMAND_TABLE, STATE_TABLE).
+        for (std::size_t i = 0; i < spec.states.size(); ++i) {
+          g_command_table.Set(spec.states[i], vals[i]);
+        }
+      }
+    } else if (kind == "GET") {
+      std::vector<double> vals(spec.commands.size());
+      for (std::size_t i = 0; i < spec.commands.size(); ++i) {
+        vals[i] = g_command_table.Get(spec.commands[i]);
+      }
+      if (!vals.empty() &&
+          !WriteAll(fd, vals.data(), vals.size() * sizeof(double))) {
+        break;
+      }
+    } else {
+      // Unknown verb: payload length unknowable, the stream cannot
+      // resync — drop the connection (the client reconnects).
+      std::cerr << "tableserver: unrecognized header, closing\n";
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+int Listen(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 8) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int BoundPort(int fd) {
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  return ntohs(addr.sin_port);
+}
+
+void AcceptLoop(PortSpec spec, int srv) {
+  while (!g_stop.load()) {
+    int conn = ::accept(srv, nullptr, nullptr);
+    if (conn < 0) break;
+    std::thread(spec.protocol == "pscad" ? ServeSimConn : ServeRtdsConn,
+                spec, conn)
+        .detach();
+  }
+  ::close(srv);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::istream* in = &std::cin;
+  std::ifstream file;
+  if (argc > 1) {
+    file.open(argv[1]);
+    if (!file) {
+      std::cerr << "tableserver: cannot open " << argv[1] << "\n";
+      return 1;
+    }
+    in = &file;
+  }
+
+  std::vector<PortSpec> specs;
+  std::string line;
+  while (std::getline(*in, line)) {
+    auto hash = line.find('#');
+    if (hash != std::string::npos) line.erase(hash);
+    std::istringstream ls(line);
+    std::string verb;
+    if (!(ls >> verb)) continue;
+    if (verb == "seed") {
+      std::string key;
+      double value;
+      if (ls >> key >> value) g_state_table.Set(key, value);
+      continue;
+    }
+    if (verb != "rtds" && verb != "pscad") {
+      std::cerr << "tableserver: unknown verb '" << verb << "'\n";
+      return 1;
+    }
+    PortSpec spec;
+    spec.protocol = verb;
+    ls >> spec.port;
+    std::string tok;
+    std::vector<std::string>* target = nullptr;
+    while (ls >> tok) {
+      if (tok == "states") {
+        target = &spec.states;
+      } else if (tok == "commands") {
+        target = &spec.commands;
+      } else if (target) {
+        target->push_back(tok);
+      } else {
+        std::cerr << "tableserver: stray token '" << tok << "'\n";
+        return 1;
+      }
+    }
+    specs.push_back(std::move(spec));
+  }
+  if (specs.empty()) {
+    std::cerr << "tableserver: no ports configured\n";
+    return 1;
+  }
+
+  std::vector<std::thread> acceptors;
+  std::ostringstream ports_json;
+  ports_json << "{\"tableserver\": [";
+  for (std::size_t i = 0; i < specs.size(); ++i) {
+    int srv = Listen(specs[i].port);
+    if (srv < 0) {
+      std::cerr << "tableserver: cannot bind port " << specs[i].port << "\n";
+      return 1;
+    }
+    if (i) ports_json << ", ";
+    ports_json << "[\"127.0.0.1\", " << BoundPort(srv) << "]";
+    acceptors.emplace_back(AcceptLoop, specs[i], srv);
+  }
+  ports_json << "]}";
+  std::cout << ports_json.str() << std::endl;
+
+  for (auto& t : acceptors) t.join();
+  return 0;
+}

@@ -1,0 +1,144 @@
+"""Native C++ table-server tests.
+
+``native/tableserver.cpp`` is the standalone C++ role of the reference's
+pscad-interface (``pscad-interface-master/src``): reader/writer-locked
+state/command tables served over the RTDS byte protocol (to DGI
+processes) and the PSCAD header protocol (to a co-simulation) — for
+co-sim hosts that must not carry a Python/JAX runtime.  These tests
+build it with g++, then drive both protocols from Python, including
+wire interop with the framework's own RtdsAdapter.
+"""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from freedm_tpu.core.config import NULL_COMMAND
+from freedm_tpu.devices.adapters.rtds import WIRE_DTYPE, read_exactly
+from freedm_tpu.sim.plantserver import SIM_DTYPE, SIM_HEADER_SIZE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None and shutil.which("make") is None,
+    reason="no C++ toolchain",
+)
+
+
+@pytest.fixture(scope="module")
+def binary():
+    subprocess.run(["make", "-C", NATIVE], check=True, capture_output=True)
+    return os.path.join(NATIVE, "tableserver")
+
+
+@pytest.fixture
+def server(binary, tmp_path):
+    """Two ports over one table pair; index 0 rtds, index 1 pscad."""
+    cfg = tmp_path / "tables.cfg"
+    cfg.write_text(
+        "# shared tables: one DGI rtds port, one PSCAD sim port\n"
+        "seed SST1.gateway 5.5\n"
+        "seed LOAD_A.drain 20.0\n"
+        "rtds 0 states SST1.gateway LOAD_A.drain commands SST1.gateway\n"
+        "pscad 0 states LOAD_A.drain commands SST1.gateway\n"
+    )
+    proc = subprocess.Popen(
+        [binary, str(cfg)], stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    line = proc.stdout.readline()
+    try:
+        ports = [tuple(p) for p in json.loads(line)["tableserver"]]
+    except Exception:
+        proc.kill()
+        raise RuntimeError(f"tableserver failed: {line!r} {proc.stderr.read()}")
+    yield ports
+    proc.kill()
+    proc.wait(timeout=5)
+
+
+def sim_header(kind):
+    return kind.encode().ljust(SIM_HEADER_SIZE, b"\x00")
+
+
+def test_rtds_exchange_serves_seeded_states(server):
+    rtds_addr, _ = server
+    with socket.create_connection(rtds_addr, timeout=5) as s:
+        cmds = np.full(1, NULL_COMMAND, WIRE_DTYPE)
+        s.sendall(cmds.tobytes())
+        raw = read_exactly(s, 2 * 4)
+    states = np.frombuffer(raw, WIRE_DTYPE)
+    assert states[0] == pytest.approx(5.5)
+    assert states[1] == pytest.approx(20.0)
+
+
+def test_dgi_command_crosses_to_pscad_get(server):
+    rtds_addr, sim_addr = server
+    with socket.create_connection(rtds_addr, timeout=5) as s:
+        s.sendall(np.asarray([42.5], WIRE_DTYPE).tobytes())
+        read_exactly(s, 2 * 4)  # sync: command applied before reply
+    with socket.create_connection(sim_addr, timeout=5) as s:
+        s.sendall(sim_header("GET"))
+        raw = read_exactly(s, SIM_DTYPE.itemsize)
+    assert np.frombuffer(raw, SIM_DTYPE)[0] == pytest.approx(42.5)
+
+
+def test_pscad_set_crosses_to_rtds_states(server):
+    rtds_addr, sim_addr = server
+    with socket.create_connection(sim_addr, timeout=5) as sim:
+        sim.sendall(sim_header("SET") + np.asarray([33.0], SIM_DTYPE).tobytes())
+        sim.sendall(sim_header("GET"))
+        read_exactly(sim, SIM_DTYPE.itemsize)  # sync
+    with socket.create_connection(rtds_addr, timeout=5) as s:
+        s.sendall(np.full(1, NULL_COMMAND, WIRE_DTYPE).tobytes())
+        raw = read_exactly(s, 2 * 4)
+    assert np.frombuffer(raw, WIRE_DTYPE)[1] == pytest.approx(33.0)
+
+
+def test_framework_rtds_adapter_interops(server):
+    """The framework's own RtdsAdapter runs its lock-step exchange
+    against the native server — full wire compatibility."""
+    from freedm_tpu.devices.adapters.rtds import RtdsAdapter
+    from freedm_tpu.devices.manager import DeviceManager
+
+    rtds_addr, _ = server
+    manager = DeviceManager()
+    a = RtdsAdapter(rtds_addr[0], int(rtds_addr[1]), poll_s=0.01)
+    manager.add_device("SST1", "Sst", a)
+    manager.add_device("LOAD_A", "Load", a)
+    a.bind_state("SST1", "gateway", 0)
+    a.bind_state("LOAD_A", "drain", 1)
+    a.bind_command("SST1", "gateway", 0)
+    a.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not a.revealed:
+            time.sleep(0.01)
+        assert a.revealed, a.error
+        assert manager.get_state("LOAD_A", "drain") == pytest.approx(20.0)
+        # Commands land in the COMMAND table (the simulator's side of
+        # the contract — static tables don't feed commands back into
+        # states the way the live-physics plantserver does).
+        manager.set_command("SST1", "gateway", 7.0)
+        deadline = time.monotonic() + 5
+        got = None
+        while time.monotonic() < deadline:
+            with socket.create_connection(server[1], timeout=5) as s:
+                s.sendall(sim_header("GET"))
+                got = np.frombuffer(
+                    read_exactly(s, SIM_DTYPE.itemsize), SIM_DTYPE
+                )[0]
+            if got == pytest.approx(7.0):
+                break
+            time.sleep(0.02)
+        assert got == pytest.approx(7.0)
+        assert a.error is None
+    finally:
+        a.stop()

@@ -66,13 +66,44 @@ def test_get_reads_back_dgi_commands(rig):
     assert np.frombuffer(raw, SIM_DTYPE)[0] == 7.5
 
 
-def test_rst_seeds_commands_from_states(rig):
+def test_rst_seeds_commands_from_states():
+    """RST's COMMAND_TABLE ← STATE_TABLE copy: the seeded command
+    survives a later SET that moves the state — GET keeps returning the
+    seed, distinguishing RST from plain SET."""
+    plant = PlantAdapter(cases.vvc_9bus(), {"DESD1": ("Desd", 0)})
+    plant.reveal_devices()
+    server = PlantServer(plant, period_s=0.01)
+    addr = server.add_port(
+        states=[("DESD1", "storage")],
+        commands=[("DESD1", "storage")],
+        protocol="pscad",
+    )
+    server.start()
+    try:
+        with socket.create_connection(addr, timeout=5) as s:
+            s.sendall(header("RST") + np.asarray([5.0], SIM_DTYPE).tobytes())
+            s.sendall(header("SET") + np.asarray([9.0], SIM_DTYPE).tobytes())
+            s.sendall(header("GET"))
+            raw = read_exactly(s, SIM_DTYPE.itemsize)
+        # State followed the SET; the command kept the RST seed.
+        assert plant.get_state("DESD1", "storage") == 9.0
+        assert np.frombuffer(raw, SIM_DTYPE)[0] == 5.0
+    finally:
+        server.stop()
+
+
+def test_unknown_device_binding_warns_not_kills(rig):
+    """A typo'd binding must not kill the serving thread: the rest of
+    the message applies and the connection keeps serving."""
     plant, server, sim_addr, _ = rig
+    server._ports[0].states.insert(0, ("TYPO", "drain"))
     with socket.create_connection(sim_addr, timeout=5) as s:
-        s.sendall(header("RST") + np.asarray([30.0, 45.0], SIM_DTYPE).tobytes())
+        s.sendall(
+            header("SET") + np.asarray([1.0, 27.0, 0.0], SIM_DTYPE).tobytes()
+        )
         s.sendall(header("GET"))
-        read_exactly(s, SIM_DTYPE.itemsize)
-    assert plant.get_state("LOAD_A", "drain") == 30.0
+        read_exactly(s, SIM_DTYPE.itemsize)  # connection still alive
+    assert plant.get_state("LOAD_A", "drain") == 27.0
 
 
 def test_unknown_header_closes_connection_but_server_survives(rig):
