@@ -61,6 +61,7 @@ class Runtime:
     vvc: Optional[VvcModule] = None
     endpoint: Optional[object] = None  # UdpEndpoint in federate mode
     federation: Optional[object] = None
+    telemetry: Optional[object] = None  # TelemetryModule
 
     def start(self) -> "Runtime":
         if self.endpoint is not None:
@@ -102,6 +103,8 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                     help="checkpoint every N rounds (default 1)")
     ap.add_argument("--resume", action="store_true", default=None,
                     help="resume from the checkpoint file if it exists")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="capture a JAX profiler trace of the run into DIR")
     ap.add_argument("--migration-step", type=float, default=None,
                     help="size of LB power migrations")
     ap.add_argument("--malicious-behavior", action="store_true", default=None,
@@ -301,6 +304,11 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         broker.attach_clock_sync(
             ClockSynchronizer(cfg.uuid, federation.known, endpoint.send)
         )
+    from freedm_tpu.runtime.telemetry import TelemetryModule
+
+    telemetry = TelemetryModule()
+    broker.register_module(telemetry, 0)
+
     if cfg.resume and not cfg.checkpoint:
         raise ValueError(
             "--resume needs a checkpoint path (set `checkpoint` in "
@@ -320,7 +328,10 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             logger.status(
                 f"resumed from {cfg.checkpoint} at round {broker.round_index}"
             )
-    return Runtime(cfg, timings, broker, fleet, factories, vvc, endpoint, federation)
+    return Runtime(
+        cfg, timings, broker, fleet, factories, vvc, endpoint, federation,
+        telemetry,
+    )
 
 
 def _round_summary(rt: Runtime) -> Dict[str, object]:
@@ -348,6 +359,11 @@ def _round_summary(rt: Runtime) -> Dict[str, object]:
         out["fed_state"] = fed.state
         out["fed_migrations"] = fed.fed_migrations
         out["fed_accepts"] = shared.get("dcn_accepts", 0)
+    if rt.telemetry is not None:
+        t = rt.telemetry.telemetry.summary()
+        for k in ("round_ms_p50", "round_ms_p95"):
+            if k in t:
+                out[k] = t[k]
     return out
 
 
@@ -370,24 +386,36 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"vvc={'on' if rt.vvc else 'off'}"
     )
     rt.start()
+    import contextlib
+
+    from freedm_tpu.runtime.telemetry import profile_trace
+
+    profiling = (
+        profile_trace(args.profile_dir)
+        if args.profile_dir
+        else contextlib.nullcontext()
+    )
     try:
-        if args.summary_every > 0:
-            done = 0
-            while args.rounds == 0 or done < args.rounds:
-                chunk = args.summary_every
-                if args.rounds:
-                    chunk = min(chunk, args.rounds - done)
-                done += rt.broker.run(n_rounds=chunk, realtime=args.realtime)
-                print(json.dumps(_round_summary(rt)), flush=True)
-        else:
-            rt.broker.run(
-                n_rounds=args.rounds or None, realtime=args.realtime
-            )
+        with profiling:
+            _run_main(args, rt)
     except KeyboardInterrupt:
         pass
     finally:
         rt.stop()
     return 0
+
+
+def _run_main(args, rt: Runtime) -> None:
+    if args.summary_every > 0:
+        done = 0
+        while args.rounds == 0 or done < args.rounds:
+            chunk = args.summary_every
+            if args.rounds:
+                chunk = min(chunk, args.rounds - done)
+            done += rt.broker.run(n_rounds=chunk, realtime=args.realtime)
+            print(json.dumps(_round_summary(rt)), flush=True)
+    else:
+        rt.broker.run(n_rounds=args.rounds or None, realtime=args.realtime)
 
 
 if __name__ == "__main__":

@@ -218,6 +218,7 @@ class Broker:
         budget_sum = 0.0
         for ph in self._phases:
             phase_start = time.time()
+            phase_mono = time.monotonic()
             with self._qlock:
                 ph.queue.extend(ph.next_queue)
                 ph.next_queue = []
@@ -237,6 +238,11 @@ class Broker:
                     task = ph.queue.pop(0)
                 task()
             ph.module.run_phase(ctx)
+            # Per-phase duration for the telemetry arrays (SURVEY §5) —
+            # monotonic, so an NTP step cannot corrupt the record.
+            self.shared[f"_phase_ms_{ph.module.name}"] = (
+                time.monotonic() - phase_mono
+            ) * 1e3
             if realtime:
                 budget_sum += ph.time_ms / 1000.0
                 target = aligned_start + budget_sum

@@ -1,0 +1,153 @@
+"""Per-step telemetry arrays + JAX profiler hookup.
+
+SURVEY §5: the reference's only tracing is ``Logger.Trace <<
+__PRETTY_FUNCTION__`` call-entry spam at verbosity 8 plus offline log
+spreadsheets (``docs/advanced_config/timings.rst:36-60``); the stated
+target for the new framework is "JAX profiler + per-step telemetry
+arrays".  This module provides both:
+
+- :class:`Telemetry` — a fixed-capacity ring of per-round records
+  (phase wall-times, group/migration/loss metrics) kept as numpy
+  columns, cheap enough to leave on in production (~a few hundred bytes
+  per round, no device syncs beyond values the modules already pulled
+  to host).  ``asdict()`` returns column arrays for offline analysis;
+  ``summary()`` the operator roll-up (p50/p95 wall-times).
+- :func:`profile_trace` — a context manager around
+  ``jax.profiler.start_trace`` for on-demand XLA/TPU traces of a run
+  window (the CLI's ``--profile-dir``), viewable in TensorBoard /
+  Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from freedm_tpu.runtime.module import DgiModule, PhaseContext
+
+#: Telemetry columns recorded every round.
+COLUMNS = (
+    "round",
+    "wall_s",  # full-round wall time
+    "gm_ms",
+    "sc_ms",
+    "lb_ms",
+    "vvc_ms",
+    "n_groups",
+    "migrations",
+    "intransit",
+    "vvc_loss_kw",
+    "fed_members",
+)
+
+
+class Telemetry:
+    """Fixed-capacity ring of per-round records (numpy columns)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = int(capacity)
+        self._data = {c: np.zeros(self.capacity) for c in COLUMNS}
+        self._n = 0  # total records ever written
+
+    def record(self, **values: float) -> None:
+        i = self._n % self.capacity
+        for c in COLUMNS:
+            self._data[c][i] = float(values.get(c, np.nan))
+        self._n += 1
+
+    def __len__(self) -> int:
+        return min(self._n, self.capacity)
+
+    def asdict(self) -> Dict[str, np.ndarray]:
+        """Column arrays in chronological order (oldest first)."""
+        n = len(self)
+        i = self._n % self.capacity
+        out = {}
+        for c in COLUMNS:
+            col = self._data[c]
+            out[c] = (
+                col[:n].copy()
+                if self._n <= self.capacity
+                else np.concatenate([col[i:], col[:i]])
+            )
+        return out
+
+    def summary(self) -> Dict[str, float]:
+        """Operator roll-up: round-time percentiles + latest metrics.
+
+        Reads only what it reports (one column + the newest record) —
+        ``--summary-every 1`` calls this every round, so it must not
+        copy the whole ring."""
+        n = len(self)
+        if n == 0:
+            return {"rounds": 0}
+        out: Dict[str, float] = {"rounds": int(self._n)}
+        wall = self._data["wall_s"][:n]
+        wall = wall[~np.isnan(wall)]
+        if wall.size:
+            out["round_ms_p50"] = round(float(np.percentile(wall, 50)) * 1e3, 3)
+            out["round_ms_p95"] = round(float(np.percentile(wall, 95)) * 1e3, 3)
+        newest = (self._n - 1) % self.capacity
+        for c in ("n_groups", "migrations", "vvc_loss_kw", "fed_members"):
+            v = self._data[c][newest]
+            if not np.isnan(v):
+                out[f"last_{c}"] = round(float(v), 6)
+        return out
+
+
+class TelemetryModule(DgiModule):
+    """Snapshots each round's outcome into the telemetry ring (the
+    per-step arrays SURVEY §5 calls for).
+
+    Everything comes from the shared blackboard (phase durations from
+    the broker's per-phase bookkeeping, metrics from the modules) — all
+    already host-side, so recording costs no device round-trips.
+    Register it after the algorithm phases it observes.
+    """
+
+    name = "telemetry"
+
+    def __init__(self, capacity: int = 4096):
+        self.telemetry = Telemetry(capacity)
+        self._round_start: Optional[float] = None
+
+    def run_phase(self, ctx: PhaseContext) -> None:
+        now = time.monotonic()
+        wall = np.nan if self._round_start is None else now - self._round_start
+        self._round_start = now
+        shared = ctx.shared
+        values: Dict[str, float] = {"round": ctx.round_index, "wall_s": wall}
+        group = shared.get("group")
+        if group is not None:
+            values["n_groups"] = int(group.n_groups)
+        lb_out = shared.get("lb_round")
+        if lb_out is not None:
+            values["migrations"] = int(lb_out.n_migrations)
+            values["intransit"] = float(np.sum(np.asarray(lb_out.intransit)))
+        vvc_out = shared.get("vvc")
+        if vvc_out is not None:
+            values["vvc_loss_kw"] = float(vvc_out.loss_after_kw)
+        fed = shared.get("federation")
+        if fed is not None:
+            values["fed_members"] = len(fed.members)
+        for name in ("gm", "sc", "lb", "vvc"):
+            dt = shared.get(f"_phase_ms_{name}")
+            if dt is not None:
+                values[f"{name}_ms"] = dt
+        self.telemetry.record(**values)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """JAX profiler window: every XLA compile/execute inside the block
+    lands in ``log_dir`` (TensorBoard's profile plugin / Perfetto)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
