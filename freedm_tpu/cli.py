@@ -337,15 +337,23 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
 def _round_summary(rt: Runtime) -> Dict[str, object]:
     shared = rt.broker.shared
     out: Dict[str, object] = {"round": rt.broker.round_index}
-    group = shared.get("group")
-    if group is not None:
-        out["n_groups"] = int(group.n_groups)
-    lb_out = shared.get("lb_round")
-    if lb_out is not None:
-        out["migrations"] = int(lb_out.n_migrations)
+    # The telemetry record is the single source for the metrics it
+    # carries — the printed summary can't drift from the stored arrays.
+    latest = rt.telemetry.telemetry.latest() if rt.telemetry else {}
+    if "n_groups" in latest:
+        out["n_groups"] = int(latest["n_groups"])
+    elif shared.get("group") is not None:
+        out["n_groups"] = int(shared["group"].n_groups)
+    if "migrations" in latest:
+        out["migrations"] = int(latest["migrations"])
+    elif shared.get("lb_round") is not None:
+        out["migrations"] = int(shared["lb_round"].n_migrations)
+    if "vvc_loss_kw" in latest:
+        out["vvc_loss_kw"] = round(latest["vvc_loss_kw"], 6)
+    elif shared.get("vvc") is not None:
+        out["vvc_loss_kw"] = round(float(shared["vvc"].loss_after_kw), 6)
     vvc_out = shared.get("vvc")
     if vvc_out is not None:
-        out["vvc_loss_kw"] = round(float(vvc_out.loss_after_kw), 6)
         out["vvc_improved"] = bool(vvc_out.improved)
     readings = rt.fleet.last_readings
     if readings is not None:

@@ -640,6 +640,10 @@ class LbModule(DgiModule):
             ctx.shared["fed_intransit"] = self.fed.fed_intransit
         fleet.write_gateways(gateway)
         ctx.shared["lb_intransit"] = out.intransit
+        # Host scalar for telemetry/summaries — published here, where
+        # the round's outputs are being materialized anyway, so no
+        # other reader needs its own device sync.
+        ctx.shared["lb_intransit_total"] = float(jnp.sum(out.intransit))
         ctx.shared["lb_round"] = out
         self.total_migrations += int(out.n_migrations)
         self.rounds += 1

@@ -75,6 +75,19 @@ class Telemetry:
             )
         return out
 
+    def latest(self) -> Dict[str, float]:
+        """The newest record's non-NaN fields — the single source the
+        operator summary reads so printed summaries can never drift
+        from the recorded columns."""
+        if self._n == 0:
+            return {}
+        i = (self._n - 1) % self.capacity
+        return {
+            c: float(self._data[c][i])
+            for c in COLUMNS
+            if not np.isnan(self._data[c][i])
+        }
+
     def summary(self) -> Dict[str, float]:
         """Operator roll-up: round-time percentiles + latest metrics.
 
@@ -126,7 +139,11 @@ class TelemetryModule(DgiModule):
         lb_out = shared.get("lb_round")
         if lb_out is not None:
             values["migrations"] = int(lb_out.n_migrations)
-            values["intransit"] = float(np.sum(np.asarray(lb_out.intransit)))
+            # Pre-summed host scalar published by LbModule — reading the
+            # device array here would add a per-round blocking sync.
+            intransit = shared.get("lb_intransit_total")
+            if intransit is not None:
+                values["intransit"] = intransit
         vvc_out = shared.get("vvc")
         if vvc_out is not None:
             values["vvc_loss_kw"] = float(vvc_out.loss_after_kw)

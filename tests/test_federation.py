@@ -338,3 +338,74 @@ def test_federated_processes_e2e(tmp_path):
     finally:
         a.kill()
         b.kill()
+
+
+def test_three_slices_form_one_group_and_balance():
+    """Three processes federate into one group (transitive invites:
+    the winning coordinator absorbs both others) and the draft auction
+    serves two demand slices from one supply."""
+    pa, pb, pc = free_udp_ports(3)
+    a = Slice(pa, [pb, pc], generation=30.0, drain=10.0)  # +20
+    b = Slice(pb, [pa, pc], drain=10.0)  # -10
+    c = Slice(pc, [pa, pb], drain=10.0)  # -10
+    slices = [a, b, c]
+    try:
+        all_uuids = {a.uuid, b.uuid, c.uuid}
+        ok = run_until(
+            slices,
+            lambda: all(s.fed.members == all_uuids for s in slices)
+            and len({s.fed.leader for s in slices}) == 1,
+            timeout_s=30.0,
+        )
+        assert ok, [s.fed.view() for s in slices]
+        want = max(all_uuids, key=process_priority)
+        assert a.fed.leader == want
+        ok = run_until(
+            slices,
+            lambda: a.gateway() >= 19.0
+            and b.gateway() <= -9.0
+            and c.gateway() <= -9.0
+            and a.fed.fed_intransit == 0,
+            timeout_s=40.0,
+        )
+        assert ok, (a.gateway(), b.gateway(), c.gateway())
+        total = sum(s.gateway() for s in slices)
+        assert abs(total) < 1e-6
+    finally:
+        for s in slices:
+            s.stop()
+
+
+def test_federation_survives_lossy_links():
+    """30% datagram loss in every direction: the SR transport's resends
+    carry the election and the draft auction through, and the
+    late-accept/rollback reconciliation keeps the total conserved."""
+    pa, pb = free_udp_ports(2)
+    a = Slice(pa, [pb], generation=30.0, drain=10.0)
+    b = Slice(pb, [pa], drain=20.0)
+    for s, other, seed in ((a, b, 1), (b, a, 2)):
+        s.endpoint._rng = np.random.default_rng(seed)
+        s.endpoint.incoming_reliability = 70
+        s.endpoint._peers[other.uuid].reliability = 70
+    try:
+        assert run_until(
+            [a, b],
+            lambda: a.fed.members == {a.uuid, b.uuid} == b.fed.members,
+            timeout_s=40.0,
+        ), (a.fed.view(), b.fed.view())
+        ok = run_until(
+            [a, b],
+            lambda: a.gateway() >= 19.0
+            and b.gateway() <= -19.0
+            and a.fed.fed_intransit == 0
+            and b.fed.fed_intransit == 0,
+            timeout_s=60.0,
+            sleep_s=0.02,
+        )
+        assert ok, (a.gateway(), b.gateway(), a.fed.fed_rollbacks)
+        # Conservation held through every loss/rollback interleaving.
+        total = a.gateway() + b.gateway()
+        assert abs(total) < 1e-6
+    finally:
+        a.stop()
+        b.stop()
