@@ -337,21 +337,19 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
 def _round_summary(rt: Runtime) -> Dict[str, object]:
     shared = rt.broker.shared
     out: Dict[str, object] = {"round": rt.broker.round_index}
-    # The telemetry record is the single source for the metrics it
-    # carries — the printed summary can't drift from the stored arrays.
-    latest = rt.telemetry.telemetry.latest() if rt.telemetry else {}
-    if "n_groups" in latest:
-        out["n_groups"] = int(latest["n_groups"])
-    elif shared.get("group") is not None:
-        out["n_groups"] = int(shared["group"].n_groups)
-    if "migrations" in latest:
-        out["migrations"] = int(latest["migrations"])
-    elif shared.get("lb_round") is not None:
-        out["migrations"] = int(shared["lb_round"].n_migrations)
-    if "vvc_loss_kw" in latest:
-        out["vvc_loss_kw"] = round(latest["vvc_loss_kw"], 6)
-    elif shared.get("vvc") is not None:
-        out["vvc_loss_kw"] = round(float(shared["vvc"].loss_after_kw), 6)
+    # The telemetry roll-up is the single source for the metrics it
+    # carries — the printed summary cannot drift from the stored arrays
+    # (TelemetryModule runs after every metric producer each round).
+    t = rt.telemetry.telemetry.summary() if rt.telemetry else {}
+    if "last_n_groups" in t:
+        out["n_groups"] = int(t["last_n_groups"])
+    if "last_migrations" in t:
+        out["migrations"] = int(t["last_migrations"])
+    if "last_vvc_loss_kw" in t:
+        out["vvc_loss_kw"] = round(t["last_vvc_loss_kw"], 6)
+    for k in ("round_ms_p50", "round_ms_p95"):
+        if k in t:
+            out[k] = t[k]
     vvc_out = shared.get("vvc")
     if vvc_out is not None:
         out["vvc_improved"] = bool(vvc_out.improved)
@@ -367,11 +365,6 @@ def _round_summary(rt: Runtime) -> Dict[str, object]:
         out["fed_state"] = fed.state
         out["fed_migrations"] = fed.fed_migrations
         out["fed_accepts"] = shared.get("dcn_accepts", 0)
-    if rt.telemetry is not None:
-        t = rt.telemetry.telemetry.summary()
-        for k in ("round_ms_p50", "round_ms_p95"):
-            if k in t:
-                out[k] = t[k]
     return out
 
 

@@ -75,19 +75,6 @@ class Telemetry:
             )
         return out
 
-    def latest(self) -> Dict[str, float]:
-        """The newest record's non-NaN fields — the single source the
-        operator summary reads so printed summaries can never drift
-        from the recorded columns."""
-        if self._n == 0:
-            return {}
-        i = (self._n - 1) % self.capacity
-        return {
-            c: float(self._data[c][i])
-            for c in COLUMNS
-            if not np.isnan(self._data[c][i])
-        }
-
     def summary(self) -> Dict[str, float]:
         """Operator roll-up: round-time percentiles + latest metrics.
 

@@ -204,8 +204,12 @@ def test_late_accept_after_rollback_conserves_power(pair):
 # ---------------------------------------------------------------------------
 
 
-def _write_fed_configs(tmp_path, ports, me, peer):
-    """Reference-style config set for one federated process."""
+def _write_fed_configs(tmp_path, ports, me, peer, timings_overrides=None):
+    """Reference-style config set for one federated process.
+
+    ``timings_overrides`` patches fields of the serialized timings.cfg
+    (e.g. small realtime phase budgets) — callers must not hand-write
+    the file, or later helper calls would overwrite it with defaults."""
     from freedm_tpu.devices.schema import DEFAULT_TYPES
     import dataclasses
 
@@ -219,11 +223,12 @@ def _write_fed_configs(tmp_path, ports, me, peer):
         lines.append("  </deviceType>")
     lines.append("</root>")
     (tmp_path / "device.xml").write_text("\n".join(lines))
+    tvals = {
+        f.name: getattr(Timings(), f.name) for f in dataclasses.fields(Timings)
+    }
+    tvals.update(timings_overrides or {})
     (tmp_path / "timings.cfg").write_text(
-        "\n".join(
-            f"{f.name.upper()} = {getattr(Timings(), f.name)}"
-            for f in dataclasses.fields(Timings)
-        )
+        "\n".join(f"{k.upper()} = {v}" for k, v in tvals.items())
     )
     # Both slices' adapters in ONE shared adapter.xml; the owner
     # attribute routes them, non-local owners are skipped in federate
@@ -260,8 +265,9 @@ def _write_fed_configs(tmp_path, ports, me, peer):
 
 
 class _Proc:
-    def __init__(self, cfg):
+    def __init__(self, cfg, extra=()):
         self.cfg = cfg
+        self.extra = list(extra)
         self.lines = []
         self.proc = None
         self.start()
@@ -272,7 +278,7 @@ class _Proc:
         env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "freedm_tpu", "-c", str(self.cfg),
-             "--summary-every", "25"],
+             "--summary-every", "25"] + self.extra,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
         )
         self._t = threading.Thread(target=self._pump, daemon=True)
@@ -409,3 +415,41 @@ def test_federation_survives_lossy_links():
     finally:
         a.stop()
         b.stop()
+
+
+def test_federated_realtime_with_clock_sync_e2e(tmp_path):
+    """The flagship deployment shape, all pieces at once: two federated
+    CLI processes in REALTIME mode on one host, phase budgets honored,
+    clock synchronizer attached, group formed, power migrated."""
+    ports = free_udp_ports(2)
+    # Small realtime budgets: gm 80 + sc 40 + lb 120 = 240 ms rounds.
+    small = dict(gm_phase_time=80, sc_phase_time=40, lb_phase_time=120,
+                 vvc_phase_time=0)
+    cfg_a = _write_fed_configs(
+        tmp_path, ports, ports[0], ports[1], timings_overrides=small
+    )
+    cfg_b = _write_fed_configs(
+        tmp_path, ports, ports[1], ports[0], timings_overrides=small
+    )
+    procs = []
+    try:
+        for cfg in (cfg_a, cfg_b):
+            procs.append(_Proc(cfg, extra=["--realtime"]))
+        # _Proc summarizes every 25 rounds; at 240 ms realtime rounds
+        # that is one summary per ~6 s — fine within the deadline.
+        ok_a = procs[0].wait_for(
+            lambda l: l.get("fed_members") == 2 and l.get("gateway_total", 0) >= 3.0,
+            timeout_s=120.0,
+        )
+        assert ok_a, (procs[0].last(), procs[1].last())
+        assert procs[1].wait_for(
+            lambda l: l.get("fed_members") == 2, timeout_s=60.0
+        )
+        # Realtime honored: round-time p50 tracks the 240 ms budget
+        # (free-running would report ~ms).
+        p50 = procs[0].last().get("round_ms_p50")
+        assert p50 is not None and p50 >= 200.0, procs[0].last()
+        assert procs[0].last().get("fed_leader") == procs[1].last().get("fed_leader")
+    finally:
+        for p in procs:
+            p.kill()
