@@ -13,9 +13,14 @@ same process:
 
 - ``nr_2000bus_mesh_solves_per_sec`` — full Newton-Raphson solves/sec on
   a 2000-bus meshed network (hand-assembled Jacobian, dense LU on MXU);
+- ``fdlf_2000bus_mesh_solves_per_sec`` — the fast-decoupled solver on
+  the same case (B′/B″ factorized once at build time);
 - ``mc_1024lane_118bus_lane_solves_per_sec`` — 1024-scenario Monte-Carlo
   batch (vmap over injections) on a 118-bus mesh, fixed-iteration NR,
   counted in lane-solves/sec;
+- ``mc_1024lane_118bus_fdlf_lane_solves_per_sec`` — the same batch
+  through FDLF, whose lanes share the build-time factorization
+  (~40× the NR batch on v5e);
 - ``n1_118way_contingency_batch_ms`` — the full 118-way N-1 screen (vmap
   over branch status) as one batched solve, total wall ms.
 """
@@ -31,6 +36,7 @@ import numpy as np
 
 from freedm_tpu.grid.cases import synthetic_mesh, synthetic_radial
 from freedm_tpu.pf import ladder
+from freedm_tpu.pf.fdlf import make_fdlf_solver
 from freedm_tpu.pf.newton import make_newton_solver
 
 TARGET_MS_PER_ITER = 10.0
@@ -58,16 +64,16 @@ def bench_ladder():
     return dt / MAX_ITER * 1000.0
 
 
-def bench_nr_2000():
+def bench_nr_2000(maker=make_newton_solver, max_iter=10):
     sys = synthetic_mesh(2000, seed=4, load_mw=2.0, chord_frac=1.0)
-    solve, _ = make_newton_solver(sys, max_iter=10)
+    solve, _ = maker(sys, max_iter=max_iter)
     dt = _time(solve, lambda r: r.v, reps=10)
     return 1.0 / dt
 
 
-def bench_mc_1024():
+def bench_mc_1024(maker=make_newton_solver, max_iter=6):
     sys = synthetic_mesh(118, seed=1, load_mw=10.0, chord_frac=1.0)
-    _, solve_fixed = make_newton_solver(sys, max_iter=6)
+    _, solve_fixed = maker(sys, max_iter=max_iter)
     rng = np.random.default_rng(0)
     scale = rng.uniform(0.7, 1.3, (1024, 1))
     p = jnp.asarray(scale * sys.p_inj[None, :])
@@ -95,7 +101,13 @@ def main() -> None:
     ms_per_iter = bench_ladder()
     extra = {
         "nr_2000bus_mesh_solves_per_sec": round(bench_nr_2000(), 2),
+        "fdlf_2000bus_mesh_solves_per_sec": round(
+            bench_nr_2000(maker=make_fdlf_solver, max_iter=30), 2
+        ),
         "mc_1024lane_118bus_lane_solves_per_sec": round(bench_mc_1024(), 1),
+        "mc_1024lane_118bus_fdlf_lane_solves_per_sec": round(
+            bench_mc_1024(maker=make_fdlf_solver, max_iter=16), 1
+        ),
         "n1_118way_contingency_batch_ms": round(bench_n1_118(), 2),
     }
     print(

@@ -12,4 +12,5 @@ from freedm_tpu.pf.newton import (  # noqa: F401
     make_newton_solver,
     branch_flows,
 )
+from freedm_tpu.pf.fdlf import make_fdlf_solver  # noqa: F401
 from freedm_tpu.pf.sweeps import make_sweeps, dense_sweeps, doubling_sweeps  # noqa: F401

@@ -65,6 +65,30 @@ class NewtonResult(NamedTuple):
     mismatch: jax.Array  # [] float: max |free-equation residual|
 
 
+def s_calc(y: C, theta, v):
+    """Realized (P, Q) bus injections at a voltage profile — the one
+    power-calculation both the Newton and fast-decoupled solvers share
+    (single source, like ``grid.bus.branch_admittances``)."""
+    vc = cplx.polar(v, theta)
+    i = C(y.re @ vc.re - y.im @ vc.im, y.re @ vc.im + y.im @ vc.re)
+    s = vc * i.conj()
+    return s.re, s.im
+
+
+def build_result(y: C, theta, v, it, err, tol) -> NewtonResult:
+    """Assemble the shared result record from a final state."""
+    p_calc, q_calc = s_calc(y, theta, v)
+    return NewtonResult(
+        v=v,
+        theta=theta,
+        p=p_calc,
+        q=q_calc,
+        iterations=jnp.asarray(it, jnp.int32),
+        converged=err < tol,
+        mismatch=err,
+    )
+
+
 def make_newton_solver(
     sys: BusSystem,
     tol: Optional[float] = None,
@@ -104,15 +128,9 @@ def make_newton_solver(
     p_sched0 = jnp.asarray(sys.p_inj, rdtype)
     q_sched0 = jnp.asarray(sys.q_inj, rdtype)
 
-    def _s_calc(y: C, theta, v):
-        vc = cplx.polar(v, theta)
-        i = C(y.re @ vc.re - y.im @ vc.im, y.re @ vc.im + y.im @ vc.re)
-        s = vc * i.conj()
-        return s.re, s.im
-
     def _residual(x, y: C, p_sched, q_sched):
         theta, v = x[:n], x[n:]
-        p_calc, q_calc = _s_calc(y, theta, v)
+        p_calc, q_calc = s_calc(y, theta, v)
         f_p = jnp.where(th_free > 0, p_calc - p_sched, theta)
         f_q = jnp.where(v_free > 0, q_calc - q_sched, v - v_set)
         return jnp.concatenate([f_p, f_q])
@@ -167,17 +185,7 @@ def make_newton_solver(
         return x, y, p_sched, q_sched
 
     def _finish(x, y, p_sched, q_sched, it, err):
-        theta, v = x[:n], x[n:]
-        p_calc, q_calc = _s_calc(y, theta, v)
-        return NewtonResult(
-            v=v,
-            theta=theta,
-            p=p_calc,
-            q=q_calc,
-            iterations=jnp.asarray(it, jnp.int32),
-            converged=err < tol,
-            mismatch=err,
-        )
+        return build_result(y, x[:n], x[n:], it, err, tol)
 
     # NR is precision-critical: the TPU MXU's default reduced-precision
     # matmul passes corrupt the batched blocked LU inside
