@@ -22,7 +22,13 @@ same process:
   through FDLF, whose lanes share the build-time factorization
   (~40× the NR batch on v5e);
 - ``n1_118way_contingency_batch_ms`` — the full 118-way N-1 screen (vmap
-  over branch status) as one batched solve, total wall ms.
+  over branch status) as one batched solve, total wall ms (Newton wins
+  this one: FDLF's per-lane refactorization costs more than it saves at
+  [118,118]);
+- ``lb_256node_rounds_per_sec`` — the LB auction kernel run to
+  convergence on a 256-node group (BASELINE.md north-star "LB
+  convergence wall-clock vs node count"; the reference paces each LB
+  round at 3000 ms, ``LB_ROUND_TIME``).
 """
 
 from __future__ import annotations
@@ -83,6 +89,22 @@ def bench_mc_1024(maker=make_newton_solver, max_iter=6):
     return 1024.0 / dt
 
 
+def bench_lb_256():
+    from freedm_tpu.modules import lb
+
+    n = 256
+    rng = np.random.default_rng(0)
+    netgen = jnp.asarray(rng.normal(0, 10, n))
+    gw0 = jnp.zeros(n)
+    mask = jnp.ones((n, n))
+    rounds = 64  # enough for this imbalance profile to fully converge
+    run = jax.jit(lambda: lb.run_rounds(netgen, gw0, mask, 1.0, rounds))
+    gw, migs, _ = run()
+    assert int(np.asarray(migs)[-1]) == 0, "did not converge in the budget"
+    dt = _time(run, lambda r: r[0], reps=10)
+    return rounds / dt
+
+
 def bench_n1_118():
     sys = synthetic_mesh(118, seed=1, load_mw=10.0, chord_frac=1.0)
     _, solve_fixed = make_newton_solver(sys, max_iter=6)
@@ -109,6 +131,7 @@ def main() -> None:
             bench_mc_1024(maker=make_fdlf_solver, max_iter=16), 1
         ),
         "n1_118way_contingency_batch_ms": round(bench_n1_118(), 2),
+        "lb_256node_rounds_per_sec": round(bench_lb_256(), 1),
     }
     print(
         json.dumps(

@@ -329,14 +329,13 @@ def test_fdlf_2k_mesh_and_n1_batch():
 
 
 def test_fdlf_respects_pv_and_slack_pins():
-    from freedm_tpu.grid.bus import PV, SLACK
     from freedm_tpu.pf.fdlf import make_fdlf_solver
 
     sys = cases.synthetic_mesh(40, seed=9)
     solve, _ = make_fdlf_solver(sys)
     out = solve()
     assert bool(out.converged)
-    pinned = sys.bus_type != 0  # PV + slack hold v_set
+    pinned = sys.bus_type != PQ  # PV + slack hold v_set
     np.testing.assert_allclose(
         np.asarray(out.v)[pinned], sys.v_set[pinned], atol=1e-9
     )
