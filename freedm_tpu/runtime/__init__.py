@@ -14,3 +14,7 @@ from freedm_tpu.runtime.fleet import (  # noqa: F401
     build_broker,
     omega_invariant,
 )
+from freedm_tpu.runtime.checkpoint import CheckpointModule  # noqa: F401
+from freedm_tpu.runtime.clocksync import ClockSynchronizer  # noqa: F401
+from freedm_tpu.runtime.federation import Federation, FederationView  # noqa: F401
+from freedm_tpu.runtime.telemetry import Telemetry, TelemetryModule  # noqa: F401

@@ -48,13 +48,15 @@ from __future__ import annotations
 import hashlib
 import time
 from dataclasses import dataclass, field
-from typing import Dict, NamedTuple, Optional, Set, Tuple
+from typing import TYPE_CHECKING, Dict, NamedTuple, Optional, Set, Tuple
 
 import numpy as np
 
 from freedm_tpu.core.config import Timings
-from freedm_tpu.dcn.endpoint import UdpEndpoint
 from freedm_tpu.runtime.messages import ModuleMessage
+
+if TYPE_CHECKING:  # type-only: a runtime import would cycle through dcn
+    from freedm_tpu.dcn.endpoint import UdpEndpoint
 
 # Federation GM states (GMAgent::EStatus, GroupManagement.hpp).
 NORMAL = "NORMAL"
