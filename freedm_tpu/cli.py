@@ -224,15 +224,14 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
 
     vvc = None
     extra = []
+    vvc_feeder = None
     if cfg.vvc_case:
         from freedm_tpu.grid import cases
 
         try:
-            feeder = getattr(cases, cfg.vvc_case)()
+            vvc_feeder = getattr(cases, cfg.vvc_case)()
         except AttributeError:
             raise ValueError(f"unknown vvc feeder case {cfg.vvc_case!r}") from None
-        vvc = VvcModule(fleet, feeder)
-        extra.append(vvc)
 
     if cfg.mqtt_id:
         # MQTT plug-and-play on this node (the reference wires mqtt-id/
@@ -287,6 +286,12 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         )
         if cfg.network_config:
             load_network_config(endpoint, cfg.network_config)
+
+    if vvc_feeder is not None:
+        # Built after the federation so a federated VVC can run the
+        # master/slave hand-off across slices.
+        vvc = VvcModule(fleet, vvc_feeder, federation=federation)
+        extra.append(vvc)
 
     invariant = omega_invariant() if cfg.check_invariant else None
     broker = build_broker(

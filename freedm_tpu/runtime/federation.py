@@ -75,6 +75,11 @@ LB_TYPES = frozenset(
 )
 #: sc-recipient message types the federation consumes.
 SC_TYPES = frozenset({"sc_state"})
+#: vvc-recipient message types: the master/slave hand-off
+#: (GradientMessage → vvc_slave actuation, Broker_s1..s3's
+#: ``VoltVarCtrl.cpp:146-154`` xx.mat persistence collapsed to a
+#: setpoint message).
+VVC_TYPES = frozenset({"vvc_state", "vvc_set"})
 
 
 def process_priority(uuid: str) -> int:
@@ -192,6 +197,14 @@ class Federation:
 
         # -- SC state --
         self._peer_states: Dict[str, Tuple[Dict[str, float], _Deadline]] = {}
+
+        # -- VVC master/slave state --
+        # member uuid -> (readings [(row, pi, val)], sst keys [(row, pi)],
+        # freshness) pushed each VVC phase; slaves hold the last
+        # setpoints their master shipped, with a freshness stamp.
+        self._vvc_peer_inputs: Dict[str, Tuple[list, list, _Deadline]] = {}
+        self._vvc_setpoints: Optional[list] = None
+        self._vvc_set_seen = _Deadline(0, 0.0)
 
     # ------------------------------------------------------------------
     # plumbing
@@ -470,9 +483,15 @@ class Federation:
     # LB: the draft auction at slice granularity
     # ------------------------------------------------------------------
     def _reset_lb(self) -> None:
-        # Group changed: drafts against the old group are void.
+        # Group changed: drafts against the old group are void, and so
+        # are a defunct master's VVC setpoints and member inputs — a
+        # slave joining a new group must not actuate the old master's
+        # Q values against fresh load conditions.
         self.demand_peers.clear()
         self._draft_ages.clear()
+        self._vvc_setpoints = None
+        self._vvc_set_seen = _Deadline(0, 0.0)
+        self._vvc_peer_inputs.clear()
 
     def _ensure_delta(self, n: int) -> np.ndarray:
         if self._fed_delta is None or self._fed_delta.shape[0] != n:
@@ -641,3 +660,84 @@ class Federation:
                 {k: float(v) for k, v in msg.payload.items()},
                 self._now(),
             )
+
+    # ------------------------------------------------------------------
+    # VVC: the master/slave setpoint hand-off
+    # ------------------------------------------------------------------
+    @property
+    def vvc_in_group(self) -> bool:
+        """A settled group member (not its coordinator) — the slice that
+        SHOULD be driven by a master, the reference's vvc_slave role
+        (Broker_s1..s3).  Whether it actually defers is gated by
+        :meth:`vvc_take_setpoints`: a coordinator that runs no VVC
+        module (or died) never ships setpoints, and the member falls
+        back to its own gradient loop instead of going dark."""
+        return (
+            self.state == NORMAL
+            and not self.is_coordinator
+            and len(self.members) > 1
+        )
+
+    def vvc_push_state(self, readings, sst_keys) -> None:
+        """Slave → master: this slice's live (non-stale) Pload readings
+        and the control rows its Sst_x devices cover."""
+        self._send(
+            self.leader,
+            "vvc",
+            "vvc_state",
+            readings=[[int(r), int(p), float(v)] for r, p, v in readings],
+            ssts=[[int(r), int(p)] for r, p in sst_keys],
+        )
+
+    def vvc_remote_inputs(self):
+        """Master: fresh member readings and remote control keys.
+
+        Returns ``(readings [(row, pi, val)], sst_keys [(row, pi)])``
+        from members whose push is recent — a partitioned slave's rows
+        silently leave the control mask, like its devices dying."""
+        readings, keys = [], []
+        for u in self.members - {self.uuid}:
+            entry = self._vvc_peer_inputs.get(u)
+            if entry is None:
+                continue
+            r, s, seen = entry
+            if seen.expired(self._round, 3, 3 * self.ayt_timeout_s):
+                continue
+            readings += [(int(a), int(b), float(c)) for a, b, c in r]
+            keys += [(int(a), int(b)) for a, b in s]
+        return readings, keys
+
+    def vvc_send_setpoints(self, entries) -> None:
+        """Master → slaves: the accepted Q setpoints for remote rows
+        (the GradientMessage role, one message per member)."""
+        payload = [[int(r), int(p), float(v)] for r, p, v in entries]
+        self._broadcast(
+            self.members - {self.uuid}, "vvc", "vvc_set", q=payload
+        )
+
+    def vvc_take_setpoints(self) -> Optional[list]:
+        """Slave: the most recent setpoints from the master (kept, not
+        consumed — re-applied until superseded, like the reference slave
+        re-reading its persisted xx.mat).  ``None`` when nothing fresh
+        arrived — the master runs no VVC, or stopped — which flips the
+        member back to standalone control."""
+        if self._vvc_setpoints is None:
+            return None
+        if self._vvc_set_seen.expired(self._round, 3, 3 * self.ayt_timeout_s):
+            return None
+        return self._vvc_setpoints
+
+    def handle_vvc(self, msg: ModuleMessage) -> None:
+        src = msg.source
+        if not src or src == self.uuid:
+            return
+        p = msg.payload
+        if msg.type == "vvc_state":
+            if src in self.members:
+                self._vvc_peer_inputs[src] = (
+                    p.get("readings", []), p.get("ssts", []), self._now()
+                )
+        elif msg.type == "vvc_set":
+            if src == self.leader:
+                self._vvc_setpoints = p.get("q", [])
+                self._vvc_set_seen = self._now()

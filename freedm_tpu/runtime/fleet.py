@@ -705,9 +705,17 @@ class VvcModule(DgiModule):
     equal to the feeder's default is "Signal not updated" and the
     default is kept), runs one gradient round with backtracking line
     search (``vvc_main``), and scatters the accepted Q setpoints to the
-    per-phase ``Sst_a/b/c`` devices as ``gateway`` commands — the
-    master/slave ``GradientMessage``→``vvc_slave`` hand-off collapsed
-    into a direct device write.
+    per-phase ``Sst_a/b/c`` devices as ``gateway`` commands — within one
+    slice, the master/slave ``GradientMessage``→``vvc_slave`` hand-off
+    collapses into a direct device write.
+
+    ACROSS federated slices the hand-off is real again (the reference's
+    master + Broker_s1..s3 slaves): when a :class:`Federation` is
+    attached and this slice is a group member, the module runs as a
+    SLAVE — it ships its live Pload readings and Sst rows to the
+    coordinator each VVC phase and actuates whatever setpoints come
+    back; the coordinator's module runs the gradient step over the
+    union of local and member rows and ships the members' rows to them.
 
     Device → feeder-branch mapping: ``row_of`` overrides per name;
     otherwise the first integer in the device name is the 0-based branch
@@ -725,10 +733,12 @@ class VvcModule(DgiModule):
         config=None,
         row_of: Optional[Dict[str, int]] = None,
         alpha0: float = 2000.0,
+        federation=None,
     ):
         from freedm_tpu.modules import vvc as vvc_mod
 
         self.fleet = fleet
+        self.fed = federation
         self.feeder = feeder
         self.config = config or vvc_mod.VVCConfig()
         self.row_of = dict(row_of or {})
@@ -749,7 +759,14 @@ class VvcModule(DgiModule):
         self.rounds = 0
         self.improved_rounds = 0
         self.stale_reads = 0
+        self.slave_rounds = 0
         self.last = None
+
+    def handle_message(self, msg, ctx=None) -> None:
+        from freedm_tpu.runtime.federation import VVC_TYPES
+
+        if self.fed is not None and msg.type in VVC_TYPES:
+            self.fed.handle_vvc(msg)
 
     def _row(self, device: str) -> int:
         if device in self.row_of:
@@ -786,11 +803,12 @@ class VvcModule(DgiModule):
                     out.append((node.manager, name, self._row(name), pi))
         return out
 
-    def _refresh_mask(self, ssts: List[tuple]) -> None:
+    def _refresh_mask(self, keys) -> None:
         """Controllable node-phases = where Sst_x devices exist (the
-        reference's S2 vector covers exactly the SST rows).  Recompiles
-        the step when the set changes (device reveal/PnP arrival)."""
-        key = tuple(sorted((row, pi) for _, _, row, pi in ssts))
+        reference's S2 vector covers exactly the SST rows) — plus, for a
+        federated master, the member slices' rows.  Recompiles the step
+        when the set changes (device reveal/PnP arrival)."""
+        key = tuple(sorted(set(keys)))
         if key == self._mask_key:
             return
         self._mask_key = key
@@ -799,12 +817,13 @@ class VvcModule(DgiModule):
             mask[row, pi] = 1.0
         self._step = self._make(mask)
 
-    def run_phase(self, ctx: PhaseContext) -> None:
-        fleet = self.fleet
-        # Start from the feeder's configured spot loads (the Dl table)
-        # and overlay live per-phase readings.
+    def _live_loads(self):
+        """The feeder's spot loads overlaid with live per-phase device
+        readings; also returns the accepted (non-stale) readings for a
+        slave's push to its master."""
         s_load = np.array(self.feeder.s_load, dtype=np.complex128)
-        for node in fleet.nodes:
+        live = []
+        for node in self.fleet.nodes:
             if not node.alive:
                 continue
             for pi, ph in enumerate(self.PHASES):
@@ -824,25 +843,69 @@ class VvcModule(DgiModule):
                         self.stale_reads += 1
                     else:
                         s_load[row, pi] = val + 1j * s_load[row, pi].imag
+                        live.append((row, pi, val))
+        return s_load, live
+
+    def run_phase(self, ctx: PhaseContext) -> None:
+        s_load, live = self._live_loads()
         ssts = self._sst_devices()
-        if not ssts:
-            # No live per-phase SST: nothing to actuate.  Computing a
-            # full-mask "descent" here would publish falling losses the
-            # plant never sees (controls in model only) — skip instead,
-            # like the reference module logging an empty device set.
+        fed = self.fed
+        if fed is not None and fed.vvc_in_group:
+            # Group member: ship readings + control rows to the master
+            # every phase.  Actuate its setpoints while they flow
+            # (Broker_s1..s3's vvc_slave); if none are fresh — the
+            # coordinator runs no VVC, or died — fall THROUGH to the
+            # standalone gradient loop rather than going dark.
+            fed.vvc_push_state(live, [(row, pi) for _, _, row, pi in ssts])
+            sets = fed.vvc_take_setpoints()
+            if sets is not None:
+                by_key = {(int(r), int(p)): float(v) for r, p, v in sets}
+                for manager, name, row, pi in ssts:
+                    if (row, pi) in by_key:
+                        manager.set_command(name, "gateway", by_key[(row, pi)])
+                        self.q_kvar[row, pi] = by_key[(row, pi)]
+                self.slave_rounds += 1
+                ctx.shared.pop("vvc", None)
+                return
+        remote_keys: List[tuple] = []
+        if fed is not None and fed.is_coordinator:
+            # MASTER: overlay fresh member readings; their Sst rows join
+            # the control mask and their setpoints ship back below.
+            r_readings, remote_keys = fed.vvc_remote_inputs()
+            nb = self.feeder.n_branches
+            remote_keys = [
+                (r, p) for r, p in remote_keys if 0 <= r < nb and 0 <= p < 3
+            ]
+            for row, pi, val in r_readings:
+                if 0 <= row < nb and 0 <= pi < 3:
+                    s_load[row, pi] = val + 1j * s_load[row, pi].imag
+        local_keys = [(row, pi) for _, _, row, pi in ssts]
+        if not local_keys and not remote_keys:
+            # No live per-phase SST anywhere: nothing to actuate.
+            # Computing a full-mask "descent" here would publish falling
+            # losses the plant never sees (controls in model only) —
+            # skip instead, like the reference module logging an empty
+            # device set.
             self.skipped_rounds += 1
             ctx.shared.pop("vvc", None)
             return
-        self._refresh_mask(ssts)
+        self._refresh_mask(local_keys + remote_keys)
         out = self._step(s_load, self.q_kvar, self.alpha)
         improved = bool(out.improved)
-        self.q_kvar = np.asarray(out.q_ctrl_kvar)
+        # Writable copy, not a device-array view: a later election may
+        # demote this module to slave, which writes rows in place.
+        self.q_kvar = np.array(out.q_ctrl_kvar)
         self.alpha = max(
             float(out.alpha) * 2.0 if improved else self.alpha * 0.5, 1e-3
         )
-        # Scatter accepted setpoints to the per-phase SST devices.
+        # Scatter accepted setpoints: local rows to the per-phase SST
+        # devices, member rows over the DCN (the GradientMessage role).
         for manager, name, row, pi in ssts:
             manager.set_command(name, "gateway", float(self.q_kvar[row, pi]))
+        if remote_keys and fed is not None:
+            fed.vvc_send_setpoints(
+                [(r, p, float(self.q_kvar[r, p])) for r, p in remote_keys]
+            )
         self.rounds += 1
         self.improved_rounds += int(improved)
         self.last = out

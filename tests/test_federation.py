@@ -453,3 +453,164 @@ def test_federated_realtime_with_clock_sync_e2e(tmp_path):
     finally:
         for p in procs:
             p.kill()
+
+
+def test_federated_vvc_master_drives_slave_devices():
+    """The reference's master/slave VVC (GradientMessage -> vvc_slave,
+    Broker_s1..s3): a member slice ships its Pload readings and Sst rows
+    to the coordinator, whose gradient step covers the union of rows and
+    ships the member rows back; the slave actuates them locally."""
+    from freedm_tpu.grid import cases
+    from freedm_tpu.runtime import VvcModule
+    from freedm_tpu.runtime.fleet import build_broker as _bb
+
+    feeder = cases.vvc_9bus()
+    pa, pb = free_udp_ports(2)
+    slices = {}
+    for port, peer, rows in ((pa, pb, (2, 3)), (pb, pa, (4, 5, 6))):
+        uuid = f"127.0.0.1:{port}"
+        seeds = {}
+        names = {}
+        for row in rows:
+            for pi, ph in enumerate("abc"):
+                seeds[(f"Q{row}_{ph}", "gateway")] = 0.0
+                names[f"Q{row}_{ph}"] = f"Sst_{ph}"
+        fake = FakeAdapter(seeds)
+        manager = DeviceManager()
+        for name, tname in names.items():
+            manager.add_device(name, tname, fake)
+        fake.reveal_devices()
+        fleet = Fleet([NodeHandle(uuid, manager)], migration_step=1.0)
+        endpoint = UdpEndpoint(uuid, bind=("127.0.0.1", port))
+        fed = Federation(
+            endpoint, {f"127.0.0.1:{peer}": ("127.0.0.1", peer)},
+            migration_step=1.0,
+        )
+        vvc = VvcModule(fleet, feeder, federation=fed)
+        broker = _bb(fleet, federation=fed, extra_modules=[vvc])
+        endpoint.sink = broker.deliver
+        endpoint.start()
+        slices[uuid] = type("S", (), dict(
+            uuid=uuid, fed=fed, broker=broker, vvc=vvc, fake=fake,
+            endpoint=endpoint, rows=rows,
+        ))()
+    a, b = slices.values()
+    try:
+        assert run_until(
+            list(slices.values()),
+            lambda: a.fed.members == {a.uuid, b.uuid} == b.fed.members,
+        )
+        master, slave = (a, b) if a.fed.is_coordinator else (b, a)
+        ok = run_until(
+            list(slices.values()),
+            lambda: slave.vvc.slave_rounds > 2
+            and any(
+                slave.fake.get_state(f"Q{row}_{ph}", "gateway") != 0.0
+                for row in slave.rows
+                for ph in "abc"
+            ),
+            timeout_s=30.0,
+        )
+        assert ok, (master.vvc.rounds, slave.vvc.slave_rounds)
+        # The master's accepted q covers BOTH slices' rows.
+        q = np.asarray(master.vvc.q_kvar)
+        assert np.abs(q[list(master.rows)]).sum() > 0
+        assert np.abs(q[list(slave.rows)]).sum() > 0
+        # Settle: one slave-only round applies its latest received set
+        # (the hand-off lags by the in-flight message, by design), after
+        # which the devices hold exactly what the master shipped.
+        slave.broker.run_round()
+        sets = {
+            (int(r), int(p)): float(v)
+            for r, p, v in (slave.fed.vvc_take_setpoints() or [])
+        }
+        assert sets, "slave never received setpoints"
+        for (row, pi), want in sets.items():
+            ph = "abc"[pi]
+            assert slave.fake.get_state(
+                f"Q{row}_{ph}", "gateway"
+            ) == pytest.approx(want, rel=1e-6)
+        # The master saw descent with the full control mask.
+        assert master.vvc.improved_rounds >= 1
+        # Once enslaved, the member never runs its own gradient step
+        # again (it legitimately ran as its own master pre-federation).
+        before = slave.vvc.rounds
+        run_until([master, slave], lambda: False, timeout_s=0.5)
+        assert slave.vvc.rounds == before
+        assert slave.vvc.slave_rounds > 3
+    finally:
+        for s in slices.values():
+            s.endpoint.stop()
+
+
+def test_member_falls_back_to_standalone_under_vvc_less_master():
+    """A coordinator that runs no VVC module must not silently disable
+    volt-var on its members: with no fresh setpoints arriving, the
+    member keeps running its own gradient loop and actuating locally."""
+    from freedm_tpu.grid import cases
+    from freedm_tpu.runtime import VvcModule
+    from freedm_tpu.runtime.fleet import build_broker as _bb
+
+    feeder = cases.vvc_9bus()
+    ports = free_udp_ports(2)
+    uuids = [f"127.0.0.1:{p}" for p in ports]
+    # The higher-hash uuid wins the election; give VVC to the LOSER so
+    # the coordinator is vvc-less.
+    winner = max(uuids, key=process_priority)
+    slices = []
+    for port, uuid in zip(ports, uuids):
+        peer_port = ports[1] if port == ports[0] else ports[0]
+        has_vvc = uuid != winner
+        seeds, names = {}, {}
+        if has_vvc:
+            for row in (4, 5):
+                for ph in "abc":
+                    seeds[(f"Q{row}_{ph}", "gateway")] = 0.0
+                    names[f"Q{row}_{ph}"] = f"Sst_{ph}"
+        fake = FakeAdapter(seeds)
+        manager = DeviceManager()
+        for name, tname in names.items():
+            manager.add_device(name, tname, fake)
+        fake.reveal_devices()
+        fleet = Fleet([NodeHandle(uuid, manager)], migration_step=1.0)
+        endpoint = UdpEndpoint(uuid, bind=("127.0.0.1", port))
+        fed = Federation(
+            endpoint, {f"127.0.0.1:{peer_port}": ("127.0.0.1", peer_port)},
+            migration_step=1.0,
+        )
+        extra = []
+        vvc = None
+        if has_vvc:
+            vvc = VvcModule(fleet, feeder, federation=fed)
+            extra.append(vvc)
+        broker = _bb(fleet, federation=fed, extra_modules=extra)
+        endpoint.sink = broker.deliver
+        endpoint.start()
+        slices.append(type("S", (), dict(
+            uuid=uuid, fed=fed, broker=broker, vvc=vvc, fake=fake,
+            endpoint=endpoint,
+        ))())
+    member = next(s for s in slices if s.vvc is not None)
+    try:
+        assert run_until(
+            slices, lambda: all(len(s.fed.members) == 2 for s in slices)
+        )
+        assert not member.fed.is_coordinator
+        # Grouped under a vvc-less master, the member keeps its own
+        # gradient loop alive and actuates its devices.
+        r0 = member.vvc.rounds
+        ok = run_until(
+            slices,
+            lambda: member.vvc.rounds > r0 + 3
+            and any(
+                member.fake.get_state(f"Q{row}_{ph}", "gateway") != 0.0
+                for row in (4, 5)
+                for ph in "abc"
+            ),
+            timeout_s=20.0,
+        )
+        assert ok, (member.vvc.rounds, member.vvc.slave_rounds)
+        assert member.vvc.slave_rounds == 0
+    finally:
+        for s in slices:
+            s.endpoint.stop()
