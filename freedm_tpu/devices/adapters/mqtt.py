@@ -34,6 +34,7 @@ import json
 import socket
 import struct
 import threading
+import traceback
 from typing import Callable, Dict, List, Optional, Tuple
 
 from freedm_tpu.core import logging as dgilog
@@ -154,21 +155,34 @@ class MqttClient:
                 if not self._stop.is_set():
                     self.error = e
                 return
-            if ptype == PUBLISH:
-                tlen = struct.unpack(">H", body[:2])[0]
-                topic = body[2 : 2 + tlen].decode()
-                payload = body[2 + tlen :]  # QoS 0: no packet id
-                try:
-                    self.on_message(topic, payload)
-                except Exception:
-                    logger.error("MQTT message handler failed", exc_info=True)
-            elif ptype == PINGREQ:
-                self._send(packet(PINGRESP, 0, b""))
-            # CONNACK handled in ctor; SUBACK/UNSUBACK are fire-and-forget.
+            try:
+                if ptype == PUBLISH:
+                    tlen = struct.unpack(">H", body[:2])[0]
+                    topic = body[2 : 2 + tlen].decode()
+                    payload = body[2 + tlen :]  # QoS 0: no packet id
+                    try:
+                        self.on_message(topic, payload)
+                    except Exception:
+                        logger.error(
+                            "MQTT message handler failed: "
+                            + traceback.format_exc()
+                        )
+                elif ptype == PINGREQ:
+                    self._send(packet(PINGRESP, 0, b""))
+                # CONNACK handled in ctor; SUBACK/UNSUBACK fire-and-forget.
+            except Exception as e:
+                # Error-not-crash: any unexpected failure (malformed frame,
+                # handler-logging failure, socket death mid-PINGRESP) must
+                # latch self.error so the adapter reports unhealthy instead
+                # of silently freezing device state with a dead thread.
+                self.error = e
+                return
 
     def subscribe(self, topics: List[str], qos: int = 0) -> None:
-        self._packet_id += 1
-        body = struct.pack(">H", self._packet_id)
+        with self._wlock:
+            self._packet_id += 1
+            pid = self._packet_id
+        body = struct.pack(">H", pid)
         for t in topics:
             body += encode_string(t) + bytes([qos])
         self._send(packet(SUBSCRIBE, 0x02, body))

@@ -50,7 +50,17 @@ def collect_state(broker, fleet) -> Dict:
     # Fresh ingress, not last_readings: the round's LB/VVC writes landed
     # AFTER the cached reading, and the checkpoint must carry the
     # post-round operating point.
-    state["gateway"] = _arr(fleet.read_devices()["gateway"])
+    gateway = np.asarray(fleet.read_devices()["gateway"], np.float64)
+    # A node whose restored setpoint is still waiting for its SST to
+    # reveal reads gateway=0 — persist the pending value instead, or a
+    # checkpoint written before the first exchange would overwrite the
+    # operating point the staging exists to preserve.
+    pending = getattr(fleet, "_restore_pending", None)
+    if pending is not None:
+        for i, v in enumerate(pending):
+            if v is not None:
+                gateway[i] = v
+    state["gateway"] = gateway.tolist()
     for name in ("gm", "sc", "lb", "vvc"):
         ph = broker._by_name.get(name)
         if ph is None:
@@ -91,10 +101,11 @@ def restore_state(state: Dict, broker, fleet) -> None:
     """Re-install a snapshot into a freshly built stack.
 
     Device slots are restored first (so tensor rows line up), then the
-    module warm state; finally the saved gateway setpoints are
-    re-issued to the devices — adapters whose backing store died with
-    the process (fake rigs) resume at the checkpointed operating point
-    instead of zero.
+    module warm state; finally the saved gateway setpoints are staged
+    for re-issue — each node's value lands on the first device ingress
+    that finds a revealed SST, so the checkpointed operating point
+    survives ``--resume`` on defer-reveal transports (rtds/opendss)
+    as well as on fake rigs.
     """
     if state.get("version") != FORMAT_VERSION:
         raise ValueError(f"unknown checkpoint version {state.get('version')!r}")
@@ -142,7 +153,14 @@ def restore_state(state: Dict, broker, fleet) -> None:
         m.skipped_rounds = vvc_s["skipped_rounds"]
     gateway = state.get("gateway")
     if gateway is not None:
-        fleet.write_gateways(np.asarray(gateway))
+        # Staged, not written: restore runs before adapters start, and
+        # defer-reveal transports (rtds/opendss) reveal devices only
+        # after their first exchange — an immediate write_gateways would
+        # be silently dropped by apply_commands for those nodes.  The
+        # fleet issues each node's value on the first ingress that finds
+        # a revealed SST (ADVICE r4: restored operating point must
+        # survive --resume on every transport, not just fake rigs).
+        fleet.stage_restored_gateways(np.asarray(gateway))
 
 
 def save(path: str, state: Dict) -> None:

@@ -221,6 +221,11 @@ class Fleet:
         # manager.apply_commands (egress).
         self._snapshots: Optional[List[dt.DeviceTensor]] = None
         self._ingress = None  # compiled lazily from the shared layout
+        # Checkpointed gateway setpoints waiting for their node's SSTs to
+        # reveal (defer-reveal transports like rtds/opendss reveal only
+        # after the first exchange; an immediate write would be silently
+        # dropped by apply_commands).  None = nothing pending.
+        self._restore_pending: Optional[List[Optional[float]]] = None
 
     @property
     def n_nodes(self) -> int:
@@ -267,6 +272,7 @@ class Fleet:
         gateway from SST, ``lb/LoadBalance.cpp:382-402``) plus the FID
         states GM needs and the Omega frequency the invariant checks.
         """
+        self._apply_restored_gateways()
         lay = self.nodes[0].manager.layout
         for node in self.nodes[1:]:
             other = node.manager.layout
@@ -350,6 +356,30 @@ class Fleet:
         return jnp.asarray([by_name.get(name, (0.0, False))[0] for name in self.fid_names])
 
     # -- device egress -------------------------------------------------------
+    def _write_node_gateway(
+        self, i: int, node, value: float, fresh: bool = False
+    ) -> int:
+        """One node's gateway write through the tensor egress pump;
+        returns the number of device writes that actually landed.
+
+        ``fresh`` forces a new snapshot — the restore path runs right
+        after a device reveals, when the cached snapshot predates the
+        reveal and carries no Sst-typed row for the command to land on.
+        """
+        lay = node.manager.layout
+        snap = (
+            self._snapshots[i]
+            if self._snapshots is not None and not fresh
+            else node.manager.snapshot()
+        )
+        t = dt.set_commands(
+            dt.clear_commands(snap),
+            lay.type_ids["Sst"],
+            lay.signal_index("gateway"),
+            jnp.asarray(float(value), snap.command.dtype),
+        )
+        return node.manager.apply_commands(t)
+
     def write_gateways(self, gateway: np.ndarray) -> None:
         """Push per-node gateway setpoints to each node's SSTs
         (``SetPStar`` → ``SetCommand("gateway")``,
@@ -362,18 +392,52 @@ class Fleet:
             lay = node.manager.layout
             if "Sst" not in lay.type_ids:
                 continue
-            snap = (
-                self._snapshots[i]
-                if self._snapshots is not None
-                else node.manager.snapshot()
-            )
-            t = dt.set_commands(
-                dt.clear_commands(snap),
-                lay.type_ids["Sst"],
-                lay.signal_index("gateway"),
-                jnp.asarray(float(gateway[i]), snap.command.dtype),
-            )
-            node.manager.apply_commands(t)
+            self._write_node_gateway(i, node, float(gateway[i]))
+
+    # How many ingress rounds a staged restore value stays live.  RTDS/
+    # OpenDSS reveal within their first exchange (a round or two); an
+    # SST that first appears later than this (e.g. a PnP controller
+    # joining mid-run) is new work for LB, not a resume, and stamping a
+    # stale checkpoint over the live trajectory would be wrong.
+    RESTORE_WINDOW_ROUNDS = 10
+
+    def stage_restored_gateways(self, gateway: np.ndarray) -> None:
+        """Defer checkpointed gateway setpoints until each node's SSTs
+        reveal (checkpoint restore runs before adapters start, and
+        :meth:`DeviceManager.apply_commands` drops writes to unrevealed
+        devices).  Each node's value is issued exactly once, at the
+        start of the first ingress that finds a revealed SST — before
+        LB reads, so the restored operating point is what the modules
+        resume from.  Values not placeable within
+        ``RESTORE_WINDOW_ROUNDS`` ingresses are dropped (a late-joining
+        SST gets the live trajectory, not the stale checkpoint)."""
+        self._restore_pending = [float(g) for g in np.asarray(gateway)]
+        self._restore_rounds_left = self.RESTORE_WINDOW_ROUNDS
+
+    def _apply_restored_gateways(self) -> None:
+        if self._restore_pending is None:
+            return
+        outstanding = False
+        for i, node in enumerate(self.nodes):
+            value = self._restore_pending[i]
+            if value is None:
+                continue
+            lay = node.manager.layout
+            if "Sst" not in lay.type_ids or not node.manager.device_names(
+                "Sst"
+            ):
+                outstanding = True  # SSTs not revealed yet — keep waiting
+                continue
+            # Only retire the value once a write actually landed: a
+            # reveal/removal race (PnP heartbeat reap between the check
+            # above and the egress pump) writes nothing and must retry.
+            if self._write_node_gateway(i, node, value, fresh=True) > 0:
+                self._restore_pending[i] = None
+            else:
+                outstanding = True
+        self._restore_rounds_left -= 1
+        if not outstanding or self._restore_rounds_left <= 0:
+            self._restore_pending = None
 
     def step_plants(self) -> None:
         for p in self.plants:

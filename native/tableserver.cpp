@@ -31,6 +31,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <fstream>
@@ -137,7 +138,7 @@ void ServeRtdsConn(const PortSpec& spec, int fd) {
       for (std::size_t i = 0; i < spec.commands.size(); ++i) {
         float v = BeToFloat(&cmd_buf[i * 4]);
         // NULL_COMMAND entries leave the table untouched.
-        if (std::abs(v - kNullCommand) > 0.5f) {
+        if (std::fabs(v - kNullCommand) > 0.5f) {
           g_command_table.Set(spec.commands[i], v);
         }
       }

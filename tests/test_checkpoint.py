@@ -136,6 +136,36 @@ def test_restore_slots_reorders_rows():
     assert m.row_of("D") == 3
 
 
+def test_restored_gateway_waits_for_defer_reveal(tmp_path):
+    """ADVICE r4: a checkpointed gateway must land on defer-reveal
+    transports (rtds/opendss reveal devices only after their first
+    exchange), not just on fake rigs.  Staged values wait for reveal,
+    then issue exactly once."""
+    from freedm_tpu.devices.adapters.fake import FakeAdapter
+    from freedm_tpu.runtime import Fleet, NodeHandle
+
+    fake = FakeAdapter()
+    m = DeviceManager(capacity=4)
+    m.add_device("SST", "Sst", fake)
+    fleet = Fleet([NodeHandle("a:1", m)])
+    fleet.stage_restored_gateways(np.asarray([42.0]))
+
+    # Unrevealed (pre-first-exchange): the write must NOT be dropped.
+    fleet.read_devices()
+    assert fleet._restore_pending is not None
+
+    fake.reveal_devices()  # the transport's first exchange completes
+    fleet.read_devices()
+    assert fake.get_state("SST", "gateway") == 42.0
+    assert fleet._restore_pending is None
+
+    # Exactly once: later rounds must not re-impose the checkpoint over
+    # live module writes.
+    fake.set_state("SST", "gateway", 7.0)
+    fleet.read_devices()
+    assert fake.get_state("SST", "gateway") == 7.0
+
+
 def test_atomic_save_survives_kill_mid_run(tmp_path):
     """SIGKILL a checkpointing CLI fleet mid-run; the checkpoint on
     disk is a complete, loadable snapshot and a resumed run continues
