@@ -175,7 +175,10 @@ class MqttClient:
                 # handler-logging failure, socket death mid-PINGRESP) must
                 # latch self.error so the adapter reports unhealthy instead
                 # of silently freezing device state with a dead thread.
-                self.error = e
+                # Guarded like the read path above: a clean close() racing
+                # an in-flight PINGRESP is shutdown, not failure.
+                if not self._stop.is_set():
+                    self.error = e
                 return
 
     def subscribe(self, topics: List[str], qos: int = 0) -> None:

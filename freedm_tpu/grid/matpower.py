@@ -56,6 +56,45 @@ def load_case(path: Union[str, Path]) -> BusSystem:
     return from_mpc(parse_case_text(Path(path).read_text()))
 
 
+DATA_DIR = Path(__file__).parent / "data"
+
+
+def builtin_case_names() -> tuple:
+    """Names of the bundled IEEE cases (``grid/data/*.m``)."""
+    return tuple(sorted(p.stem for p in DATA_DIR.glob("*.m")))
+
+
+def _builtin_path(name: str) -> Path:
+    path = DATA_DIR / f"{name}.m"
+    if not path.exists():
+        raise KeyError(f"no builtin case {name!r}; have {builtin_case_names()}")
+    return path
+
+
+def load_builtin(name: str) -> BusSystem:
+    """Load a bundled IEEE case by name (e.g. ``case14``,
+    ``case_ieee30``).
+
+    These are the recognized public test systems BASELINE.md's meshed
+    benchmarks anchor to.  IEEE 118-bus is NOT bundled: this build
+    environment has no offline copy of its 186-branch dataset and
+    fabricating one would be worse than absent — 118-bus-scale runs use
+    :func:`freedm_tpu.grid.cases.synthetic_mesh` and say so.
+    """
+    return load_case(_builtin_path(name))
+
+
+def builtin_solved_state(name: str):
+    """(vm, va_deg) columns of a bundled case's bus matrix.
+
+    For ``case14`` these are the published solved operating point (the
+    validation oracle); for cases whose file carries a flat start they
+    are just that, and the caller should not treat them as a solution.
+    """
+    mpc = parse_case_text(_builtin_path(name).read_text())
+    return mpc["bus"][:, 7].copy(), mpc["bus"][:, 8].copy()
+
+
 def from_mpc(mpc: Dict[str, np.ndarray]) -> BusSystem:
     """Build a :class:`BusSystem` from parsed mpc matrices."""
     bus = mpc["bus"]

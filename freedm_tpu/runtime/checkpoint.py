@@ -50,7 +50,9 @@ def collect_state(broker, fleet) -> Dict:
     # Fresh ingress, not last_readings: the round's LB/VVC writes landed
     # AFTER the cached reading, and the checkpoint must carry the
     # post-round operating point.
-    gateway = np.asarray(fleet.read_devices()["gateway"], np.float64)
+    # np.array (forced copy): np.asarray of a matching-dtype JAX array
+    # is a zero-copy READ-ONLY view and the overlay below would crash.
+    gateway = np.array(fleet.read_devices()["gateway"], np.float64)
     # A node whose restored setpoint is still waiting for its SST to
     # reveal reads gateway=0 — persist the pending value instead, or a
     # checkpoint written before the first exchange would overwrite the

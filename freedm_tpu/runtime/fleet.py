@@ -394,12 +394,14 @@ class Fleet:
                 continue
             self._write_node_gateway(i, node, float(gateway[i]))
 
-    # How many ingress rounds a staged restore value stays live.  RTDS/
-    # OpenDSS reveal within their first exchange (a round or two); an
-    # SST that first appears later than this (e.g. a PnP controller
-    # joining mid-run) is new work for LB, not a resume, and stamping a
-    # stale checkpoint over the live trajectory would be wrong.
-    RESTORE_WINDOW_ROUNDS = 10
+    # How many device ingresses a staged restore value stays live for.
+    # RTDS/OpenDSS reveal within their first exchange (a round or two),
+    # and a round performs a handful of ingresses (LB read + checkpoint
+    # collection), so 40 ingresses ≈ 10+ rounds of grace; an SST that
+    # first appears later than that (e.g. a PnP controller joining
+    # mid-run) is new work for LB, not a resume, and stamping a stale
+    # checkpoint over the live trajectory would be wrong.
+    RESTORE_WINDOW_INGRESSES = 40
 
     def stage_restored_gateways(self, gateway: np.ndarray) -> None:
         """Defer checkpointed gateway setpoints until each node's SSTs
@@ -409,10 +411,11 @@ class Fleet:
         start of the first ingress that finds a revealed SST — before
         LB reads, so the restored operating point is what the modules
         resume from.  Values not placeable within
-        ``RESTORE_WINDOW_ROUNDS`` ingresses are dropped (a late-joining
-        SST gets the live trajectory, not the stale checkpoint)."""
+        ``RESTORE_WINDOW_INGRESSES`` ingresses are dropped with a
+        warning (a late-joining SST gets the live trajectory, not the
+        stale checkpoint)."""
         self._restore_pending = [float(g) for g in np.asarray(gateway)]
-        self._restore_rounds_left = self.RESTORE_WINDOW_ROUNDS
+        self._restore_rounds_left = self.RESTORE_WINDOW_INGRESSES
 
     def _apply_restored_gateways(self) -> None:
         if self._restore_pending is None:
@@ -436,7 +439,18 @@ class Fleet:
             else:
                 outstanding = True
         self._restore_rounds_left -= 1
-        if not outstanding or self._restore_rounds_left <= 0:
+        if not outstanding:
+            self._restore_pending = None
+        elif self._restore_rounds_left <= 0:
+            undelivered = [
+                (self.nodes[i].uuid, v)
+                for i, v in enumerate(self._restore_pending)
+                if v is not None
+            ]
+            logger.warn(
+                "dropping undelivered restored gateways (SSTs never "
+                f"revealed within the restore window): {undelivered}"
+            )
             self._restore_pending = None
 
     def step_plants(self) -> None:

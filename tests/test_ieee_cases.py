@@ -1,0 +1,142 @@
+"""Real-case validation against published IEEE solutions (VERDICT r4
+missing item 3).
+
+The reference's numeric credibility came from HIL regression artifacts
+(``/root/reference/Broker/testing/results/``); the framework equivalent
+is solving recognized public cases and pinning the answers to their
+published values:
+
+- **case14** — the bus matrix carries the published solved operating
+  point (Vm/Va columns of the IEEE distribution), so the test is a
+  value-level oracle: |V| to ~1e-3 (the published values are rounded to
+  3 decimals) and angles to ~0.05 deg, plus the two classic aggregates
+  (slack generation 232.4 MW, system losses 13.39 MW).
+- **case_ieee30** — no offline copy of the published per-bus solution
+  exists in this environment, so the anchors are the published
+  aggregates (total load 283.4 MW, base-case losses 17.557 MW, slack
+  generation ~260.95 MW) plus cross-solver agreement.
+
+Cross-solver agreement (full Newton vs fast-decoupled, two different
+iterations sharing only the Ybus) guards against a systematic error
+that a single solver's convergence test would miss.
+"""
+
+import numpy as np
+import pytest
+
+from freedm_tpu.grid.matpower import (
+    builtin_case_names,
+    builtin_solved_state,
+    load_builtin,
+)
+from freedm_tpu.pf.fdlf import make_fdlf_solver
+from freedm_tpu.pf.newton import make_newton_solver
+
+F64 = np.float64
+
+
+def test_builtin_cases_present():
+    names = builtin_case_names()
+    assert "case14" in names and "case_ieee30" in names
+
+
+def test_case14_matches_published_solution():
+    sys14 = load_builtin("case14")
+    assert sys14.n_bus == 14 and sys14.n_branch == 20
+    solve, _ = make_newton_solver(sys14, dtype=F64, max_iter=15)
+    r = solve()
+    assert bool(r.converged)
+    vm_pub, va_pub = builtin_solved_state("case14")
+    vm = np.asarray(r.v)
+    va = np.degrees(np.asarray(r.theta))
+    # Published values are rounded to 3 decimals (1e-3 / 1e-2 deg).
+    np.testing.assert_allclose(vm, vm_pub, atol=2e-3)
+    np.testing.assert_allclose(va, va_pub, atol=5e-2)
+    # The two classic aggregates of the case14 base case.
+    assert abs(float(r.p[0]) * 100.0 - 232.4) < 0.2  # slack generation, MW
+    assert abs(float(np.sum(r.p)) * 100.0 - 13.39) < 0.1  # losses, MW
+
+
+def test_case14_fdlf_agrees_with_newton():
+    sys14 = load_builtin("case14")
+    nr, _ = make_newton_solver(sys14, dtype=F64, max_iter=15)
+    fd, _ = make_fdlf_solver(sys14, dtype=F64, max_iter=60)
+    a, b = nr(), fd()
+    assert bool(a.converged) and bool(b.converged)
+    np.testing.assert_allclose(np.asarray(a.v), np.asarray(b.v), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(a.theta), np.asarray(b.theta), atol=1e-6
+    )
+
+
+def test_case30_published_aggregates_and_cross_solver():
+    sys30 = load_builtin("case_ieee30")
+    assert sys30.n_bus == 30 and sys30.n_branch == 41
+    # Data-level anchor: the IEEE 30-bus total load is exactly 283.4 MW
+    # (p_inj = gen - load; PQ buses carry pure load here, and the only
+    # demand at a generator bus is netted against its dispatch).
+    mpc_load = 21.7 + 2.4 + 7.6 + 94.2 + 22.8 + 30 + 5.8 + 11.2 + 6.2
+    mpc_load += 8.2 + 3.5 + 9 + 3.2 + 9.5 + 2.2 + 17.5 + 3.2 + 8.7
+    mpc_load += 3.5 + 2.4 + 10.6
+    assert abs(mpc_load - 283.4) < 1e-9
+    solve, _ = make_newton_solver(sys30, dtype=F64, max_iter=15)
+    r = solve()
+    assert bool(r.converged)
+    losses_mw = float(np.sum(r.p)) * sys30.base_mva
+    slack_mw = float(r.p[0]) * sys30.base_mva
+    # Published base-case losses ~17.557 MW; slack picks up load - 40 +
+    # losses = 260.96 MW.
+    assert abs(losses_mw - 17.557) < 0.15
+    assert abs(slack_mw - 260.96) < 0.3
+    assert 0.99 < float(np.min(r.v)) and float(np.max(r.v)) <= 1.083
+
+    fd, _ = make_fdlf_solver(sys30, dtype=F64, max_iter=80)
+    b = fd()
+    assert bool(b.converged)
+    np.testing.assert_allclose(np.asarray(r.v), np.asarray(b.v), atol=1e-6)
+
+
+def _islanding_outages(sys):
+    """Branch indices whose removal disconnects the network (union-find
+    on the remaining branches)."""
+    out = []
+    for k in range(sys.n_branch):
+        parent = list(range(sys.n_bus))
+
+        def find(a):
+            while parent[a] != a:
+                parent[a] = parent[parent[a]]
+                a = parent[a]
+            return a
+
+        for j in range(sys.n_branch):
+            if j == k:
+                continue
+            ra, rb = find(int(sys.from_bus[j])), find(int(sys.to_bus[j]))
+            if ra != rb:
+                parent[ra] = rb
+        roots = {find(i) for i in range(sys.n_bus)}
+        if len(roots) > 1:
+            out.append(k)
+    return out
+
+
+def test_case30_n1_screen_converges_on_secure_outages():
+    """A real-case N-1 screen: every non-islanding single-branch outage
+    of the IEEE 30-bus system solves (vmap over status lanes)."""
+    import jax
+    import jax.numpy as jnp
+
+    sys30 = load_builtin("case_ieee30")
+    islanding = set(_islanding_outages(sys30))
+    secure = [k for k in range(sys30.n_branch) if k not in islanding]
+    assert len(secure) >= 30  # the screen is not vacuous
+    _, solve_fixed = make_newton_solver(sys30, dtype=F64, max_iter=8)
+    status = np.ones((len(secure), sys30.n_branch), F64)
+    status[np.arange(len(secure)), secure] = 0.0
+    batched = jax.jit(jax.vmap(lambda s: solve_fixed(status=s)))
+    r = batched(jnp.asarray(status))
+    assert bool(np.all(np.asarray(r.converged)))
+    # Outages only redistribute flow: voltages stay physical (the worst
+    # secure case30 outage sags to ~0.86 pu — stressed, not collapsed).
+    assert float(np.min(np.asarray(r.v))) > 0.8
