@@ -13,4 +13,6 @@ from freedm_tpu.pf.newton import (  # noqa: F401
     branch_flows,
 )
 from freedm_tpu.pf.fdlf import make_fdlf_solver  # noqa: F401
+from freedm_tpu.pf.mfree import make_injection_fn  # noqa: F401
+from freedm_tpu.pf.n1 import make_n1_screen, secure_outages  # noqa: F401
 from freedm_tpu.pf.sweeps import make_sweeps, dense_sweeps, doubling_sweeps  # noqa: F401

@@ -28,7 +28,7 @@ so ``vmap`` batches scenarios/contingencies.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +37,56 @@ import numpy as np
 from freedm_tpu.grid.bus import PQ, SLACK, BusSystem, ybus_dense
 from freedm_tpu.pf.newton import build_result, s_calc
 from freedm_tpu.utils import cplx
+
+
+class DecoupledParts(NamedTuple):
+    """Masks and B′/B″ builders shared by the FDLF solver and the SMW
+    N-1 screen (:mod:`freedm_tpu.pf.n1`) — the decoupled matrices live
+    in exactly one place."""
+
+    th_free: jax.Array  # [n] 1.0 where θ is unknown
+    v_free: jax.Array  # [n] 1.0 where V is unknown
+    b_prime: "callable"  # (status|None) -> [n, n]
+    b_dblprime: "callable"  # (ybus C) -> [n, n]
+
+
+def decoupled_parts(sys: BusSystem, rdtype) -> DecoupledParts:
+    """Build the XB-scheme decoupled matrices for a bus system.
+
+    B′ comes from series 1/x alone (r, shunts, taps dropped — the
+    decoupling that keeps it constant and well-conditioned); B″ is
+    −Im(Ybus) on the PQ block.  Pinned rows/cols (slack θ, PV/slack V)
+    are identity, preserving symmetry and static shapes.
+    """
+    bus_type = jnp.asarray(sys.bus_type)
+    th_free = (bus_type != SLACK).astype(rdtype)
+    v_free = (bus_type == PQ).astype(rdtype)
+    n = sys.n_bus
+    inv_x = jnp.asarray(1.0 / sys.x, rdtype)
+    f_j = jnp.asarray(np.asarray(sys.from_bus))
+    t_j = jnp.asarray(np.asarray(sys.to_bus))
+
+    def b_prime(status):
+        on = jnp.ones(sys.n_branch, rdtype) if status is None else jnp.asarray(
+            status, rdtype
+        )
+        w = inv_x * on
+        m = jnp.zeros((n, n), rdtype)
+        m = m.at[f_j, f_j].add(w)
+        m = m.at[t_j, t_j].add(w)
+        m = m.at[f_j, t_j].add(-w)
+        m = m.at[t_j, f_j].add(-w)
+        keep = th_free
+        m = m * keep[:, None] * keep[None, :]
+        return m + jnp.diag(1.0 - keep)
+
+    def b_dblprime(y):
+        m = -y.im
+        keep = v_free
+        m = m * keep[:, None] * keep[None, :]
+        return m + jnp.diag(1.0 - keep)
+
+    return DecoupledParts(th_free, v_free, b_prime, b_dblprime)
 
 
 def make_fdlf_solver(
@@ -58,42 +108,12 @@ def make_fdlf_solver(
         tol = 1e-8 if rdtype == jnp.float64 else 3e-5
     n = sys.n_bus
 
-    bus_type = jnp.asarray(sys.bus_type)
-    th_free = (bus_type != SLACK).astype(rdtype)
-    v_free = (bus_type == PQ).astype(rdtype)
+    parts = decoupled_parts(sys, rdtype)
+    th_free, v_free = parts.th_free, parts.v_free
+    _b_prime, _b_dblprime = parts.b_prime, parts.b_dblprime
     v_set = jnp.asarray(sys.v_set, rdtype)
     p_sched0 = jnp.asarray(sys.p_inj, rdtype)
     q_sched0 = jnp.asarray(sys.q_inj, rdtype)
-
-    f = np.asarray(sys.from_bus)
-    t = np.asarray(sys.to_bus)
-    # XB scheme: B' from series 1/x alone (r, shunts, taps dropped) —
-    # the decoupling that keeps B' constant and well-conditioned.
-    inv_x = jnp.asarray(1.0 / sys.x, rdtype)
-    f_j = jnp.asarray(f)
-    t_j = jnp.asarray(t)
-
-    def _b_prime(status):
-        on = jnp.ones(sys.n_branch, rdtype) if status is None else jnp.asarray(
-            status, rdtype
-        )
-        w = inv_x * on
-        m = jnp.zeros((n, n), rdtype)
-        m = m.at[f_j, f_j].add(w)
-        m = m.at[t_j, t_j].add(w)
-        m = m.at[f_j, t_j].add(-w)
-        m = m.at[t_j, f_j].add(-w)
-        # Pinned θ rows/cols → identity, preserving symmetry.
-        keep = th_free
-        m = m * keep[:, None] * keep[None, :]
-        return m + jnp.diag(1.0 - keep)
-
-    def _b_dblprime(y):
-        # B'' = −Im(Ybus) on the PQ block, identity elsewhere.
-        m = -y.im
-        keep = v_free
-        m = m * keep[:, None] * keep[None, :]
-        return m + jnp.diag(1.0 - keep)
 
     def _mismatch(y, theta, v, p_sched, q_sched):
         p_calc, q_calc = s_calc(y, theta, v)

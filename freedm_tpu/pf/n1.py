@@ -1,0 +1,217 @@
+"""TPU-first N-1 contingency screening: one factorization, rank-2
+Sherman–Morrison–Woodbury updates per outage lane.
+
+The round-4 screen solved each outage lane by re-forming and
+re-factorizing the network matrices per lane — 118 O(n³) factorizations
+for a 118-way screen (``bench.py`` r4: 113.9 ms, with FDLF *losing* to
+Newton for exactly this reason).  The textbook fix, laid out in VERDICT
+r4 item 2, is the inverse-matrix-modification lemma: a single-branch
+outage changes the fast-decoupled pair by a matrix supported on the
+branch's two endpoint rows/columns —
+
+    B′_k = B′ − w_k·a_k a_kᵀ                  (rank 1, a_k = e_f − e_k)
+    B″_k = B″ + P_k·Im(Y_stamp_k)·P_kᵀ        (rank ≤ 2, P_k = [e_f, e_t])
+
+so with the BASE pair factorized once, every outage lane solves via
+
+    (A + P M Pᵀ)⁻¹ b = A⁻¹b − (Z M)·(I₂ + Pᵀ Z M)⁻¹·(Pᵀ A⁻¹ b)
+
+where Z = A⁻¹P is precomputed for ALL branches in one multi-RHS
+triangular solve.  Per lane per half-iteration: one base triangular
+solve (shared LU, batched over lanes on the MXU), two gathers, and a
+2×2 solve — O(n²) instead of O(n³), and the O(n³) happens once.
+
+Masking: the pinned rows of B′/B″ (slack θ, PV/slack V) are identity in
+the base matrices, so the update columns are masked by the same
+``th_free`` / ``v_free`` vectors — an endpoint on a pinned bus simply
+drops out of the correction.
+
+Mismatches are evaluated branch-wise (:mod:`freedm_tpu.pf.mfree`), so
+the screen never materializes a ``[lanes, n, n]`` Ybus stack.
+
+Caveat (documented, asserted by the caller): removing a *bridge*
+branch islands part of the network and makes B′_k singular — the 2×2
+capacitance matrix becomes (numerically) singular and that lane's
+result is garbage.  Screen callers filter islanding outages first, as
+``tests/test_ieee_cases.py`` does with a union-find pass.
+
+Reference bar: the reference has no contingency machinery at all — its
+only solver is a 9-bus radial ladder inside a 3000 ms round budget
+(``Broker/src/vvc/DPF_return7.cpp``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.grid.bus import BusSystem, branch_admittances, ybus_dense
+from freedm_tpu.pf.fdlf import decoupled_parts
+from freedm_tpu.pf.mfree import make_injection_fn
+from freedm_tpu.pf.newton import NewtonResult
+from freedm_tpu.utils import cplx
+
+
+def secure_outages(sys: BusSystem) -> list:
+    """Branch indices whose single removal does NOT island the network
+    (union-find over the surviving branches).
+
+    The mandatory pre-filter for :func:`make_n1_screen` lanes: a bridge
+    outage makes B′ singular and its lane's result is garbage.  Kept on
+    host/numpy — it is a build-time graph pass, not a per-solve one.
+    """
+    out = []
+    for k in range(sys.n_branch):
+        parent = list(range(sys.n_bus))
+
+        def find(a):
+            while parent[a] != a:
+                parent[a] = parent[parent[a]]
+                a = parent[a]
+            return a
+
+        for j in range(sys.n_branch):
+            if j != k:
+                ra, rb = find(int(sys.from_bus[j])), find(int(sys.to_bus[j]))
+                if ra != rb:
+                    parent[ra] = rb
+        if len({find(i) for i in range(sys.n_bus)}) == 1:
+            out.append(k)
+    return out
+
+
+def make_n1_screen(
+    sys: BusSystem,
+    tol: Optional[float] = None,
+    max_iter: int = 40,
+    dtype: Optional[jnp.dtype] = None,
+):
+    """Compile the SMW fast-decoupled N-1 screen.
+
+    Returns ``screen(outages)``: ``outages`` is an ``[k]`` int array of
+    branch indices (each lane removes exactly that branch); the result
+    is a lane-batched :class:`~freedm_tpu.pf.newton.NewtonResult`.
+    Jitted; the lane axis is a ``vmap``, so sharding the lane axis over
+    a mesh is one ``pjit`` annotation away.
+    """
+    rdtype = cplx.default_rdtype(dtype)
+    if tol is None:
+        tol = 1e-8 if rdtype == jnp.float64 else 3e-5
+    n = sys.n_bus
+    m = sys.n_branch
+
+    parts = decoupled_parts(sys, rdtype)
+    th_free, v_free = parts.th_free, parts.v_free
+    v_set = jnp.asarray(sys.v_set, rdtype)
+    p_sched = jnp.asarray(sys.p_inj, rdtype)
+    q_sched = jnp.asarray(sys.q_inj, rdtype)
+    inject = make_injection_fn(sys, rdtype)
+
+    f = np.asarray(sys.from_bus)
+    t = np.asarray(sys.to_bus)
+    idx_all = jnp.asarray(np.stack([f, t], axis=1))  # [m, 2]
+
+    with jax.default_matmul_precision("highest"):
+        y0 = ybus_dense(sys, status=None, dtype=rdtype)
+        lu_p = jax.jit(jax.scipy.linalg.lu_factor)(parts.b_prime(None))
+        lu_q = jax.jit(jax.scipy.linalg.lu_factor)(parts.b_dblprime(y0))
+
+        # Z = A⁻¹ P for every branch endpoint, one multi-RHS solve per
+        # matrix.  Update columns are masked one-hots (pinned buses drop).
+        mask_p = np.asarray(th_free)[np.stack([f, t], 1)]  # [m, 2]
+        mask_q = np.asarray(v_free)[np.stack([f, t], 1)]
+        rhs_p = np.zeros((n, 2 * m), np.asarray(th_free).dtype)
+        rhs_q = np.zeros_like(rhs_p)
+        rhs_p[f, 2 * np.arange(m)] = mask_p[:, 0]
+        rhs_p[t, 2 * np.arange(m) + 1] = mask_p[:, 1]
+        rhs_q[f, 2 * np.arange(m)] = mask_q[:, 0]
+        rhs_q[t, 2 * np.arange(m) + 1] = mask_q[:, 1]
+        z_p = jax.scipy.linalg.lu_solve(lu_p, jnp.asarray(rhs_p)).reshape(
+            n, m, 2
+        )
+        z_q = jax.scipy.linalg.lu_solve(lu_q, jnp.asarray(rhs_q)).reshape(
+            n, m, 2
+        )
+
+        # Per-branch 2x2 update blocks.
+        yff, yft, ytf, ytt = branch_admittances(sys, status=None, dtype=rdtype)
+        w = jnp.asarray(1.0 / sys.x, rdtype)
+        m_p = (
+            -w[:, None, None]
+            * jnp.asarray([[1.0, -1.0], [-1.0, 1.0]], rdtype)[None]
+        )  # [m, 2, 2]
+        m_q = jnp.stack(
+            [
+                jnp.stack([yff.im, yft.im], axis=-1),
+                jnp.stack([ytf.im, ytt.im], axis=-1),
+            ],
+            axis=-2,
+        )  # [m, 2, 2]
+
+    mask_p = jnp.asarray(mask_p, rdtype)
+    mask_q = jnp.asarray(mask_q, rdtype)
+    eye2 = jnp.eye(2, dtype=rdtype)
+
+    def _corr_solve(lu, zmk, capk, idx, maskk, b):
+        """(A + P M Pᵀ)⁻¹ b given the lane's precomputed Z·M and cap."""
+        t0 = jax.scipy.linalg.lu_solve(lu, b)
+        pt = t0[idx] * maskk  # Pᵀ t0
+        return t0 - zmk @ jnp.linalg.solve(capk, pt)
+
+    def _solve_lane(k):
+        """One outage lane: FDLF iteration with SMW-corrected solves."""
+        idx = idx_all[k]  # [2]
+        mk_p, mk_q = mask_p[k], mask_q[k]
+        zm_p = z_p[:, k, :] @ m_p[k]  # [n, 2] = A⁻¹ U for B′
+        zm_q = z_q[:, k, :] @ m_q[k]
+        cap_p = eye2 + zm_p[idx] * mk_p[:, None]  # I₂ + Pᵀ A⁻¹ U
+        cap_q = eye2 + zm_q[idx] * mk_q[:, None]
+        status = jnp.ones(m, rdtype).at[k].set(0.0)
+
+        def mismatch(theta, v):
+            p_calc, q_calc = inject(theta, v, status=status)
+            dp = (p_sched - p_calc) / v * th_free
+            dq = (q_sched - q_calc) / v * v_free
+            return dp, dq
+
+        def err_from(dp, dq, v):
+            return jnp.maximum(
+                jnp.max(jnp.abs(dp * v)), jnp.max(jnp.abs(dq * v))
+            ).astype(rdtype)
+
+        v = jnp.where(v_free > 0, 1.0, v_set).astype(rdtype)
+        theta = jnp.zeros(n, rdtype)
+        dp, dq = mismatch(theta, v)
+
+        def body(carry, _):
+            theta, v, dp, dq = carry
+            theta = theta + _corr_solve(lu_p, zm_p, cap_p, idx, mk_p, dp) * th_free
+            _, dq2 = mismatch(theta, v)
+            v = v + _corr_solve(lu_q, zm_q, cap_q, idx, mk_q, dq2) * v_free
+            dp3, dq3 = mismatch(theta, v)
+            return (theta, v, dp3, dq3), None
+
+        (theta, v, dp, dq), _ = jax.lax.scan(
+            body, (theta, v, dp, dq), None, length=max_iter
+        )
+        err = err_from(dp, dq, v)
+        p_calc, q_calc = inject(theta, v, status=status)
+        return NewtonResult(
+            v=v,
+            theta=theta,
+            p=p_calc,
+            q=q_calc,
+            iterations=jnp.asarray(max_iter, jnp.int32),
+            converged=err < tol,
+            mismatch=err,
+        )
+
+    @jax.jit
+    def screen(outages):
+        with jax.default_matmul_precision("highest"):
+            return jax.vmap(_solve_lane)(jnp.asarray(outages))
+
+    return screen

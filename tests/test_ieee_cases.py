@@ -96,40 +96,16 @@ def test_case30_published_aggregates_and_cross_solver():
     np.testing.assert_allclose(np.asarray(r.v), np.asarray(b.v), atol=1e-6)
 
 
-def _islanding_outages(sys):
-    """Branch indices whose removal disconnects the network (union-find
-    on the remaining branches)."""
-    out = []
-    for k in range(sys.n_branch):
-        parent = list(range(sys.n_bus))
-
-        def find(a):
-            while parent[a] != a:
-                parent[a] = parent[parent[a]]
-                a = parent[a]
-            return a
-
-        for j in range(sys.n_branch):
-            if j == k:
-                continue
-            ra, rb = find(int(sys.from_bus[j])), find(int(sys.to_bus[j]))
-            if ra != rb:
-                parent[ra] = rb
-        roots = {find(i) for i in range(sys.n_bus)}
-        if len(roots) > 1:
-            out.append(k)
-    return out
-
-
 def test_case30_n1_screen_converges_on_secure_outages():
     """A real-case N-1 screen: every non-islanding single-branch outage
     of the IEEE 30-bus system solves (vmap over status lanes)."""
     import jax
     import jax.numpy as jnp
 
+    from freedm_tpu.pf.n1 import secure_outages
+
     sys30 = load_builtin("case_ieee30")
-    islanding = set(_islanding_outages(sys30))
-    secure = [k for k in range(sys30.n_branch) if k not in islanding]
+    secure = secure_outages(sys30)
     assert len(secure) >= 30  # the screen is not vacuous
     _, solve_fixed = make_newton_solver(sys30, dtype=F64, max_iter=8)
     status = np.ones((len(secure), sys30.n_branch), F64)

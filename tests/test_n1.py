@@ -1,0 +1,96 @@
+"""SMW N-1 screen tests (VERDICT r4 item 2).
+
+Correctness bar: the rank-2-updated solves must reproduce the per-lane
+refactorized FDLF exactly (same iteration, same matrices — SMW is an
+identity, not an approximation), and agree with full Newton at
+tolerance level on every converged lane.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.grid.cases import synthetic_mesh
+from freedm_tpu.grid.matpower import load_builtin
+from freedm_tpu.pf.fdlf import make_fdlf_solver
+from freedm_tpu.pf.mfree import make_injection_fn
+from freedm_tpu.pf.n1 import make_n1_screen, secure_outages
+from freedm_tpu.pf.newton import make_newton_solver, s_calc
+from freedm_tpu.grid.bus import ybus_dense
+
+F64 = np.float64
+
+
+def test_injection_fn_matches_dense_ybus():
+    """The branch-wise injection evaluation IS the Ybus matvec."""
+    sys30 = load_builtin("case_ieee30")
+    inject = make_injection_fn(sys30, F64)
+    rng = np.random.default_rng(0)
+    theta = jnp.asarray(rng.uniform(-0.3, 0.3, sys30.n_bus))
+    v = jnp.asarray(rng.uniform(0.95, 1.05, sys30.n_bus))
+    status = jnp.asarray(rng.integers(0, 2, sys30.n_branch).astype(F64))
+    p, q = inject(theta, v, status=status)
+    y = ybus_dense(sys30, status=status, dtype=F64)
+    p_ref, q_ref = s_calc(y, theta, v)
+    np.testing.assert_allclose(np.asarray(p), np.asarray(p_ref), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(q), np.asarray(q_ref), atol=1e-12)
+
+
+def test_smw_screen_equals_refactorized_fdlf_case30():
+    sys30 = load_builtin("case_ieee30")
+    secure = secure_outages(sys30)
+    screen = make_n1_screen(sys30, dtype=F64, max_iter=40)
+    r = screen(jnp.asarray(secure))
+    assert bool(np.all(np.asarray(r.converged)))
+
+    fd, _ = make_fdlf_solver(sys30, dtype=F64, max_iter=60)
+    for i, k in enumerate(secure[:8]):  # spot-check lanes, full run is slow
+        st = np.ones(sys30.n_branch)
+        st[k] = 0.0
+        rr = fd(status=jnp.asarray(st))
+        np.testing.assert_allclose(
+            np.asarray(r.v)[i], np.asarray(rr.v), atol=1e-8
+        )
+        np.testing.assert_allclose(
+            np.asarray(r.theta)[i], np.asarray(rr.theta), atol=1e-8
+        )
+
+
+def test_smw_screen_agrees_with_newton_118():
+    sys118 = synthetic_mesh(118, seed=1, load_mw=10.0, chord_frac=1.0)
+    secure = secure_outages(sys118)[:40]
+    screen = make_n1_screen(sys118, dtype=F64, max_iter=40)
+    r = screen(jnp.asarray(secure))
+    assert bool(np.all(np.asarray(r.converged)))
+
+    _, solve_fixed = make_newton_solver(sys118, dtype=F64, max_iter=10)
+    status = np.ones((len(secure), sys118.n_branch), F64)
+    status[np.arange(len(secure)), secure] = 0.0
+    rb = jax.jit(jax.vmap(lambda s: solve_fixed(status=s)))(jnp.asarray(status))
+    np.testing.assert_allclose(
+        np.asarray(r.v), np.asarray(rb.v), atol=5e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(r.theta), np.asarray(rb.theta), atol=5e-7
+    )
+
+
+def test_smw_screen_handles_pinned_endpoints():
+    """Outages of branches touching the slack or PV buses mask their
+    update columns; the corrected solve must still be exact."""
+    sys30 = load_builtin("case_ieee30")
+    pinned = [
+        k
+        for k in secure_outages(sys30)
+        if sys30.bus_type[sys30.from_bus[k]] != 0
+        or sys30.bus_type[sys30.to_bus[k]] != 0
+    ]
+    assert pinned, "case30 has pinned-endpoint branches"
+    screen = make_n1_screen(sys30, dtype=F64, max_iter=40)
+    r = screen(jnp.asarray(pinned))
+    assert bool(np.all(np.asarray(r.converged)))
+    fd, _ = make_fdlf_solver(sys30, dtype=F64, max_iter=60)
+    st = np.ones(sys30.n_branch)
+    st[pinned[0]] = 0.0
+    rr = fd(status=jnp.asarray(st))
+    np.testing.assert_allclose(np.asarray(r.v)[0], np.asarray(rr.v), atol=1e-8)
