@@ -11,6 +11,15 @@ per 3000 ms VVC round (``Broker/config/timings.cfg``,
 ``extra`` carries the remaining BASELINE.md target rows, measured in the
 same process:
 
+- ``nr_10000bus_mesh_solve_ms`` — a full 10k-bus **meshed** AC solve
+  (matrix-free Newton-GMRES + FDLF-inverse preconditioner,
+  ``pf/krylov``; the reference's only solver is a 9-bus radial ladder
+  under a 3000 ms budget) — with
+  ``nr_10000bus_mesh_true_mismatch_pu``, the solution's residual
+  re-evaluated on host in float64 (honest accuracy, not f32 noise);
+- ``nr_2000bus_krylov_batch64_lane_solves_per_sec`` — 64 lane-batched
+  full-accuracy 2k-bus NR solves (vmap turns the preconditioner into
+  MXU matmuls; VERDICT r4 item 5's ">=5x 12.62" target row);
 - ``nr_2000bus_mesh_solves_per_sec`` — full Newton-Raphson solves/sec on
   a 2000-bus meshed network (hand-assembled Jacobian, dense LU on MXU);
 - ``fdlf_2000bus_mesh_solves_per_sec`` — the fast-decoupled solver on
@@ -52,6 +61,7 @@ import numpy as np
 from freedm_tpu.grid.cases import synthetic_mesh, synthetic_radial
 from freedm_tpu.pf import ladder
 from freedm_tpu.pf.fdlf import make_fdlf_solver
+from freedm_tpu.pf.krylov import make_krylov_solver, true_mismatch
 from freedm_tpu.pf.newton import make_newton_solver
 
 TARGET_MS_PER_ITER = 10.0
@@ -96,6 +106,39 @@ def bench_mc_1024(maker=make_newton_solver, max_iter=6):
     batched = jax.jit(jax.vmap(lambda pi, qi: solve_fixed(p_inj=pi, q_inj=qi)))
     dt = _time(lambda: batched(p, q), lambda r: r.v, reps=5)
     return 1024.0 / dt
+
+
+def bench_nr_10k_mesh():
+    """The 10k-bus MESHED solve (VERDICT r4 item 1): matrix-free
+    Newton-GMRES with the FDLF-inverse preconditioner (``pf/krylov``).
+    Returns (ms/solve, f64-oracle mismatch) — the oracle is evaluated on
+    host in double precision so the reported accuracy is real, not f32
+    evaluation noise."""
+    sys_ = synthetic_mesh(10_000, seed=4, load_mw=2.0, chord_frac=0.3)
+    solve, _ = make_krylov_solver(sys_, max_iter=15)
+    r = solve()
+    assert bool(r.converged), f"10k mesh diverged: {float(r.mismatch)}"
+    dt = _time(solve, lambda r: r.v, reps=10)
+    return dt * 1000.0, true_mismatch(sys_, r)
+
+
+def bench_nr_2k_krylov_lanes(lanes=64):
+    """Lane-batched full-accuracy NR at 2k buses (VERDICT r4 item 5):
+    vmap over per-lane injections turns the preconditioner matvec into
+    an MXU matmul and amortizes every kernel launch."""
+    sys_ = synthetic_mesh(2000, seed=4, load_mw=2.0, chord_frac=1.0)
+    _, solve_fixed = make_krylov_solver(sys_, max_iter=8, inner_iters=16)
+    rng = np.random.default_rng(0)
+    scale = rng.uniform(0.9, 1.1, (lanes, 1))
+    p = jnp.asarray(scale * sys_.p_inj[None, :])
+    q = jnp.asarray(scale * sys_.q_inj[None, :])
+    batched = jax.jit(
+        lambda p, q: jax.vmap(lambda pi, qi: solve_fixed(p_inj=pi, q_inj=qi))(p, q)
+    )
+    r = batched(p, q)
+    assert bool(jnp.all(r.converged)), "krylov lane batch diverged"
+    dt = _time(lambda: batched(p, q), lambda r: r.v, reps=10)
+    return lanes / dt
 
 
 def bench_lb_256():
@@ -155,7 +198,13 @@ def bench_n1_case30_smw():
 
 def main() -> None:
     ms_per_iter = bench_ladder()
+    nr10k_ms, nr10k_true = bench_nr_10k_mesh()
     extra = {
+        "nr_10000bus_mesh_solve_ms": round(nr10k_ms, 1),
+        "nr_10000bus_mesh_true_mismatch_pu": float(f"{nr10k_true:.2e}"),
+        "nr_2000bus_krylov_batch64_lane_solves_per_sec": round(
+            bench_nr_2k_krylov_lanes(), 1
+        ),
         "nr_2000bus_mesh_solves_per_sec": round(bench_nr_2000(), 2),
         "fdlf_2000bus_mesh_solves_per_sec": round(
             bench_nr_2000(maker=make_fdlf_solver, max_iter=30), 2

@@ -1,0 +1,398 @@
+"""Matrix-free Newton-Krylov power flow for large meshed networks.
+
+The dense Newton solver (:mod:`freedm_tpu.pf.newton`) assembles a
+``[2n, 2n]`` Jacobian and LU-factorizes it every iteration — 1.6 GB and
+O(n³) at n = 10k, which caps it at ~2k buses per lane.  This module is
+the scale-out path documented in ``newton.py``'s memory plan: solve the
+same masked full-size Newton system
+
+    J(x) dx = -f(x),    x = [θ ‖ V] ∈ R^{2n}
+
+without ever materializing J:
+
+* **Residual and Jacobian-vector products are O(n + m).**  ``f(x)``
+  evaluates bus injections branch-wise (:mod:`freedm_tpu.pf.mfree`,
+  two gathers + two ``segment_sum`` scatters), and ``J·dx`` is one
+  ``jax.jvp`` of that function — no Ybus, no Jacobian, no [n, n]
+  anything in the Newton loop.
+* **A robust right-preconditioned GMRES(m) inner solve** (own
+  implementation, :func:`_pgmres` — masked double modified-Gram-Schmidt
+  as batched matmuls, guarded normalizations, dense least-squares
+  finish).  The preconditioner is the classic FDLF approximation
+  J ≈ diag(V)·B on each half-system: B′ (series 1/x) for P-θ and B″
+  (−Im Ybus) for Q-V (:func:`freedm_tpu.pf.fdlf.decoupled_parts` —
+  same matrices, one source).  Both are **inverted once at build
+  time** and applied as dense matvecs: on TPU an explicit-inverse
+  matvec is one MXU pass, while a triangular ``lu_solve`` serializes;
+  trading a one-time O(n³) build for O(n²) streaming applications is
+  the right MXU trade.  The stock ``jax.scipy.sparse.linalg.gmres``
+  was measured and rejected (NaN on Krylov breakdown in its batched
+  variant, f32 orthogonality loss in its incremental variant), as were
+  stationary Richardson and Orthomin(1) inners (ρ(I − M⁻¹J) > 1 modes
+  on dense chorded meshes stall both near 3e-4).
+* **The preconditioner streams in bfloat16.**  M⁻¹ only steers Krylov
+  convergence — any linear operator is a *valid* preconditioner — so
+  the [n, n] inverse pair is stored and applied in bf16, halving the
+  HBM traffic that dominates each GMRES iteration at 10k buses
+  (2 × n² × 2 B ≈ 400 MB/iteration instead of 800 MB).  The Newton
+  iterates, residuals, and JVPs all stay in the working dtype.
+* **Inexact Newton.**  The inner iteration runs a fixed
+  ``inner_iters`` sweeps (no data-dependent control flow); the outer
+  loop self-corrects whatever the inner solve leaves.
+
+Accuracy envelope (measured): in float64 (CPU tests) the solver reaches
+1e-8-level mismatch and matches the dense Newton oracle to 1e-14.  In
+float32 on the real chip a 10k-bus mesh converges to ~1.3e-5 pu in 6
+Newton iterations — under the default 3e-5 tolerance — and the host
+float64 oracle :func:`true_mismatch` confirms ~1e-5 true residual
+(``bench.py`` reports it, so the accuracy claim never rests on f32
+self-evaluation).  The weaker inner solvers tried first (stationary
+Richardson, Orthomin(1), stock jax GMRES) all stalled near 3e-4 on
+exactly this case; if a future change regresses the f32 mismatch
+toward that level, suspect the inner solve before blaming arithmetic —
+the f32 residual-evaluation noise itself is only ~8e-6 at this scale.
+
+Reference context: the reference's only solver is a 9-bus radial ladder
+sweep under a 3000 ms budget (``Broker/src/vvc/DPF_return7.cpp:8-263``,
+``Broker/config/timings.cfg:14-16``).  This path solves four orders of
+magnitude more network — meshed, not radial — per chip in milliseconds
+(BASELINE.md 10k-bus class; SURVEY §7 hard part (i) resolved without
+banded factorizations).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from freedm_tpu.grid.bus import BusSystem, SLACK, PQ, ybus_dense
+from freedm_tpu.pf.fdlf import decoupled_parts
+from freedm_tpu.pf.mfree import make_injection_fn
+from freedm_tpu.utils import cplx
+
+
+_NS_TARGET = 0.05  # ‖I − A·X‖_max good enough for a preconditioner
+
+
+@jax.jit
+def _newton_schulz(a):
+    """Approximate inverse by the Newton–Schulz GEMM iteration.
+
+    X_{k+1} = X_k (2I − A X_k), started from X_0 = Aᵀ/(‖A‖₁‖A‖∞),
+    converges quadratically once ‖I − A X‖ < 1 — and every step is two
+    [n, n] matmuls, i.e. pure MXU work.  The factorization routes XLA
+    offers here (LU + triangular solve against an identity RHS) either
+    OOM at compile time or serialize pathologically at n = 10k; a GEMM
+    iteration is the shape the systolic array wants.
+
+    Returns ``(x, resid)`` where ``resid = ‖I − A X‖_max``; the caller
+    falls back to a host LAPACK inverse if the iteration stalled (badly
+    conditioned B′/B″ — quantified, not assumed).
+    """
+    n = a.shape[0]
+    norm1 = jnp.max(jnp.sum(jnp.abs(a), axis=0))
+    norminf = jnp.max(jnp.sum(jnp.abs(a), axis=1))
+    x = a.T / (norm1 * norminf)
+    eye = jnp.eye(n, dtype=a.dtype)
+    # 2·log2(cond) + margin iterations; cond is unknown, so iterate on
+    # the measured residual with a hard cap.
+    max_steps = 120
+
+    def cond_fn(carry):
+        _, resid, it = carry
+        return jnp.logical_and(it < max_steps, resid > _NS_TARGET)
+
+    def body(carry):
+        x, _, it = carry
+        ax = a @ x
+        x_new = x @ (2.0 * eye - ax)
+        resid = jnp.max(jnp.abs(eye - ax))
+        return x_new, resid, it + 1
+
+    x, resid, _ = jax.lax.while_loop(
+        cond_fn, body, (x, jnp.asarray(jnp.inf, a.dtype), jnp.int32(0))
+    )
+    # One residual refresh for the final iterate.
+    resid = jnp.max(jnp.abs(eye - a @ x))
+    return x, resid
+
+
+def _precond_inv(mat, out_dtype):
+    """Explicit inverse for the preconditioner, in ``out_dtype``.
+
+    Newton–Schulz on device first (MXU GEMMs); if the iteration stalls
+    above ``_NS_TARGET`` — possible for very high-condition B′ — fall
+    back to LAPACK on the host, where an exact O(n³) factorization is
+    a one-time build cost, not a per-solve one.
+    """
+    import numpy as np
+
+    x, resid = _newton_schulz(mat)
+    if float(resid) <= _NS_TARGET:
+        return x.astype(out_dtype)
+    host = np.linalg.inv(np.asarray(mat, np.float64))
+    return jnp.asarray(host, out_dtype)
+
+
+def _pgmres(a_op, m_op, b, m: int):
+    """Right-preconditioned GMRES(m), one cycle, f32-robust.
+
+    ``jax.scipy.sparse.linalg.gmres`` proved unusable here: its batched
+    variant NaNs on Krylov breakdown and its incremental variant loses
+    orthogonality in float32 at 2·10k unknowns (non-monotone residuals).
+    This implementation is built for exactly this use:
+
+    - **masked modified Gram-Schmidt with a second pass** — each new
+      direction is orthogonalized against the whole stored basis twice;
+      the projections are [m+1, N] matmuls (MXU work), masked by basis
+      validity, which is both faster on TPU and more accurate than a
+      sequential MGS loop;
+    - **guarded normalizations** — a breakdown (‖w‖ → 0, i.e. the
+      Krylov space is exhausted because the preconditioner already
+      solved it) freezes further basis growth instead of dividing by ~0;
+    - **small dense least-squares** at the end (``lstsq`` on the
+      [m+1, m] Hessenberg) instead of incremental Givens rotations.
+
+    Returns the update ``x ≈ A⁻¹ b`` (zero initial guess).
+    """
+    dtype = b.dtype
+    nvec = b.shape[0]
+    tiny = jnp.asarray(jnp.finfo(dtype).tiny, dtype)
+    beta = jnp.linalg.norm(b)
+    safe_beta = jnp.maximum(beta, tiny)
+
+    v_basis = jnp.zeros((m + 1, nvec), dtype).at[0].set(b / safe_beta)
+    z_store = jnp.zeros((m, nvec), dtype)
+    h_mat = jnp.zeros((m + 1, m), dtype)
+    valid = jnp.zeros(m + 1, dtype).at[0].set(1.0)
+
+    def arnoldi(carry, j):
+        v_basis, z_store, h_mat, valid = carry
+        z = m_op(v_basis[j])
+        w = a_op(z)
+        # Two MGS passes against the valid basis, as batched matvecs.
+        mask = valid * (jnp.arange(m + 1) <= j)
+        h1 = (v_basis @ w) * mask
+        w = w - v_basis.T @ h1
+        h2 = (v_basis @ w) * mask
+        w = w - v_basis.T @ h2
+        h_col = h1 + h2
+        nrm = jnp.linalg.norm(w)
+        alive = (nrm > jnp.asarray(1e-30, dtype)).astype(dtype) * valid[j]
+        h_col = h_col.at[j + 1].set(nrm)
+        v_next = w / jnp.maximum(nrm, tiny) * alive
+        return (
+            v_basis.at[j + 1].set(v_next),
+            z_store.at[j].set(z * valid[j]),
+            h_mat.at[:, j].set(h_col * valid[j]),
+            valid.at[j + 1].set(alive),
+        ), None
+
+    (v_basis, z_store, h_mat, valid), _ = jax.lax.scan(
+        arnoldi, (v_basis, z_store, h_mat, valid), jnp.arange(m)
+    )
+    rhs = jnp.zeros(m + 1, dtype).at[0].set(beta)
+    y, *_ = jnp.linalg.lstsq(h_mat, rhs)
+    return z_store.T @ y
+
+
+class KrylovResult(NamedTuple):
+    """Power-flow solution in per-unit (matrix-free variant of
+    :class:`freedm_tpu.pf.newton.NewtonResult` — same fields)."""
+
+    v: jax.Array
+    theta: jax.Array
+    p: jax.Array
+    q: jax.Array
+    iterations: jax.Array
+    converged: jax.Array
+    mismatch: jax.Array
+
+
+def make_krylov_solver(
+    sys: BusSystem,
+    tol: Optional[float] = None,
+    max_iter: int = 12,
+    inner_iters: int = 24,
+    dtype: Optional[jnp.dtype] = None,
+    precond_dtype: jnp.dtype = jnp.bfloat16,
+):
+    """Compile the matrix-free Newton solver with Richardson inner.
+
+    Returns ``(solve, solve_fixed)`` with the same call signature as
+    :func:`freedm_tpu.pf.newton.make_newton_solver` (injections, branch
+    ``status``, and start point traced — vmap any of them).
+
+    ``inner_iters`` is the Krylov dimension of the inner solve — the
+    per-Newton-step work is bounded by that many JVPs + preconditioner
+    matvecs.
+    """
+    rdtype = cplx.default_rdtype(dtype)
+    if tol is None:
+        tol = 1e-8 if rdtype == jnp.float64 else 3e-5
+    n = sys.n_bus
+
+    bus_type = jnp.asarray(sys.bus_type)
+    th_free = (bus_type != SLACK).astype(rdtype)
+    v_free = (bus_type == PQ).astype(rdtype)
+    free = jnp.concatenate([th_free, v_free])
+    v_set = jnp.asarray(sys.v_set, rdtype)
+    p_sched0 = jnp.asarray(sys.p_inj, rdtype)
+    q_sched0 = jnp.asarray(sys.q_inj, rdtype)
+
+    inject = make_injection_fn(sys, rdtype)
+
+    # Build-time preconditioner: FDLF B′/B″ inverted once, stored bf16.
+    # (The dense [n, n] build peaks at ~3 n² f32 bytes — build-time only;
+    # the Newton loop itself never touches an [n, n] f32 array.)
+    parts = decoupled_parts(sys, rdtype)
+    with jax.default_matmul_precision("highest"):
+        _bp_inv = _precond_inv(parts.b_prime(None), precond_dtype)
+        _bq_inv = _precond_inv(
+            parts.b_dblprime(ybus_dense(sys, status=None, dtype=rdtype)),
+            precond_dtype,
+        )
+
+    def _residual(x, p_sched, q_sched, status):
+        theta, v = x[:n], x[n:]
+        p_calc, q_calc = inject(theta, v, status=status)
+        f_p = jnp.where(th_free > 0, p_calc - p_sched, theta)
+        f_q = jnp.where(v_free > 0, q_calc - q_sched, v - v_set)
+        return jnp.concatenate([f_p, f_q])
+
+    def _apply_precond(bp_inv, bq_inv, u, v_now):
+        """M⁻¹u with M = blockdiag(diag(V)B′, diag(V)B″): the FDLF
+        Jacobian approximation.  Pinned rows are identity in B′/B″ (see
+        ``decoupled_parts``), so they pass through unscaled."""
+        u_p, u_q = u[:n], u[n:]
+        s_p = jnp.where(th_free > 0, u_p / v_now, u_p).astype(precond_dtype)
+        s_q = jnp.where(v_free > 0, u_q / v_now, u_q).astype(precond_dtype)
+        d_th = (bp_inv @ s_p).astype(rdtype)
+        d_v = (bq_inv @ s_q).astype(rdtype)
+        return jnp.concatenate([d_th, d_v])
+
+    def _newton_step(bp_inv, bq_inv, x, p_sched, q_sched, status):
+        f = _residual(x, p_sched, q_sched, status)
+
+        def jvp_op(dx):
+            return jax.jvp(
+                lambda z: _residual(z, p_sched, q_sched, status), (x,), (dx,)
+            )[1]
+
+        v_now = x[n:]
+        precond = lambda u: _apply_precond(bp_inv, bq_inv, u, v_now)
+        dx = _pgmres(jvp_op, precond, -f, m=inner_iters)
+        # Breakdown safety net: a non-finite inner solve (never observed
+        # with the guarded MGS, but f32 at 20k unknowns has surprised
+        # before) falls back to one preconditioned first-order step.
+        dx = jnp.where(jnp.all(jnp.isfinite(dx)), dx, precond(-f))
+        return x + dx, jnp.max(jnp.abs(f * free))
+
+    def _prep(p_inj, q_inj, v0, theta0):
+        p_sched = p_sched0 if p_inj is None else jnp.asarray(p_inj, rdtype)
+        q_sched = q_sched0 if q_inj is None else jnp.asarray(q_inj, rdtype)
+        v = (
+            jnp.where(v_free > 0, 1.0, v_set).astype(rdtype)
+            if v0 is None
+            else jnp.asarray(v0, rdtype)
+        )
+        theta = jnp.zeros(n, rdtype) if theta0 is None else jnp.asarray(theta0, rdtype)
+        return jnp.concatenate([theta, v]), p_sched, q_sched
+
+    def _finish(x, p_sched, q_sched, status, it):
+        theta, v = x[:n], x[n:]
+        p_calc, q_calc = inject(theta, v, status=status)
+        err = jnp.max(jnp.abs(_residual(x, p_sched, q_sched, status) * free))
+        return KrylovResult(
+            v=v,
+            theta=theta,
+            p=p_calc,
+            q=q_calc,
+            iterations=jnp.asarray(it, jnp.int32),
+            converged=err < tol,
+            mismatch=err,
+        )
+
+    # The [n, n] inverse pair is passed as ARGUMENTS, not closed over:
+    # closure constants are serialized into the compile payload (at 10k
+    # buses that is 400 MB of bf16 — rejected by remote-compile paths
+    # and duplicated in HBM otherwise); runtime arguments are neither.
+    @jax.jit
+    def _solve_impl(bp_inv, bq_inv, x, ps, qs, status):
+        with jax.default_matmul_precision("highest"):
+            def cond(carry):
+                _, it, err = carry
+                return jnp.logical_and(it < max_iter, err >= tol)
+
+            def body(carry):
+                x, it, _ = carry
+                x_new, err = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
+                return (x_new, it + 1, err)
+
+            x, it, _ = jax.lax.while_loop(
+                cond, body, (x, jnp.int32(0), jnp.asarray(jnp.inf, rdtype))
+            )
+            return _finish(x, ps, qs, status, it)
+
+    @jax.jit
+    def _solve_fixed_impl(bp_inv, bq_inv, x, ps, qs, status):
+        with jax.default_matmul_precision("highest"):
+            def body(x, _):
+                x_new, _ = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
+                return x_new, None
+
+            x, _ = jax.lax.scan(body, x, None, length=max_iter)
+            return _finish(x, ps, qs, status, max_iter)
+
+    def solve(p_inj=None, q_inj=None, status=None, v0=None, theta0=None):
+        x, ps, qs = _prep(p_inj, q_inj, v0, theta0)
+        return _solve_impl(_bp_inv, _bq_inv, x, ps, qs, status)
+
+    def solve_fixed(p_inj=None, q_inj=None, status=None, v0=None, theta0=None):
+        x, ps, qs = _prep(p_inj, q_inj, v0, theta0)
+        return _solve_fixed_impl(_bp_inv, _bq_inv, x, ps, qs, status)
+
+    return solve, solve_fixed
+
+
+def true_mismatch(sys: BusSystem, result: KrylovResult) -> float:
+    """Host float64 oracle: the max masked power-flow residual of a
+    solution, evaluated branch-wise in numpy double precision.
+
+    Independent of every on-device dtype decision, so it reports the
+    REAL accuracy of a float32 solve (the on-device ``mismatch`` field
+    carries f32 evaluation noise at large n).  Cost: O(n + m) on host.
+    """
+    import numpy as np
+
+    from freedm_tpu.grid.bus import branch_admittances
+
+    n = sys.n_bus
+    theta = np.asarray(result.theta, np.float64)
+    v = np.asarray(result.v, np.float64)
+    yff, yft, ytf, ytt = [
+        np.asarray(c.re, np.float64) + 1j * np.asarray(c.im, np.float64)
+        for c in branch_admittances(sys, dtype=jnp.float64)
+    ]
+    f, t = sys.from_bus, sys.to_bus
+    vc = v * np.exp(1j * theta)
+    i_f = yff * vc[f] + yft * vc[t]
+    i_t = ytf * vc[f] + ytt * vc[t]
+    s_f = vc[f] * np.conj(i_f)
+    s_t = vc[t] * np.conj(i_t)
+    p = np.zeros(n)
+    q = np.zeros(n)
+    np.add.at(p, f, s_f.real)
+    np.add.at(p, t, s_t.real)
+    np.add.at(q, f, s_f.imag)
+    np.add.at(q, t, s_t.imag)
+    v2 = v * v
+    p += sys.g_shunt * v2
+    q -= sys.b_shunt * v2
+    th_free = sys.bus_type != SLACK
+    v_free = sys.bus_type == PQ
+    fp = np.where(th_free, p - sys.p_inj, 0.0)
+    fq = np.where(v_free, q - sys.q_inj, 0.0)
+    return float(max(np.max(np.abs(fp)), np.max(np.abs(fq))))
