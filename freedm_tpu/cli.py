@@ -62,6 +62,7 @@ class Runtime:
     endpoint: Optional[object] = None  # UdpEndpoint in federate mode
     federation: Optional[object] = None
     telemetry: Optional[object] = None  # TelemetryModule
+    mesh: Optional[object] = None  # MeshFleetModule in --mesh-devices mode
 
     def start(self) -> "Runtime":
         if self.endpoint is not None:
@@ -97,6 +98,11 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--network-config", default=None, help="network.xml path")
     ap.add_argument("--federate", action="store_true", default=None,
                     help="treat add-host peers as remote processes over the DCN")
+    ap.add_argument("--mesh-devices", type=int, default=None, metavar="N",
+                    help="dispatch rounds as one sharded superstep over an "
+                         "N-device mesh (0 = per-module kernels)")
+    ap.add_argument("--mesh-scenarios", type=int, default=None, metavar="B",
+                    help="VVC Monte-Carlo scenario lanes on the mesh batch axis")
     ap.add_argument("--checkpoint", default=None, metavar="PATH",
                     help="write a round-boundary checkpoint to PATH")
     ap.add_argument("--checkpoint-every", type=int, default=None, metavar="N",
@@ -136,6 +142,7 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("adapter_config", "adapter_config"), ("logger_config", "logger_config"),
         ("timings_config", "timings_config"), ("topology_config", "topology_config"),
         ("network_config", "network_config"), ("federate", "federate"),
+        ("mesh_devices", "mesh_devices"), ("mesh_scenarios", "mesh_scenarios"),
         ("checkpoint", "checkpoint"), ("checkpoint_every", "checkpoint_every"),
         ("resume", "resume"),
         ("migration_step", "migration_step"),
@@ -287,16 +294,37 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         if cfg.network_config:
             load_network_config(endpoint, cfg.network_config)
 
-    if vvc_feeder is not None:
+    invariant = omega_invariant() if cfg.check_invariant else None
+    mesh_mod = None
+    if cfg.mesh_devices > 0:
+        # Multi-chip dispatch: the whole round is ONE sharded superstep
+        # (runtime/meshfleet.py); GM/SC/LB/VVC phases are inside it.
+        if cfg.federate:
+            raise ValueError(
+                "--mesh-devices and --federate are different deployment "
+                "shapes (one sharded process vs DCN slices); pick one"
+            )
+        from freedm_tpu.runtime.meshfleet import MeshFleetModule
+
+        # vvc_feeder may be None: no vvc-case = no VVC leg, same
+        # contract as the per-module path.
+        mesh_mod = MeshFleetModule(
+            fleet,
+            vvc_feeder,
+            n_devices=cfg.mesh_devices,
+            n_scenarios=cfg.mesh_scenarios,
+            invariant=invariant,
+        )
+
+    if vvc_feeder is not None and mesh_mod is None:
         # Built after the federation so a federated VVC can run the
         # master/slave hand-off across slices.
         vvc = VvcModule(fleet, vvc_feeder, federation=federation)
         extra.append(vvc)
 
-    invariant = omega_invariant() if cfg.check_invariant else None
     broker = build_broker(
         fleet, timings, config=cfg, invariant=invariant, extra_modules=extra,
-        federation=federation,
+        federation=federation, mesh_module=mesh_mod,
     )
     if endpoint is not None:
         from freedm_tpu.runtime.clocksync import ClockSynchronizer
@@ -335,7 +363,7 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             )
     return Runtime(
         cfg, timings, broker, fleet, factories, vvc, endpoint, federation,
-        telemetry,
+        telemetry, mesh_mod,
     )
 
 

@@ -231,10 +231,14 @@ class GlobalConfig:
     clock_skew_us: int = 0
 
     # --- TPU-specific additions (no reference equivalent) ---
-    # Logical mesh shape: nodes axis = one row per DGI node; batch axis =
-    # Monte-Carlo scenarios.
-    mesh_nodes: int = 1
-    mesh_batch: int = 1
+    # Multi-chip dispatch: >0 runs the round loop as ONE sharded
+    # superstep over a mesh of this many devices
+    # (:mod:`freedm_tpu.runtime.meshfleet`); 0 = per-module kernels on
+    # the default device.  Mutually exclusive with ``federate``.
+    mesh_devices: int = 0
+    # VVC Monte-Carlo scenario lanes carried by the mesh superstep
+    # (sharded over the mesh's ``batch`` axis).
+    mesh_scenarios: int = 8
     # Feeder case (freedm_tpu.grid.cases constructor name) the VVC module
     # controls; unset = no VVC phase.  The reference compiles its feeder
     # into vvc_main (load_system_data.cpp); ours is a config knob.

@@ -54,17 +54,24 @@ class SuperstepOut(NamedTuple):
 
 def make_superstep(
     mesh,
-    feeder: Feeder,
+    feeder: Optional[Feeder] = None,
     migration_step: float = 1.0,
     vvc_config: vvc.VVCConfig = vvc.VVCConfig(),
 ):
-    """Compile the sharded superstep for a mesh and feeder.
+    """Compile the sharded superstep for a mesh (and optional feeder).
 
     Returns ``(step, shard_state)``: ``step(state) -> SuperstepOut`` is
     jitted with node/batch shardings; ``shard_state`` places a host
-    state onto the mesh.
+    state onto the mesh.  ``feeder=None`` runs the round without a VVC
+    leg (the config contract: no vvc-case = no VVC phase); the scenario
+    leaves collapse to placeholder [B, 1, 3] zeros and ``vvc_loss`` is
+    all-zero.
     """
-    vvc_step = vvc.make_vvc_controller(feeder, config=vvc_config)
+    vvc_step = (
+        vvc.make_vvc_controller(feeder, config=vvc_config)
+        if feeder is not None
+        else None
+    )
 
     n1 = node_sharding(mesh, 1)
     n2 = node_sharding(mesh, 2)
@@ -102,10 +109,11 @@ def make_superstep(
     )
 
     @partial(jax.jit, out_shardings=out_shardings)
-    def step(state: FleetState) -> SuperstepOut:
+    def step(state: FleetState, invariant_ok=None) -> SuperstepOut:
         group = gm.form_groups(state.alive, state.reachable)
         lb_out = lb.lb_round(
-            state.netgen, state.gateway, group.group_mask, migration_step
+            state.netgen, state.gateway, group.group_mask, migration_step,
+            invariant_ok=invariant_ok,
         )
         zeros = jnp.zeros_like(state.gateway)
         collected = sc.collect(
@@ -117,14 +125,23 @@ def make_superstep(
             zeros,
             lb_out.intransit,
         )
-        vvc_out = jax.vmap(lambda s, q: vvc_step(s, q))(state.s_load, state.q_ctrl)
-        new_state = state._replace(gateway=lb_out.gateway, q_ctrl=vvc_out.q_ctrl_kvar)
+        if vvc_step is not None:
+            vvc_out = jax.vmap(lambda s, q: vvc_step(s, q))(
+                state.s_load, state.q_ctrl
+            )
+            new_state = state._replace(
+                gateway=lb_out.gateway, q_ctrl=vvc_out.q_ctrl_kvar
+            )
+            vvc_loss = vvc_out.loss_after_kw
+        else:
+            new_state = state._replace(gateway=lb_out.gateway)
+            vvc_loss = jnp.zeros(state.q_ctrl.shape[0])
         return SuperstepOut(
             state=new_state,
             group=group,
             lb_out=lb_out,
             collected=collected,
-            vvc_loss=vvc_out.loss_after_kw,
+            vvc_loss=vvc_loss,
         )
 
     def shard_state(
@@ -136,7 +153,12 @@ def make_superstep(
     ) -> FleetState:
         n = len(netgen)
         b = len(scenario_scale)
-        s = np.asarray(feeder.s_load)[None] * np.asarray(scenario_scale)[:, None, None]
+        base = (
+            np.asarray(feeder.s_load)
+            if feeder is not None
+            else np.zeros((1, 3), np.complex128)
+        )
+        s = base[None] * np.asarray(scenario_scale)[:, None, None]
         state = FleetState(
             alive=jnp.asarray(np.ones(n) if alive is None else alive, jnp.float32),
             reachable=jnp.asarray(
@@ -145,7 +167,7 @@ def make_superstep(
             netgen=jnp.asarray(netgen, jnp.float32),
             gateway=jnp.asarray(gateway, jnp.float32),
             s_load=cplx.as_c(s, dtype=jnp.float32),
-            q_ctrl=jnp.zeros((b, feeder.n_branches, 3), jnp.float32),
+            q_ctrl=jnp.zeros((b, base.shape[0], 3), jnp.float32),
         )
         return jax.device_put(state, state_shardings)
 

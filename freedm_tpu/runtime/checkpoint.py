@@ -96,6 +96,16 @@ def collect_state(broker, fleet) -> Dict:
                 "stale_reads": m.stale_reads,
                 "skipped_rounds": m.skipped_rounds,
             }
+    mesh_ph = broker._by_name.get("mesh")
+    if mesh_ph is not None:
+        # Mesh-superstep deployments carry their VVC warm state as the
+        # sharded q_ctrl scenario tensor instead of per-module fields.
+        m = mesh_ph.module
+        state["mesh"] = {
+            "q_ctrl": None if m._state is None else _arr(m._state.q_ctrl),
+            "prev_loss": m._prev_loss,
+            "rounds": m.rounds,
+        }
     return state
 
 
@@ -153,6 +163,13 @@ def restore_state(state: Dict, broker, fleet) -> None:
         m.improved_rounds = vvc_s["improved_rounds"]
         m.stale_reads = vvc_s["stale_reads"]
         m.skipped_rounds = vvc_s["skipped_rounds"]
+    mesh_s = state.get("mesh")
+    if mesh_s and "mesh" in broker._by_name:
+        m = broker._by_name["mesh"].module
+        if mesh_s.get("q_ctrl") is not None:
+            m._restore_q_ctrl = np.asarray(mesh_s["q_ctrl"])
+        m._prev_loss = mesh_s.get("prev_loss")
+        m.rounds = mesh_s.get("rounds", 0)
     gateway = state.get("gateway")
     if gateway is not None:
         # Staged, not written: restore runs before adapters start, and

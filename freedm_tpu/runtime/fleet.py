@@ -1027,16 +1027,36 @@ def build_broker(
     invariant=None,
     extra_modules: Sequence[DgiModule] = (),
     federation=None,
+    mesh_module: Optional[DgiModule] = None,
 ) -> Broker:
     """Wire the standard module stack (PosixMain.cpp:346-435 parity:
     GM, SC, LB phases in order with timings.cfg budgets, SC subscribed
     to lb/vvc, plus fleet egress).  ``federation`` attaches the
     process-level GM/LB/SC protocols
-    (:class:`freedm_tpu.runtime.federation.Federation`)."""
+    (:class:`freedm_tpu.runtime.federation.Federation`).
+
+    ``mesh_module`` (a :class:`freedm_tpu.runtime.meshfleet.MeshFleetModule`)
+    replaces the four per-module phases with one sharded superstep
+    carrying the whole round budget — all other wiring (clock skew,
+    egress) is identical, so config knobs added here reach both paths.
+    """
     t = timings or Timings()
     broker = Broker(
         clock_skew_s=(config.clock_skew_us / 1e6 if config is not None else 0.0)
     )
+    if mesh_module is not None:
+        if extra_modules or federation is not None:
+            raise ValueError(
+                "mesh_module replaces the per-module phases; extra_modules/"
+                "federation cannot be combined with it"
+            )
+        broker.register_module(
+            mesh_module,
+            t.gm_phase_time + t.sc_phase_time + t.lb_phase_time
+            + t.vvc_phase_time,
+        )
+        broker.register_module(EgressModule(fleet), 0)
+        return broker
     gm_mod = GmModule(fleet, federation=federation)
     sc_mod = ScModule(fleet, federation=federation)
     lb_mod = LbModule(fleet, invariant=invariant, federation=federation)
