@@ -1,0 +1,1 @@
+"""Operator tooling (scenario drivers, soak rigs)."""

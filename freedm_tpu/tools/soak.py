@@ -1,0 +1,468 @@
+"""Multi-process federation soak: N slices + plantserver + kills/rejoins.
+
+The reference's scale rig is ``Broker/testing/run_test.sh`` — five DGI
+processes (``MultipleDgi3A..E``) wired by ``--add-host`` against one
+table server, run 15 s, then killed, with pass/fail judged by a human
+reading logs.  This tool is the framework's equivalent, automated
+(VERDICT r4 item 8):
+
+- one plantserver process (live feeder physics, RTDS lock-step TCP);
+- N federated ``python -m freedm_tpu`` processes over real UDP with
+  lossy links (network.xml reliability injection), each owning a
+  **different row segment** of the shared feeder's VVC devices (the
+  reference's s1→SST2-4 partition shape, ``Broker_s1`` master/slave
+  deployment);
+- scripted fault schedule: kill a member → regroup, restart → re-merge,
+  kill the LEADER → re-election + slave VVC fallback, restart → full
+  group again;
+- machine-checked assertions on the slices' own JSON round summaries:
+  group membership counts, leadership change, power conservation
+  (Σ gateway ≈ 0), and VVC liveness through the master's death;
+- one command, one pass/fail JSON artifact:
+
+    python -m freedm_tpu.tools.soak --slices 5 --out soak.json
+
+Exit code 0 iff every check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Feeder rows carrying per-phase Sst_{a,b,c} VVC devices, partitioned
+# round-robin across slices (heterogeneous segments: every slice
+# actuates a different subset of the one physical feeder).
+VVC_ROWS = (2, 3, 4, 5, 6, 7)
+
+
+def free_udp_ports(n: int) -> List[int]:
+    socks = [socket.socket(socket.AF_INET, socket.SOCK_DGRAM) for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+_CACHE_DIR: Optional[str] = None
+
+
+def _env() -> Dict[str, str]:
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    if _CACHE_DIR:
+        # All slices (and restarted slices) run identical JAX programs:
+        # a shared persistent compilation cache turns the N-process
+        # startup compile storm into one compile + N-1 cache hits.
+        env["JAX_COMPILATION_CACHE_DIR"] = _CACHE_DIR
+        env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1"
+    return env
+
+
+@dataclasses.dataclass
+class SliceSpec:
+    uuid: str
+    port: int
+    rows: List[int]
+    generation: float
+    drain: float
+    plant_port: Optional[int] = None
+    cfg_path: Optional[Path] = None
+
+
+class Check:
+    def __init__(self):
+        self.results: List[Dict] = []
+
+    def record(self, name: str, ok: bool, detail: str = "") -> bool:
+        self.results.append({"name": name, "ok": bool(ok), "detail": detail})
+        status = "ok " if ok else "FAIL"
+        print(f"[soak] {status} {name}  {detail}", flush=True)
+        return ok
+
+    @property
+    def passed(self) -> bool:
+        return all(r["ok"] for r in self.results)
+
+
+class Proc:
+    """One federated slice process with a summary-line reader."""
+
+    def __init__(self, spec: SliceSpec):
+        self.spec = spec
+        self.lines: List[Dict] = []
+        self.proc: Optional[subprocess.Popen] = None
+
+    def start(self) -> "Proc":
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "freedm_tpu", "-c", str(self.spec.cfg_path),
+             "--summary-every", "5", "--realtime"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=_env(), text=True,
+        )
+        threading.Thread(target=self._pump, daemon=True).start()
+        return self
+
+    def _pump(self):
+        proc = self.proc
+        for line in proc.stdout:
+            if line.startswith("{"):
+                try:
+                    self.lines.append(json.loads(line))
+                except ValueError:
+                    pass
+
+    def last(self) -> Dict:
+        return self.lines[-1] if self.lines else {}
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self):
+        if self.alive():
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+
+def wait_for(procs: List[Proc], cond, timeout_s: float) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.25)
+    return cond()
+
+
+def write_configs(
+    workdir: Path, specs: List[SliceSpec], loss_pct: int, vvc: bool = True
+) -> None:
+    from freedm_tpu.core.config import Timings
+    from freedm_tpu.devices.schema import DEFAULT_TYPES
+
+    lines = ["<root>"]
+    for t in DEFAULT_TYPES:
+        lines.append(f"  <deviceType><id>{t.id}</id>")
+        for s in t.states:
+            lines.append(f"    <state>{s}</state>")
+        for c in t.commands:
+            lines.append(f"    <command>{c}</command>")
+        lines.append("  </deviceType>")
+    lines.append("</root>")
+    (workdir / "device.xml").write_text("\n".join(lines))
+
+    # Small realtime budgets (gm 80 + sc 40 + lb 150 + vvc 250 = 520 ms
+    # rounds): realtime pacing keeps every slice's protocol timers on
+    # the same wall clock — free-running slices round at wildly
+    # different rates (one compiles while another spins), and the
+    # election's wall-clock timeouts then declare live peers dead
+    # forever.  This is the reference's own deployment shape.
+    small = {"gm_phase_time": 80, "sc_phase_time": 40,
+             "lb_phase_time": 150, "vvc_phase_time": 250}
+    tvals = {
+        f.name: small.get(f.name, getattr(Timings(), f.name))
+        for f in dataclasses.fields(Timings)
+    }
+    (workdir / "timings.cfg").write_text(
+        "\n".join(f"{k.upper()} = {v}" for k, v in tvals.items())
+    )
+
+    # Rig: every slice's devices live in ONE plant (shared physics),
+    # served over one RTDS port per slice.
+    rig = ['<rig case="vvc_9bus" base="feeder" period="0.02">']
+    tables: Dict[str, Dict[str, List]] = {}
+    for i, spec in enumerate(specs):
+        devs = [(f"SST{i}", "Sst", 2 + (i % 6), None)]
+        if spec.generation:
+            devs.append((f"GEN{i}", "Drer", 1 + (i % 7), spec.generation))
+        if spec.drain:
+            devs.append((f"LOAD{i}", "Load", 1 + ((i + 3) % 7), spec.drain))
+        for row in spec.rows:
+            for ph in "abc":
+                devs.append((f"Q{row}_{ph}", f"Sst_{ph}", row, None))
+        states, commands = [], []
+        for name, tname, node, value in devs:
+            v = f' value="{value}"' if value is not None else ""
+            rig.append(
+                f'  <device name="{name}" type="{tname}" node="{node}"{v}/>'
+            )
+            sig = {"Drer": "generation", "Load": "drain"}.get(tname, "gateway")
+            states.append((name, tname, sig))
+            if tname.startswith("Sst"):
+                commands.append((name, tname, "gateway"))
+        tables[spec.uuid] = {"states": states, "commands": commands}
+        rig.append('  <adapter port="0">')
+        for kind in ("state", "command"):
+            for j, (dev, _, sig) in enumerate(tables[spec.uuid][kind + "s"]):
+                rig.append(f'    <{kind} device="{dev}" signal="{sig}" index="{j}"/>')
+        rig.append("  </adapter>")
+    rig.append("</rig>")
+    (workdir / "rig.xml").write_text("\n".join(rig))
+
+    # Shared adapter.xml; owner= routes, non-local owners are skipped in
+    # federate mode.  Plant ports are patched in later (ephemeral).
+    al = ["<root>"]
+    for spec in specs:
+        al.append(
+            f'  <adapter name="sim-{spec.port}" type="rtds" owner="{spec.uuid}">'
+        )
+        al.append(
+            f"    <info><host>127.0.0.1</host><port>@PORT-{spec.uuid}@</port>"
+            f"<poll>0.02</poll></info>"
+        )
+        for kind in ("state", "command"):
+            al.append(f"    <{kind}>")
+            for j, (dev, tname, sig) in enumerate(tables[spec.uuid][kind + "s"]):
+                al.append(
+                    f'      <entry index="{j + 1}"><type>{tname}</type>'
+                    f"<device>{dev}</device><signal>{sig}</signal></entry>"
+                )
+            al.append(f"    </{kind}>")
+        al.append("  </adapter>")
+    al.append("</root>")
+    (workdir / "adapter.xml.tmpl").write_text("\n".join(al))
+
+    for spec in specs:
+        net = ["<network>", f"  <incoming><reliability>{100 - loss_pct}</reliability></incoming>", "  <outgoing>"]
+        for other in specs:
+            if other.uuid != spec.uuid:
+                net.append(
+                    f'    <channel uuid="{other.uuid}">'
+                    f"<reliability>{100 - loss_pct}</reliability></channel>"
+                )
+        net += ["  </outgoing>", "</network>"]
+        (workdir / f"network_{spec.port}.xml").write_text("\n".join(net))
+
+        cfg = workdir / f"freedm_{spec.port}.cfg"
+        peers = "\n".join(
+            f"add-host = {o.uuid}" for o in specs if o.uuid != spec.uuid
+        )
+        vvc_line = "vvc-case = vvc_9bus\n" if vvc else ""
+        cfg.write_text(
+            f"hostname = 127.0.0.1\nport = {spec.port}\nfederate = yes\n"
+            f"{peers}\nmigration-step = 1\n{vvc_line}"
+            f"device-config = {workdir}/device.xml\n"
+            f"adapter-config = {workdir}/adapter.xml\n"
+            f"timings-config = {workdir}/timings.cfg\n"
+            f"network-config = {workdir}/network_{spec.port}.xml\n"
+        )
+        spec.cfg_path = cfg
+
+
+def finalize_adapter_xml(workdir: Path, specs: List[SliceSpec], plant_ports: List[int]):
+    text = (workdir / "adapter.xml.tmpl").read_text()
+    for spec, port in zip(specs, plant_ports):
+        spec.plant_port = port
+        text = text.replace(f"@PORT-{spec.uuid}@", str(port))
+    (workdir / "adapter.xml").write_text(text)
+
+
+def run_soak(
+    n_slices: int = 5,
+    duration_s: float = 60.0,
+    loss_pct: int = 20,
+    workdir: Optional[str] = None,
+    out: Optional[str] = None,
+    vvc: bool = True,
+) -> Dict:
+    import tempfile
+
+    global _CACHE_DIR
+    t_start = time.monotonic()
+    wd = Path(workdir) if workdir else Path(tempfile.mkdtemp(prefix="freedm_soak_"))
+    wd.mkdir(parents=True, exist_ok=True)
+    _CACHE_DIR = str(wd / "jax_cache")
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    ports = free_udp_ports(n_slices)
+    specs = []
+    for i, port in enumerate(ports):
+        rows = [r for j, r in enumerate(VVC_ROWS) if j % n_slices == i]
+        # One big producer, the rest consumers: migrations must flow.
+        gen = 20.0 * (n_slices - 1) if i == 0 else 0.0
+        drain = 0.0 if i == 0 else 15.0
+        specs.append(
+            SliceSpec(
+                uuid=f"127.0.0.1:{port}", port=port, rows=rows,
+                generation=gen, drain=drain,
+            )
+        )
+    write_configs(wd, specs, loss_pct, vvc=vvc)
+
+    check = Check()
+    plant = subprocess.Popen(
+        [sys.executable, "-m", "freedm_tpu.sim.plantserver", str(wd / "rig.xml")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=_env(), text=True,
+    )
+    procs: List[Proc] = []
+    try:
+        line = plant.stdout.readline()
+        plant_ports = [p for _, p in json.loads(line)["plantserver"]]
+        check.record("plantserver_up", len(plant_ports) == n_slices,
+                     f"ports={plant_ports}")
+        finalize_adapter_xml(wd, specs, plant_ports)
+
+        procs = [Proc(s).start() for s in specs]
+        # Phase budget: the slices JIT-compile their VVC/LB kernels on
+        # first rounds (amortized by the shared compilation cache, but
+        # the first process still pays ~30-60 s on CPU) before the
+        # first summary line appears.
+        form_timeout = max(3.0 * duration_s, 180.0)
+
+        wait_for(procs, lambda: all(p.lines for p in procs), form_timeout)
+
+        def members_everywhere(n):
+            return lambda: all(
+                p.last().get("fed_members") == n for p in procs if p.alive()
+            )
+
+        def one_leader(procs_):
+            return len({p.last().get("fed_leader") for p in procs_ if p.alive()}) == 1
+
+        ok = wait_for(
+            procs,
+            lambda: members_everywhere(n_slices)() and one_leader(procs),
+            form_timeout,
+        )
+        check.record(
+            f"group_of_{n_slices}_forms", ok,
+            f"members={[p.last().get('fed_members') for p in procs]}",
+        )
+        leaders = {p.last().get("fed_leader") for p in procs}
+        check.record("single_leader", len(leaders) == 1, f"leaders={leaders}")
+        leader_uuid = next(iter(leaders)) if leaders else None
+
+        # Power migrates and stays conserved under loss.
+        def migrated():
+            return any(p.last().get("fed_migrations", 0) > 0 for p in procs)
+
+        ok = wait_for(procs, migrated, duration_s)
+        check.record("migrations_flow", ok,
+                     f"migs={[p.last().get('fed_migrations') for p in procs]}")
+
+        def conservation_ok():
+            totals = [p.last().get("gateway_total") for p in procs]
+            if any(t is None for t in totals):
+                return False
+            return abs(sum(totals)) <= 2.0  # ≤ two in-flight quanta
+
+        ok = wait_for(procs, conservation_ok, duration_s / 2)
+        totals = [round(p.last().get("gateway_total", float("nan")), 2) for p in procs]
+        check.record("power_conserved", ok, f"gateways={totals} sum={round(sum(totals), 2)}")
+
+        # VVC runs somewhere (the master covers the union of segments).
+        def vvc_live():
+            return any("vvc_loss_kw" in p.last() for p in procs)
+
+        check.record("vvc_live", wait_for(procs, vvc_live, duration_s),
+                     "")
+
+        # -- fault schedule --------------------------------------------------
+        member = next(p for p in procs if p.spec.uuid != leader_uuid)
+        member.kill()
+        survivors = [p for p in procs if p.alive()]
+        ok = wait_for(survivors, lambda: all(
+            p.last().get("fed_members") == n_slices - 1 for p in survivors
+        ), form_timeout)
+        check.record("member_death_regroups", ok,
+                     f"members={[p.last().get('fed_members') for p in survivors]}")
+
+        member.lines.clear()
+        member.start()
+        ok = wait_for(procs, members_everywhere(n_slices), form_timeout)
+        check.record("member_rejoin_remerges", ok,
+                     f"members={[p.last().get('fed_members') for p in procs]}")
+
+        # Kill the LEADER: re-election among survivors + slave VVC
+        # fallback (members keep volt-var alive without their master).
+        leader_proc = next(p for p in procs if p.spec.uuid == leader_uuid)
+        leader_proc.kill()
+        survivors = [p for p in procs if p.alive()]
+        ok = wait_for(survivors, lambda: all(
+            p.last().get("fed_members") == n_slices - 1 for p in survivors
+        ) and one_leader(survivors), form_timeout)
+        new_leaders = {p.last().get("fed_leader") for p in survivors}
+        check.record(
+            "leader_death_reelects",
+            ok and len(new_leaders) == 1 and leader_uuid not in new_leaders,
+            f"new_leaders={new_leaders}",
+        )
+
+        def survivor_vvc_moves():
+            return any(
+                "vvc_loss_kw" in p.lines[-1]
+                for p in survivors
+                if p.lines
+            )
+
+        for p in survivors:
+            p.lines.clear()
+        check.record(
+            "vvc_survives_master_death",
+            wait_for(survivors, survivor_vvc_moves, form_timeout),
+            "standalone fallback on the members",
+        )
+
+        leader_proc.lines.clear()
+        leader_proc.start()
+        ok = wait_for(procs, members_everywhere(n_slices), form_timeout)
+        check.record("leader_rejoin_remerges", ok,
+                     f"members={[p.last().get('fed_members') for p in procs]}")
+
+        crashed = [p.spec.uuid for p in procs if not p.alive()]
+        check.record("no_unexpected_crashes", not crashed, f"crashed={crashed}")
+    finally:
+        for p in procs:
+            p.kill()
+        plant.kill()
+        plant.wait(timeout=10)
+
+    artifact = {
+        "pass": check.passed,
+        "slices": n_slices,
+        "loss_pct": loss_pct,
+        "duration_s": round(time.monotonic() - t_start, 1),
+        "checks": check.results,
+        "workdir": str(wd),
+    }
+    if out:
+        Path(out).write_text(json.dumps(artifact, indent=2))
+    print(json.dumps({"soak_pass": artifact["pass"],
+                      "checks": len(check.results),
+                      "failed": [c["name"] for c in check.results if not c["ok"]]}),
+          flush=True)
+    return artifact
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="Federated multi-process soak rig")
+    ap.add_argument("--slices", type=int, default=5)
+    ap.add_argument("--duration", type=float, default=60.0,
+                    help="per-phase timeout budget, seconds")
+    ap.add_argument("--loss", type=int, default=20, metavar="PCT",
+                    help="datagram loss percentage on every link")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the JSON artifact here")
+    ap.add_argument("--no-vvc", action="store_true",
+                    help="run without the VVC module (debug)")
+    args = ap.parse_args(argv)
+    artifact = run_soak(
+        n_slices=args.slices, duration_s=args.duration, loss_pct=args.loss,
+        workdir=args.workdir, out=args.out, vvc=not args.no_vvc,
+    )
+    return 0 if artifact["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
