@@ -8,6 +8,14 @@ target).  The reference's own envelope is one 9-bus 3-phase ladder solve
 per 3000 ms VVC round (``Broker/config/timings.cfg``,
 ``Broker/src/vvc/DPF_return7.cpp``).
 
+Ladder-iteration history on v5e (the sweep realization is the whole
+story at this size — each round moves only 240 KB, so kernel-launch
+count dominates): r1-r3 1.32 ms (doubling, separate re/im kernels);
+r4 0.749 ms (re‖im packed on the last axis — note the [..,3,2]
+trailing-stack variant measured 2.5x SLOWER, minor-dim lane tiling);
+r5 0.378 ms (Euler-tour prefix-sum sweeps, ``pf/sweeps.euler_sweeps``:
+kernel count independent of tree depth vs ~13 pointer-jumping rounds).
+
 ``extra`` carries the remaining BASELINE.md target rows, measured in the
 same process:
 

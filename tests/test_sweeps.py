@@ -33,12 +33,13 @@ def _rand_c(rng, shape):
 def test_doubling_matches_dense(feeder, rng):
     dtype = jnp.float64
     b_dense, f_dense = sweeps.dense_sweeps(feeder, dtype)
-    b_dbl, f_dbl = sweeps.doubling_sweeps(feeder, dtype)
-    x = _rand_c(rng, (feeder.n_branches, 3))
-    np.testing.assert_allclose(b_dbl(x).re, b_dense(x).re, atol=1e-10)
-    np.testing.assert_allclose(b_dbl(x).im, b_dense(x).im, atol=1e-10)
-    np.testing.assert_allclose(f_dbl(x).re, f_dense(x).re, atol=1e-10)
-    np.testing.assert_allclose(f_dbl(x).im, f_dense(x).im, atol=1e-10)
+    for maker in (sweeps.doubling_sweeps, sweeps.euler_sweeps):
+        b_alt, f_alt = maker(feeder, dtype)
+        x = _rand_c(rng, (feeder.n_branches, 3))
+        np.testing.assert_allclose(b_alt(x).re, b_dense(x).re, atol=1e-10)
+        np.testing.assert_allclose(b_alt(x).im, b_dense(x).im, atol=1e-10)
+        np.testing.assert_allclose(f_alt(x).re, f_dense(x).re, atol=1e-10)
+        np.testing.assert_allclose(f_alt(x).im, f_dense(x).im, atol=1e-10)
 
 
 def test_doubling_vmaps(rng):
@@ -56,20 +57,23 @@ def test_doubling_vmaps(rng):
 def test_ladder_solution_identical_across_methods():
     feeder = cases.synthetic_radial(300, seed=5)
     solve_dense, _ = ladder.make_ladder_solver(feeder, sweep_method="dense")
-    solve_dbl, _ = ladder.make_ladder_solver(feeder, sweep_method="doubling")
     r1 = solve_dense(feeder.s_load)
-    r2 = solve_dbl(feeder.s_load)
-    assert bool(r1.converged) and bool(r2.converged)
-    np.testing.assert_allclose(r2.v_node.re, r1.v_node.re, atol=1e-10)
-    np.testing.assert_allclose(r2.v_node.im, r1.v_node.im, atol=1e-10)
+    assert bool(r1.converged)
+    for method in ("doubling", "euler"):
+        solve_alt, _ = ladder.make_ladder_solver(feeder, sweep_method=method)
+        r2 = solve_alt(feeder.s_load)
+        assert bool(r2.converged)
+        np.testing.assert_allclose(r2.v_node.re, r1.v_node.re, atol=1e-10)
+        np.testing.assert_allclose(r2.v_node.im, r1.v_node.im, atol=1e-10)
 
 
-def test_large_feeder_uses_doubling_and_balances_power():
+def test_large_feeder_uses_euler_and_balances_power():
     # 5k-bus: compiled without a dense subtree matrix; the auto-selected
-    # solver must converge and satisfy conservation: substation injection
-    # = total load + total series losses. (2 kW/bus keeps the feeder
-    # inside its loadability limit — heavier loading is genuine voltage
-    # collapse, where the ladder method diverges by construction.)
+    # solver (Euler-tour prefix sums) must converge and satisfy
+    # conservation: substation injection = total load + total series
+    # losses. (2 kW/bus keeps the feeder inside its loadability limit —
+    # heavier loading is genuine voltage collapse, where the ladder
+    # method diverges by construction.)
     feeder = cases.synthetic_radial(5000, seed=6, pv_frac=0.1, load_kw=2.0)
     assert feeder.subtree is None
     solve, _ = ladder.make_ladder_solver(feeder)
