@@ -369,8 +369,9 @@ def run_soak(
         def vvc_live():
             return any("vvc_loss_kw" in p.last() for p in procs)
 
-        check.record("vvc_live", wait_for(procs, vvc_live, duration_s),
-                     "")
+        if vvc:
+            check.record("vvc_live", wait_for(procs, vvc_live, duration_s),
+                         "")
 
         # -- fault schedule --------------------------------------------------
         member = next(p for p in procs if p.spec.uuid != leader_uuid)
@@ -410,13 +411,14 @@ def run_soak(
                 if p.lines
             )
 
-        for p in survivors:
-            p.lines.clear()
-        check.record(
-            "vvc_survives_master_death",
-            wait_for(survivors, survivor_vvc_moves, form_timeout),
-            "standalone fallback on the members",
-        )
+        if vvc:
+            for p in survivors:
+                p.lines.clear()
+            check.record(
+                "vvc_survives_master_death",
+                wait_for(survivors, survivor_vvc_moves, form_timeout),
+                "standalone fallback on the members",
+            )
 
         leader_proc.lines.clear()
         leader_proc.start()

@@ -1,0 +1,26 @@
+"""CI wrapper around the federation soak rig (freedm_tpu/tools/soak.py).
+
+The full artifact run is 5 slices, 20% loss, VVC on every slice
+(``python -m freedm_tpu.tools.soak``; the committed SOAK_r05.json is
+one such run).  CI runs a reduced-but-real version: two federated
+subprocesses + plantserver over real sockets, scripted member AND
+leader kills with rejoins — every check machinery path, bounded time.
+"""
+
+import os
+
+from freedm_tpu.tools.soak import run_soak
+
+
+def test_soak_two_slices_quick(tmp_path):
+    artifact = run_soak(
+        n_slices=2,
+        duration_s=20.0,
+        loss_pct=0,
+        workdir=str(tmp_path),
+        out=str(tmp_path / "soak.json"),
+        vvc=False,
+    )
+    failed = [c for c in artifact["checks"] if not c["ok"]]
+    assert artifact["pass"], failed
+    assert os.path.exists(tmp_path / "soak.json")
