@@ -14,7 +14,10 @@ count dominates): r1-r3 1.32 ms (doubling, separate re/im kernels);
 r4 0.749 ms (re‖im packed on the last axis — note the [..,3,2]
 trailing-stack variant measured 2.5x SLOWER, minor-dim lane tiling);
 r5 0.378 ms (Euler-tour prefix-sum sweeps, ``pf/sweeps.euler_sweeps``:
-kernel count independent of tree depth vs ~13 pointer-jumping rounds).
+kernel count independent of tree depth vs ~13 pointer-jumping rounds);
+r5 0.311 ms (DFS-preorder branch relabeling inside the solver:
+tin = identity cuts the per-iteration data movement to ONE gather +
+ONE scatter - dynamic addressing is what remains).
 
 ``extra`` carries the remaining BASELINE.md target rows, measured in the
 same process:

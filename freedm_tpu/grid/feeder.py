@@ -161,6 +161,59 @@ class Feeder:
         self.levels = int(depth.max()) + 1 if nb else 0
         return self
 
+    def reorder_preorder(self) -> tuple["Feeder", np.ndarray]:
+        """Relabel branches (and their to-nodes) into DFS preorder.
+
+        In preorder, every subtree is a contiguous branch interval and
+        ``tin`` is the identity — the Euler-tour sweeps
+        (:func:`freedm_tpu.pf.sweeps.euler_sweeps`) then need one gather
+        + one scatter per iteration instead of four/two, which halves
+        the 10k-bus ladder iteration on TPU (dynamic gathers are the
+        cost at this size).  Returns ``(reordered, perm)`` with ``perm``
+        the preorder list (``new index -> old branch index``); per-branch
+        inputs map forward as ``x_new = x_old[perm]`` and results map
+        back as ``y_old = y_new[inv]`` with ``inv = argsort(perm)``.
+        Already-preordered feeders return ``(self, identity)``.
+        """
+        nb = self.n_branches
+        parent = self.parent
+        children: list[list[int]] = [[] for _ in range(nb)]
+        roots = []
+        for i in range(nb):
+            if parent[i] < 0:
+                roots.append(i)
+            else:
+                children[parent[i]].append(i)
+        perm = np.zeros(nb, dtype=np.int32)
+        t = 0
+        stack = list(reversed(roots))
+        while stack:
+            i = stack.pop()
+            perm[t] = i
+            t += 1
+            stack.extend(reversed(children[i]))
+        if t != nb:
+            raise ValueError("not a forest rooted at the substation")
+        if np.array_equal(perm, np.arange(nb)):
+            return self, perm
+        tin = np.argsort(perm).astype(np.int32)  # old -> new
+        # Node relabeling follows branches (branch i feeds node i+1).
+        new_from = np.where(
+            self.from_node[perm] == 0, 0, tin[self.from_node[perm] - 1] + 1
+        ).astype(np.int32)
+        out = Feeder(
+            parent=new_from - 1,
+            from_node=new_from,
+            z_pu=self.z_pu[perm],
+            s_load=self.s_load[perm],
+            q_shunt=self.q_shunt[perm],
+            load_type=self.load_type[perm],
+            base_kva=self.base_kva,
+            base_kv=self.base_kv,
+            v_source_pu=self.v_source_pu,
+        ).compile(dense_subtree=self.subtree is not None)
+        return out, perm
+
     # -- Conversions --------------------------------------------------------
 
     def s_load_pu(self, s_load_kva: Optional[np.ndarray] = None) -> np.ndarray:

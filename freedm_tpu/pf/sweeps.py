@@ -196,6 +196,35 @@ def euler_sweeps(feeder: Feeder, dtype) -> Tuple[SweepFn, SweepFn]:
     def _unpack(x, p):
         return C(x[..., :p], x[..., p:])
 
+    if bool(np.all(tin == np.arange(nb))):
+        # Feeder already in DFS preorder (see Feeder.reorder_preorder):
+        # tin is the identity, so the per-iteration data movement drops
+        # to ONE gather + ONE scatter-add (TPU dynamic gathers/scatters
+        # are the cost at this size — ~120-180 µs each against ~µs
+        # cumsums):
+        #   backward[i] = P[tout_i] − P[i]          (P = excl. prefix)
+        #   forward[i]  = P[i+1] − Q[i],
+        #       Q[i] = Σ_{k: tout_k ≤ i} x_k = cumsum(scatter x @ tout)[i]
+        # The forward identity: ancestors-or-self of i are exactly the
+        # k ≤ i whose subtree interval is still open at i (tout_k > i);
+        # subtracting the prefix of CLOSED subtrees leaves the path sum.
+        def backward(i_load: C) -> C:
+            p = i_load.re.shape[-1]
+            x = _pack(i_load)
+            ps = jnp.cumsum(x, axis=0)
+            zero = jnp.zeros((1,) + x.shape[1:], ps.dtype)
+            ps = jnp.concatenate([zero, ps], axis=0)
+            return _unpack(ps[tout_j] - ps[:nb], p)
+
+        def forward(drop: C) -> C:
+            p = drop.re.shape[-1]
+            x = _pack(drop)
+            p_incl = jnp.cumsum(x, axis=0)
+            q = jnp.zeros((nb + 1,) + x.shape[1:], x.dtype).at[tout_j].add(x)
+            return _unpack(p_incl - jnp.cumsum(q, axis=0)[:nb], p)
+
+        return backward, forward
+
     def backward(i_load: C) -> C:
         p = i_load.re.shape[-1]
         x = _pack(i_load)
