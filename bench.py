@@ -105,6 +105,25 @@ def bench_ladder():
     return dt / MAX_ITER * 1000.0
 
 
+def bench_ladder_mc_64():
+    """The full BASELINE scale matrix at once — 64 Monte-Carlo scenario
+    lanes x 10k buses through the vmapped ladder (batching amortizes the
+    per-iteration dynamic addressing ~5x beyond the single-lane rate).
+    Returns full-feeder solves/sec."""
+    feeder = synthetic_radial(N_BUS, seed=0, load_kw=1.0)
+    _, solve_fixed = ladder.make_ladder_solver(feeder, max_iter=MAX_ITER)
+    from freedm_tpu.utils import cplx
+
+    rng = np.random.default_rng(0)
+    scale = rng.uniform(0.7, 1.3, (64, 1, 1))
+    s = jax.device_put(cplx.as_c(scale * feeder.s_load[None]))
+    batched = jax.jit(jax.vmap(solve_fixed))
+    r = batched(s)
+    assert bool(jnp.all(r.converged)), "10k MC lanes diverged"
+    dt = _time(lambda: batched(s), lambda r: r.v_node.re, reps=10)
+    return 64.0 / dt
+
+
 def bench_nr_2000(maker=make_newton_solver, max_iter=10):
     sys = synthetic_mesh(2000, seed=4, load_mw=2.0, chord_frac=1.0)
     solve, _ = maker(sys, max_iter=max_iter)
@@ -258,6 +277,9 @@ def main() -> None:
         "nr_2000bus_krylov_mfu_pct": round(mfu, 2),
         "n1_2000bus_256way_krylov_screen_ms": round(
             bench_n1_2000bus_krylov(), 1
+        ),
+        "mc_64lane_10000bus_ladder_solves_per_sec": round(
+            bench_ladder_mc_64(), 1
         ),
         "nr_2000bus_mesh_solves_per_sec": round(bench_nr_2000(), 2),
         "fdlf_2000bus_mesh_solves_per_sec": round(
