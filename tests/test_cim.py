@@ -108,3 +108,34 @@ def test_fixed_variant_matches_while_loop():
     np.testing.assert_allclose(
         a.v_node.to_numpy(), b.v_node.to_numpy(), atol=1e-9
     )
+
+
+def test_gradient_through_fixed_solver():
+    """Unbalanced weakly-meshed VVC adjoint: the gradient of a voltage-
+    profile objective w.r.t. per-phase reactive loads, by reverse-mode
+    AD through the fixed-iteration current-injection solve, checked
+    against finite differences — a capability the reference's
+    hand-built 9-bus adjoint cannot reach (its solver is radial-only)."""
+    import jax
+    import jax.numpy as jnp
+
+    from freedm_tpu.utils import cplx
+
+    feeder = vvc_9bus()
+    _, solve_fixed = make_cim_solver(feeder, ties=[TIE_5_8], max_iter=80)
+    p0 = jnp.asarray(feeder.s_load.real)
+    q00 = jnp.asarray(feeder.s_load.imag)
+
+    def profile_loss(q):
+        r = solve_fixed(cplx.C(p0, q))
+        v2 = r.v_node.abs2()[1:]
+        return jnp.sum((v2 - 1.0) ** 2)
+
+    g = jax.grad(profile_loss)(q00)
+    h = 1e-3
+    for idx in ((1, 0), (4, 2), (7, 1)):
+        e = jnp.zeros_like(q00).at[idx].set(h)
+        fd = (profile_loss(q00 + e) - profile_loss(q00 - e)) / (2 * h)
+        np.testing.assert_allclose(
+            np.asarray(g[idx]), np.asarray(fd), rtol=1e-4, atol=1e-10
+        )

@@ -81,3 +81,31 @@ def test_reports_nonconvergence():
     # reported as converged.
     r = solve(p_inj=jnp.asarray(sys_.p_inj * 500.0))
     assert not bool(r.converged)
+
+
+def test_gradient_through_fixed_solver():
+    """The meshed VVC adjoint for free: d(losses)/d(q_inj) by reverse-
+    mode AD through the fixed-iteration matrix-free solve, checked
+    against central finite differences.  The reference hand-builds this
+    adjoint for its 9-bus radial case only (form_Ftheta/Fv/J + inv);
+    here it exists at transmission scale by construction."""
+    import jax
+
+    sys_ = synthetic_mesh(120, seed=4, load_mw=2.0, chord_frac=1.0)
+    _, solve_fixed = make_krylov_solver(sys_, max_iter=6, inner_iters=16)
+    q0 = jnp.asarray(sys_.q_inj)
+
+    def slack_p(q):
+        # Slack active injection = total losses + net load: a scalar
+        # whose q-sensitivity is the classic loss-gradient signal.
+        r = solve_fixed(q_inj=q)
+        return r.p[sys_.slack]
+
+    g = jax.grad(slack_p)(q0)
+    h = 1e-5
+    for idx in (3, 47, 101):
+        e = jnp.zeros_like(q0).at[idx].set(h)
+        fd = (slack_p(q0 + e) - slack_p(q0 - e)) / (2 * h)
+        np.testing.assert_allclose(
+            np.asarray(g[idx]), np.asarray(fd), rtol=1e-4, atol=1e-8
+        )
