@@ -150,7 +150,9 @@ def bench_nr_10k_mesh():
     host in double precision so the reported accuracy is real, not f32
     evaluation noise."""
     sys_ = synthetic_mesh(10_000, seed=4, load_mw=2.0, chord_frac=0.3)
-    solve, _ = make_krylov_solver(sys_, max_iter=15)
+    # inner=16 measured both faster and slightly more accurate than the
+    # default 24 at this size (178 vs 212 ms, 8.7e-6 vs 9.8e-6 true).
+    solve, _ = make_krylov_solver(sys_, max_iter=15, inner_iters=16)
     r = solve()
     assert bool(r.converged), f"10k mesh diverged: {float(r.mismatch)}"
     dt = _time(solve, lambda r: r.v, reps=10)
