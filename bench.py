@@ -74,10 +74,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from freedm_tpu.core.metrics import REGISTRY
 from freedm_tpu.grid.cases import synthetic_mesh, synthetic_radial
 from freedm_tpu.pf import ladder
 from freedm_tpu.pf.fdlf import make_fdlf_solver
-from freedm_tpu.pf.krylov import make_krylov_solver, true_mismatch
+from freedm_tpu.pf.krylov import make_krylov_solver, record_result, true_mismatch
 from freedm_tpu.pf.newton import make_newton_solver
 
 TARGET_MS_PER_ITER = 10.0
@@ -155,6 +156,7 @@ def bench_nr_10k_mesh():
     solve, _ = make_krylov_solver(sys_, max_iter=15, inner_iters=16)
     r = solve()
     assert bool(r.converged), f"10k mesh diverged: {float(r.mismatch)}"
+    record_result(r)  # already host-side via the assert — no extra sync
     dt = _time(solve, lambda r: r.v, reps=10)
     return dt * 1000.0, true_mismatch(sys_, r)
 
@@ -180,6 +182,7 @@ def bench_nr_2k_krylov_lanes(lanes=256, outer=8, inner=16):
     )
     r = batched(p, q)
     assert bool(jnp.all(r.converged)), "krylov lane batch diverged"
+    record_result(r)  # every lane's iterations, worst lane's residual
     dt = _time(lambda: batched(p, q), lambda r: r.v, reps=10)
     lane_rate = lanes / dt
     flops_per_lane = outer * inner * 4.0 * n * n
@@ -209,6 +212,7 @@ def bench_n1_2000bus_krylov(k=256):
     )
     r = screen(status)
     assert bool(jnp.all(r.converged)), "2k N-1 screen diverged"
+    record_result(r)
     dt = _time(lambda: screen(status), lambda r: r.v, reps=5)
     return dt * 1000.0
 
@@ -304,6 +308,9 @@ def main() -> None:
                 "unit": "ms/iteration",
                 "vs_baseline": round(TARGET_MS_PER_ITER / ms_per_iter, 2),
                 "extra": extra,
+                # Registry snapshot: the BENCH trajectory gains solver-
+                # iteration / residual columns without new bench code.
+                "metrics": REGISTRY.snapshot(),
             }
         )
     )

@@ -63,6 +63,7 @@ class Runtime:
     federation: Optional[object] = None
     telemetry: Optional[object] = None  # TelemetryModule
     mesh: Optional[object] = None  # MeshFleetModule in --mesh-devices mode
+    metrics_server: Optional[object] = None  # MetricsServer (--metrics-port)
 
     def start(self) -> "Runtime":
         if self.endpoint is not None:
@@ -76,6 +77,8 @@ class Runtime:
             f.stop()
         if self.endpoint is not None:
             self.endpoint.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
 
 
 def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
@@ -111,6 +114,11 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                     help="resume from the checkpoint file if it exists")
     ap.add_argument("--profile-dir", default=None, metavar="DIR",
                     help="capture a JAX profiler trace of the run into DIR")
+    ap.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                    help="serve Prometheus /metrics and /events on PORT "
+                         "(0 = ephemeral; unset = disabled)")
+    ap.add_argument("--events-log", default=None, metavar="PATH",
+                    help="append the structured event journal to PATH (JSONL)")
     ap.add_argument("--migration-step", type=float, default=None,
                     help="size of LB power migrations")
     ap.add_argument("--malicious-behavior", action="store_true", default=None,
@@ -145,6 +153,7 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("mesh_devices", "mesh_devices"), ("mesh_scenarios", "mesh_scenarios"),
         ("checkpoint", "checkpoint"), ("checkpoint_every", "checkpoint_every"),
         ("resume", "resume"),
+        ("metrics_port", "metrics_port"), ("events_log", "events_log"),
         ("migration_step", "migration_step"),
         ("malicious_behavior", "malicious_behavior"),
         ("check_invariant", "check_invariant"), ("verbose", "verbose"),
@@ -169,6 +178,22 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         dgilog.configure_from_file(cfg.logger_config)
     else:
         dgilog.set_global_level(cfg.verbose)
+
+    from freedm_tpu.core import metrics as obs
+
+    if cfg.events_log:
+        # Attach the journal file FIRST so construction-time events
+        # (checkpoint restore, federation bring-up) are captured too.
+        obs.EVENTS.open(cfg.events_log)
+
+    # Config sanity BEFORE any resource is bound: --mesh-devices and
+    # --federate are different deployment shapes, and rejecting them
+    # after endpoint construction leaked a bound UDP socket (ADVICE r5).
+    if cfg.federate and cfg.mesh_devices > 0:
+        raise ValueError(
+            "--mesh-devices and --federate are different deployment "
+            "shapes (one sharded process vs DCN slices); pick one"
+        )
 
     layout = (
         compile_layout(parse_device_xml(cfg.device_config))
@@ -299,11 +324,8 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
     if cfg.mesh_devices > 0:
         # Multi-chip dispatch: the whole round is ONE sharded superstep
         # (runtime/meshfleet.py); GM/SC/LB/VVC phases are inside it.
-        if cfg.federate:
-            raise ValueError(
-                "--mesh-devices and --federate are different deployment "
-                "shapes (one sharded process vs DCN slices); pick one"
-            )
+        # (The --federate exclusion was checked up top, before any
+        # socket was bound.)
         from freedm_tpu.runtime.meshfleet import MeshFleetModule
 
         # vvc_feeder may be None: no vvc-case = no VVC leg, same
@@ -361,9 +383,16 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             logger.status(
                 f"resumed from {cfg.checkpoint} at round {broker.round_index}"
             )
+    metrics_server = None
+    if cfg.metrics_port is not None:
+        metrics_server = obs.MetricsServer(port=cfg.metrics_port).start()
+        logger.status(
+            f"metrics: http://127.0.0.1:{metrics_server.port}/metrics "
+            f"(events: /events)"
+        )
     return Runtime(
         cfg, timings, broker, fleet, factories, vvc, endpoint, federation,
-        telemetry, mesh_mod,
+        telemetry, mesh_mod, metrics_server,
     )
 
 

@@ -243,6 +243,12 @@ class GlobalConfig:
     # controls; unset = no VVC phase.  The reference compiles its feeder
     # into vvc_main (load_system_data.cpp); ours is a config knob.
     vvc_case: Optional[str] = None
+    # Observability (freedm_tpu.core.metrics): TCP port for the
+    # Prometheus/events exposition endpoint (0 = ephemeral, None =
+    # disabled) and the JSONL event-journal path (None = in-memory ring
+    # only).
+    metrics_port: Optional[int] = None
+    events_log: Optional[str] = None
 
     @property
     def uuid(self) -> str:

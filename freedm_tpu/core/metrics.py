@@ -1,0 +1,577 @@
+"""Fleet-wide observability: metrics registry, event journal, exposition.
+
+The reference DGI's only observability is verbosity-8 ``Logger.Trace``
+call-entry spam plus offline timing spreadsheets (SURVEY §5).  The port
+so far had a single per-round :class:`~freedm_tpu.runtime.telemetry.Telemetry`
+ring — blind to the transport, the solvers, and discrete fleet events.
+This module is the unified layer the rest of the framework instruments
+against:
+
+- :class:`MetricsRegistry` — process-wide counters, gauges, and
+  fixed-bucket histograms.  Everything is host-side numpy/float state
+  behind one lock: recording never touches a device array, so the hot
+  paths (DCN pump thread, broker loop) pay nanoseconds, not syncs.
+- :class:`JsonlEventJournal` — discrete fleet events (elections, group
+  merges/splits, load migrations, checkpoint save/restore, peer
+  reconnects) as one JSON object per line, kept in a bounded in-memory
+  ring and optionally appended to a size-rotated file
+  (``--events-log``).
+- :class:`MetricsServer` — a zero-dependency ``http.server`` endpoint
+  (``--metrics-port``; 0 = ephemeral) serving Prometheus text format at
+  ``/metrics`` and the journal tail at ``/events``.
+
+The bottom of the module is the **metric catalogue**: every fleet-wide
+metric is registered once here, as a module constant, so the instrumented
+layers share one name table and a scrape always exposes the full
+vocabulary (zero-valued until something happens).  The per-round roll-up
+values (groups, migrations, VVC loss, federation members) are pushed by
+:class:`~freedm_tpu.runtime.telemetry.TelemetryModule` from the same
+record it writes into its ring — the ring and the registry cannot
+disagree.  See ``docs/observability.md`` for the full catalogue and the
+event schema.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample formatting: integers without the trailing .0."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _label_str(names: Tuple[str, ...], values: Tuple[str, ...], extra: str = "") -> str:
+    parts = [f'{n}="{v}"' for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Child:
+    """One labelled series of a metric; shares the parent's lock."""
+
+    __slots__ = ("_lock",)
+
+    def __init__(self, lock: threading.RLock):
+        self._lock = lock
+
+
+class _CounterChild(_Child):
+    __slots__ = ("_value",)
+
+    def __init__(self, lock):
+        super().__init__(lock)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _GaugeChild(_Child):
+    __slots__ = ("_value",)
+
+    def __init__(self, lock):
+        super().__init__(lock)
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _HistogramChild(_Child):
+    __slots__ = ("_bounds", "_counts", "_sum")
+
+    def __init__(self, lock, bounds: np.ndarray):
+        super().__init__(lock)
+        self._bounds = bounds
+        # One slot per finite bucket + the +Inf overflow slot.
+        self._counts = np.zeros(len(bounds) + 1, np.int64)
+        self._sum = 0.0
+
+    def observe(self, value) -> None:
+        """Record one value or an array of values (no device syncs: the
+        caller hands host data)."""
+        vals = np.atleast_1d(np.asarray(value, np.float64))
+        idx = np.searchsorted(self._bounds, vals, side="left")
+        with self._lock:
+            np.add.at(self._counts, idx, 1)
+            self._sum += float(vals.sum())
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return int(self._counts.sum())
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def buckets(self) -> Dict[str, int]:
+        """Cumulative counts keyed by upper bound (Prometheus `le`)."""
+        with self._lock:
+            cum = np.cumsum(self._counts)
+        out = {_fmt(b): int(c) for b, c in zip(self._bounds, cum[:-1])}
+        out["+Inf"] = int(cum[-1])
+        return out
+
+
+class _Metric:
+    """Base: a named family of children keyed by label values."""
+
+    kind = ""
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._lock = threading.RLock()
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+        if not self.label_names:
+            self._children[()] = self._new_child()
+
+    def _new_child(self) -> _Child:
+        raise NotImplementedError
+
+    def labels(self, *values) -> _Child:
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, got {values!r}"
+            )
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._new_child()
+            return child
+
+    def children(self) -> List[Tuple[Tuple[str, ...], _Child]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    # Unlabelled convenience pass-throughs.
+    @property
+    def value(self) -> float:
+        return self.labels().value  # type: ignore[attr-defined]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild(self._lock)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)  # type: ignore[attr-defined]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild(self._lock)
+
+    def set(self, value: float) -> None:
+        self.labels().set(value)  # type: ignore[attr-defined]
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)  # type: ignore[attr-defined]
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.labels().dec(amount)  # type: ignore[attr-defined]
+
+
+#: Default histogram buckets: wall-time-ish spread, seconds.
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help, buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 label_names: Sequence[str] = ()):
+        bounds = np.asarray(sorted(float(b) for b in buckets), np.float64)
+        if bounds.size == 0:
+            raise ValueError(f"{name}: histograms need at least one bucket")
+        self._bounds = bounds
+        super().__init__(name, help, label_names)
+
+    def _new_child(self):
+        return _HistogramChild(self._lock, self._bounds)
+
+    def observe(self, value) -> None:
+        self.labels().observe(value)  # type: ignore[attr-defined]
+
+    @property
+    def count(self) -> int:
+        return self.labels().count  # type: ignore[attr-defined]
+
+    @property
+    def sum(self) -> float:
+        return self.labels().sum  # type: ignore[attr-defined]
+
+
+class MetricsRegistry:
+    """Process-wide metric table.
+
+    Registration is idempotent: asking for an existing name returns the
+    existing metric (so module reloads and repeated constructions share
+    series), but a kind or label mismatch is a hard error — two meanings
+    for one name is a bug, not a merge.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, cls, name: str, help: str, labels: Sequence[str],
+                  **kwargs) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls or m.label_names != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind} "
+                        f"with labels {m.label_names}"
+                    )
+                buckets = kwargs.get("buckets")
+                if buckets is not None and not np.array_equal(
+                    m._bounds, np.asarray(sorted(float(b) for b in buckets))
+                ):
+                    raise ValueError(
+                        f"histogram {name!r} already registered with buckets "
+                        f"{tuple(m._bounds)}"
+                    )
+                return m
+            m = self._metrics[name] = cls(name, help, label_names=labels, **kwargs)
+            return m
+
+    def counter(self, name: str, help: str = "", labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  labels: Sequence[str] = ()) -> Histogram:
+        return self._register(Histogram, name, help, labels, buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def _items(self) -> List[_Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def render_prometheus(self) -> str:
+        """The text exposition format (version 0.0.4)."""
+        lines: List[str] = []
+        for m in self._items():
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key, child in m.children():
+                if isinstance(child, _HistogramChild):
+                    for le, c in child.buckets().items():
+                        ls = _label_str(m.label_names, key, f'le="{le}"')
+                        lines.append(f"{m.name}_bucket{ls} {c}")
+                    ls = _label_str(m.label_names, key)
+                    lines.append(f"{m.name}_sum{ls} {_fmt(child.sum)}")
+                    lines.append(f"{m.name}_count{ls} {child.count}")
+                else:
+                    ls = _label_str(m.label_names, key)
+                    lines.append(f"{m.name}{ls} {_fmt(child.value)}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-serializable dump for bench/soak artifacts."""
+        out: Dict[str, dict] = {}
+        for m in self._items():
+            entry: Dict[str, object] = {"type": m.kind}
+            values: Dict[str, object] = {}
+            for key, child in m.children():
+                k = ",".join(key)
+                if isinstance(child, _HistogramChild):
+                    values[k] = {
+                        "count": child.count,
+                        "sum": child.sum,
+                        "buckets": child.buckets(),
+                    }
+                else:
+                    values[k] = child.value
+            entry["values"] = values
+            out[m.name] = entry
+        return out
+
+
+class JsonlEventJournal:
+    """Structured discrete-event journal: one JSON object per event.
+
+    Events always land in a bounded in-memory ring (the ``/events``
+    tail); :meth:`open` additionally appends them to a JSONL file that
+    rotates once (``path`` → ``path.1``) when it exceeds ``max_bytes``
+    — an unattended soak cannot fill the disk.
+    """
+
+    def __init__(self, path: Optional[str] = None, capacity: int = 2048,
+                 max_bytes: int = 50_000_000):
+        self._lock = threading.RLock()
+        self._ring: deque = deque(maxlen=int(capacity))
+        self._fh = None
+        self._written = 0
+        self.path: Optional[str] = None
+        self.max_bytes = int(max_bytes)
+        if path:
+            self.open(path)
+
+    def open(self, path: str, max_bytes: Optional[int] = None) -> "JsonlEventJournal":
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+            if max_bytes is not None:
+                self.max_bytes = int(max_bytes)
+            self.path = str(path)
+            self._fh = open(self.path, "a", encoding="utf-8")
+            self._written = os.path.getsize(self.path)
+        return self
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def _rotate_locked(self) -> None:
+        self._fh.close()
+        os.replace(self.path, self.path + ".1")
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._written = 0
+
+    def emit(self, event: str, **fields) -> dict:
+        rec = {"ts": round(time.time(), 6), "event": event}
+        rec.update(fields)
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            self._ring.append(rec)
+            if self._fh is not None:
+                if self._written and self._written + len(line) + 1 > self.max_bytes:
+                    self._rotate_locked()
+                self._fh.write(line + "\n")
+                self._fh.flush()
+                self._written += len(line) + 1
+        return rec
+
+    def tail(self, n: int = 100) -> List[dict]:
+        with self._lock:
+            items = list(self._ring)
+        return items[-max(int(n), 0):]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+class MetricsServer:
+    """Zero-dependency exposition endpoint (``--metrics-port``).
+
+    ``GET /metrics`` — Prometheus text format of the registry;
+    ``GET /events?n=K`` — the journal's newest K events as JSONL;
+    anything else — a one-line index.  Runs ``http.server`` on a daemon
+    thread; ``port=0`` binds an ephemeral port (read it back from
+    ``.port``).
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 journal: Optional["JsonlEventJournal"] = None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        # Loopback by default: /events exposes peer uuids, federation
+        # topology, and checkpoint paths unauthenticated — widening the
+        # bind to an external interface is an explicit caller decision.
+        reg = registry if registry is not None else REGISTRY
+        jnl = journal if journal is not None else EVENTS
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # scrapes must not spam stderr
+                pass
+
+            def _reply(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                if url.path == "/metrics":
+                    self._reply(200, reg.render_prometheus(),
+                                "text/plain; version=0.0.4; charset=utf-8")
+                elif url.path == "/events":
+                    q = parse_qs(url.query)
+                    n = int(q.get("n", ["100"])[0])
+                    body = "\n".join(
+                        json.dumps(e, default=str) for e in jnl.tail(n)
+                    )
+                    self._reply(200, body + ("\n" if body else ""),
+                                "application/x-ndjson")
+                elif url.path == "/":
+                    self._reply(200, "freedm_tpu metrics: /metrics /events\n",
+                                "text/plain; charset=utf-8")
+                else:
+                    self._reply(404, "not found\n", "text/plain; charset=utf-8")
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide instances + the metric catalogue
+# ---------------------------------------------------------------------------
+
+#: The process-wide registry every layer instruments against.
+REGISTRY = MetricsRegistry()
+
+#: The process-wide event journal (memory-only until ``--events-log``
+#: attaches a file via :meth:`JsonlEventJournal.open`).
+EVENTS = JsonlEventJournal()
+
+# -- DCN transport (freedm_tpu.dcn.protocol / endpoint) ---------------------
+DCN_SENDS = REGISTRY.counter(
+    "dcn_sends_total", "Messages queued on SR channels")
+DCN_RETRANSMITS = REGISTRY.counter(
+    "dcn_retransmits_total",
+    "MESSAGE frames re-emitted after their first transmission")
+DCN_ACKS = REGISTRY.counter(
+    "dcn_acks_total", "SR window heads retired by a matching ACK")
+DCN_EXPIRED = REGISTRY.counter(
+    "dcn_expired_total", "SR messages dropped at their TTL (kill-number path)")
+DCN_OOW_DROPS = REGISTRY.counter(
+    "dcn_out_of_window_drops_total",
+    "Received MESSAGE frames rejected by the accept logic "
+    "(duplicates, out-of-order, out-of-window)")
+DCN_RECONNECTS = REGISTRY.counter(
+    "dcn_reconnects_total", "Stale-connection resyncs (SYN after MAX_DROPPED_MSGS)")
+DCN_OUTSTANDING = REGISTRY.gauge(
+    "dcn_outstanding_window", "Un-ACKed frames currently queued, per peer",
+    labels=("peer",))
+DCN_ACK_RTT = REGISTRY.histogram(
+    "dcn_ack_rtt_seconds", "First transmission to head-of-window ACK",
+    buckets=(0.001, 0.005, 0.02, 0.06, 0.12, 0.25, 0.5, 1.0, 2.0, 4.1))
+DCN_DATAGRAMS_IN = REGISTRY.counter(
+    "dcn_datagrams_in_total", "UDP datagrams received by the endpoint")
+DCN_DATAGRAMS_OUT = REGISTRY.counter(
+    "dcn_datagrams_out_total", "UDP datagrams put on the wire by the endpoint")
+DCN_BYTES_IN = REGISTRY.counter(
+    "dcn_bytes_in_total", "UDP payload bytes received by the endpoint")
+DCN_BYTES_OUT = REGISTRY.counter(
+    "dcn_bytes_out_total", "UDP payload bytes put on the wire by the endpoint")
+
+# -- power-flow solvers (freedm_tpu.pf) -------------------------------------
+PF_ITERATIONS = REGISTRY.histogram(
+    "pf_newton_iterations",
+    "Outer iterations per solve, from already-materialized result tuples",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 40), labels=("solver",))
+PF_RESIDUAL = REGISTRY.gauge(
+    "pf_residual_pu", "Final masked power mismatch of the last recorded solve",
+    labels=("solver",))
+for _solver in ("newton", "fdlf", "krylov"):
+    PF_ITERATIONS.labels(_solver)
+    PF_RESIDUAL.labels(_solver)
+
+# -- broker / runtime -------------------------------------------------------
+BROKER_ROUNDS = REGISTRY.counter(
+    "broker_rounds_total", "Completed scheduler rounds")
+BROKER_PHASE_OVERRUNS = REGISTRY.counter(
+    "broker_phase_overruns_total",
+    "Phases whose body exceeded their timings.cfg budget", labels=("phase",))
+ROUND_WALL = REGISTRY.histogram(
+    "broker_round_seconds", "Full-round wall time (telemetry ring roll-up)",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.3, 0.52, 1.0, 3.0, 10.0, 30.0))
+FLEET_GROUPS = REGISTRY.gauge(
+    "fleet_groups", "Groups formed over the local fleet (last round)")
+FLEET_ELECTIONS = REGISTRY.counter(
+    "fleet_elections_total", "Local group re-formations with a coordinator change")
+LB_MIGRATIONS = REGISTRY.counter(
+    "lb_migrations_total", "Accepted LB migration steps (telemetry ring roll-up)")
+LB_INTRANSIT = REGISTRY.gauge(
+    "lb_intransit_power", "In-flight migrated power at the last round boundary")
+VVC_LOSS = REGISTRY.gauge(
+    "vvc_loss_kw", "Feeder loss after the last VVC step")
+FED_MEMBERS = REGISTRY.gauge(
+    "federation_members", "Member processes in this slice's federation group")
+FED_ELECTIONS = REGISTRY.counter(
+    "federation_elections_total", "Process-level invitation elections started")
+FED_MIGRATIONS = REGISTRY.counter(
+    "federation_migrations_total", "Accepted cross-slice draft migrations")
+FED_PEER_DOWN = REGISTRY.counter(
+    "federation_peer_down_total", "Members evicted for silence (liveness loss)")
+CKPT_SAVES = REGISTRY.counter(
+    "checkpoint_saves_total", "Round-boundary checkpoints written")
+CKPT_RESTORES = REGISTRY.counter(
+    "checkpoint_restores_total", "Checkpoints restored into a fresh stack")
+
+
+def observe_pf_result(solver: str, result) -> None:
+    """Record a solver result's iteration count and final residual.
+
+    ``result`` is a Newton/Krylov-style result tuple whose
+    ``iterations``/``mismatch`` fields the CALLER is already pulling to
+    host (a convergence assert, a bench report, a summary) — this
+    function adds no device round-trips of its own, it just reuses the
+    materialization that is happening anyway.  Batched results record
+    every lane's iteration count and the worst lane's residual.
+    """
+    its = np.ravel(np.asarray(result.iterations))
+    PF_ITERATIONS.labels(solver).observe(its)
+    PF_RESIDUAL.labels(solver).set(float(np.max(np.asarray(result.mismatch))))

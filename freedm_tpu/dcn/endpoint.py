@@ -35,6 +35,7 @@ from typing import Callable, Dict, Optional, Tuple, Union
 
 import numpy as np
 
+from freedm_tpu.core import metrics
 from freedm_tpu.dcn import wire
 from freedm_tpu.dcn.protocol import SrChannel
 from freedm_tpu.runtime.messages import ModuleMessage
@@ -72,6 +73,10 @@ class UdpEndpoint:
         self._peers: Dict[str, _PeerState] = {}
         self._lock = threading.RLock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        # SO_REUSEADDR: a restarted process (soak rig kill/rejoin) can
+        # re-bind its well-known port while a reservation socket is
+        # still closing — without it the restart loses the port race.
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(bind)
         self._sock.settimeout(resend_time_s / 2)
         self._stop = threading.Event()
@@ -159,6 +164,8 @@ class UdpEndpoint:
                 logging.getLogger(__name__).exception("dcn flush error")
 
     def _on_datagram(self, data: bytes, addr: Tuple[str, int]) -> None:
+        metrics.DCN_DATAGRAMS_IN.inc()
+        metrics.DCN_BYTES_IN.inc(len(data))
         if self.incoming_reliability < 100 and (
             self._rng.integers(100) >= self.incoming_reliability
         ):
@@ -194,6 +201,8 @@ class UdpEndpoint:
                 continue  # IProtocol.cpp:94-101 outgoing drop
             try:
                 self._sock.sendto(datagram, st.addr)
+                metrics.DCN_DATAGRAMS_OUT.inc()
+                metrics.DCN_BYTES_OUT.inc(len(datagram))
             except OSError:
                 pass  # unreachable peers retry on the resend clock
 

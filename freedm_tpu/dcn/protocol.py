@@ -32,8 +32,9 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import replace
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional
 
+from freedm_tpu.core import metrics
 from freedm_tpu.dcn import wire
 from freedm_tpu.dcn.wire import ACCEPTED, BAD_REQUEST, CREATED, MESSAGE, Frame
 from freedm_tpu.runtime.messages import ModuleMessage
@@ -90,6 +91,11 @@ class SrChannel:
         self.sent = 0
         self.accepted = 0
         self.expired = 0
+        # Observability (core.metrics catalogue): first-transmission
+        # stamps per live seq (ack RTT + retransmit detection) and the
+        # per-peer outstanding-window gauge, bound once.
+        self._sent_at: Dict[int, float] = {}
+        self._g_outstanding = metrics.DCN_OUTSTANDING.labels(uuid)
 
     # -- sender side ---------------------------------------------------------
     def send(self, msg: ModuleMessage, now: float) -> None:
@@ -116,6 +122,8 @@ class SrChannel:
         frame = replace(probe, seq=self._take_seq())
         self._out_window.append(frame)
         self.sent += 1
+        metrics.DCN_SENDS.inc()
+        self._g_outstanding.set(len(self._out_window))
         self._next_resend = now  # fire immediately on next poll
 
     def _take_seq(self) -> int:
@@ -154,10 +162,12 @@ class SrChannel:
                 and self._out_window[0].status != CREATED
                 and self._out_window[0].expired(now)
             ):
-                self._out_window.popleft()
+                dead = self._out_window.popleft()
+                self._sent_at.pop(dead.seq, None)
                 self._send_kills = True
                 self._dropped += 1
                 self.expired += 1
+                metrics.DCN_EXPIRED.inc()
         if self._dropped > MAX_DROPPED_MSGS or todrop > MAX_DROPPED_MSGS:
             # Stale connection: reconnect with a fresh sync instead of
             # the reference's Stop()-and-recreate.
@@ -172,6 +182,17 @@ class SrChannel:
             self._out_window[0].kill = self._send_kill if self._send_kills else None
         if now >= self._next_resend:
             self._next_resend = now + self.resend_time_s
+        # Retransmit accounting: a MESSAGE frame hitting the wire after
+        # its first transmission is a retransmission, whether the resend
+        # timer fired or an ACK flush re-emitted the window.
+        for f in self._out_window:
+            if f.status != MESSAGE:
+                continue
+            if f.seq in self._sent_at:
+                metrics.DCN_RETRANSMITS.inc()
+            else:
+                self._sent_at[f.seq] = now
+        self._g_outstanding.set(len(self._out_window))
         out = list(self._out_window) + self._ack_window + self._reply_frames
         self._ack_window = []
         self._reply_frames = []
@@ -184,11 +205,15 @@ class SrChannel:
         frames, and SYN again."""
         self._dropped = 0
         self.reconnects += 1
+        metrics.DCN_RECONNECTS.inc()
+        metrics.EVENTS.emit("dcn.reconnect", peer=self.uuid, total=self.reconnects)
         if self._out_window and self._out_window[0].status == CREATED:
             self._out_window.popleft()
         while self._out_window and self._out_window[0].expired(now):
-            self._out_window.popleft()
+            dead = self._out_window.popleft()
+            self._sent_at.pop(dead.seq, None)
             self.expired += 1
+            metrics.DCN_EXPIRED.inc()
         self._out_synced = False
         if self._out_window:
             self._push_syn(now)
@@ -200,13 +225,13 @@ class SrChannel:
         out: List[ModuleMessage] = []
         for f in frames:
             if f.status == ACCEPTED:
-                self._receive_ack(f)
+                self._receive_ack(f, now)
             elif self._receive(f, now) and f.msg is not None:
                 out.append(wire.unpack_message(f.msg))
                 self.accepted += 1
         return out
 
-    def _receive_ack(self, f: Frame) -> None:
+    def _receive_ack(self, f: Frame, now: float) -> None:
         """CProtocolSR::ReceiveACK — pop the window head on seq+hash match."""
         if not self._out_window:
             return
@@ -216,6 +241,11 @@ class SrChannel:
             self._out_window.popleft()
             self._send_kills = False
             self._dropped = 0
+            metrics.DCN_ACKS.inc()
+            sent_at = self._sent_at.pop(head.seq, None)
+            if sent_at is not None and head.status == MESSAGE:
+                metrics.DCN_ACK_RTT.observe(max(now - sent_at, 0.0))
+            self._g_outstanding.set(len(self._out_window))
 
     def _receive(self, f: Frame, now: float) -> bool:
         """CProtocolSR::Receive — the 8-case accept logic."""
@@ -273,7 +303,9 @@ class SrChannel:
                 # lost ACK doesn't wedge the sender's window head.
                 if f.seq < self._in_seq:
                     self._queue_ack(f)
+                metrics.DCN_OOW_DROPS.inc()
                 return False
+            metrics.DCN_OOW_DROPS.inc()
             return False
         return False
 

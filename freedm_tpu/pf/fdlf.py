@@ -35,7 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from freedm_tpu.grid.bus import PQ, SLACK, BusSystem, ybus_dense
-from freedm_tpu.pf.newton import build_result, s_calc
+from freedm_tpu.pf.newton import NewtonResult, build_result, s_calc
+from freedm_tpu.pf.newton import record_result as _record_newton
 from freedm_tpu.utils import cplx
 
 
@@ -87,6 +88,14 @@ def decoupled_parts(sys: BusSystem, rdtype) -> DecoupledParts:
         return m + jnp.diag(1.0 - keep)
 
     return DecoupledParts(th_free, v_free, b_prime, b_dblprime)
+
+
+def record_result(result: NewtonResult) -> None:
+    """Publish an FDLF result to the solver metrics (``core.metrics``)
+    under ``solver="fdlf"`` — same contract as
+    :func:`freedm_tpu.pf.newton.record_result`: call only where the
+    result is already host-side."""
+    _record_newton(result, solver="fdlf")
 
 
 def make_fdlf_solver(

@@ -359,15 +359,26 @@ def make_krylov_solver(
     return solve, solve_fixed
 
 
-def true_mismatch(sys: BusSystem, result: KrylovResult) -> float:
+def record_result(result: KrylovResult) -> None:
+    """Publish a matrix-free result to the solver metrics
+    (``core.metrics``) under ``solver="krylov"`` — same contract as
+    :func:`freedm_tpu.pf.newton.record_result`: call only where the
+    result is already host-side."""
+    from freedm_tpu.core import metrics
+
+    metrics.observe_pf_result("krylov", result)
+
+
+def true_mismatch(sys: BusSystem, result: KrylovResult, status=None) -> float:
     """Host float64 oracle: the max masked power-flow residual of a
     solution, evaluated branch-wise in numpy double precision.
 
     Independent of every on-device dtype decision (admittances included
     — ``branch_admittances`` would silently truncate to f32 on a
     non-x64 backend), so it reports the REAL accuracy of a float32
-    solve.  Cost: O(n + m) on host.  Base-case topology only (no
-    ``status`` masking).
+    solve.  Cost: O(n + m) on host.  ``status`` applies the same
+    per-branch in-service mask the solvers trace (ADVICE r5: N-1 outage
+    lanes are oracle-checkable, not just the base case).
     """
     import numpy as np
 
@@ -375,9 +386,14 @@ def true_mismatch(sys: BusSystem, result: KrylovResult) -> float:
     theta = np.asarray(result.theta, np.float64)
     v = np.asarray(result.v, np.float64)
     # The MATPOWER branch model, in numpy double (mirrors
-    # grid.bus.branch_admittances).
+    # grid.bus.branch_admittances, status masking included: an
+    # out-of-service branch contributes no series OR charging terms).
     ys = 1.0 / (sys.r.astype(np.float64) + 1j * sys.x.astype(np.float64))
     bc2 = 1j * sys.b_chg.astype(np.float64) / 2.0
+    if status is not None:
+        on = np.asarray(status, np.float64)
+        ys = ys * on
+        bc2 = bc2 * on
     tap_shift = sys.tap.astype(np.float64) * np.exp(
         1j * sys.shift.astype(np.float64)
     )

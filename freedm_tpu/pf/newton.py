@@ -229,6 +229,22 @@ def make_newton_solver(
     return solve, solve_fixed
 
 
+def record_result(result: NewtonResult, solver: str = "newton") -> None:
+    """Publish an already-materialized result's iteration count and
+    final mismatch to the fleet-wide registry
+    (``pf_newton_iterations``/``pf_residual_pu``, ``core.metrics``).
+
+    Call it where the result is being pulled to host ANYWAY (a
+    convergence assert, a bench report, an operator summary): the
+    recording itself is numpy-only and adds no device round-trips.
+    Batched results record every lane's iteration count and the worst
+    lane's residual.
+    """
+    from freedm_tpu.core import metrics
+
+    metrics.observe_pf_result(solver, result)
+
+
 def branch_flows(sys: BusSystem, result: NewtonResult, status=None, dtype=None) -> tuple[C, C]:
     """Complex power flows ``(S_from, S_to)`` per branch, pu.
 

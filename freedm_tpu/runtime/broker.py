@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from freedm_tpu.core import metrics
 from freedm_tpu.core.config import ALIGNMENT_DURATION_MS
 from freedm_tpu.runtime.dispatch import Dispatcher
 from freedm_tpu.runtime.messages import ModuleMessage
@@ -79,6 +80,10 @@ class Broker:
         ph = _Phase(module, phase_time_ms)
         self._phases.append(ph)
         self._by_name[module.name] = ph
+        if phase_time_ms > 0:
+            # Pre-create the overrun series so a scrape shows every
+            # budgeted phase at 0 rather than omitting quiet ones.
+            metrics.BROKER_PHASE_OVERRUNS.labels(module.name)
         # Default read handler: the module's own queue.
         self.dispatcher.register(
             module.name,
@@ -240,9 +245,14 @@ class Broker:
             ph.module.run_phase(ctx)
             # Per-phase duration for the telemetry arrays (SURVEY §5) —
             # monotonic, so an NTP step cannot corrupt the record.
-            self.shared[f"_phase_ms_{ph.module.name}"] = (
-                time.monotonic() - phase_mono
-            ) * 1e3
+            phase_ms = (time.monotonic() - phase_mono) * 1e3
+            self.shared[f"_phase_ms_{ph.module.name}"] = phase_ms
+            if ph.time_ms > 0 and phase_ms > ph.time_ms:
+                # Budget exceeded.  Under realtime this is the skew the
+                # aligner has to absorb; free-running it still marks a
+                # phase slower than its configured slice (JIT warmup,
+                # regression) — either way operators want the count.
+                metrics.BROKER_PHASE_OVERRUNS.labels(ph.module.name).inc()
             if realtime:
                 budget_sum += ph.time_ms / 1000.0
                 target = aligned_start + budget_sum
@@ -250,6 +260,7 @@ class Broker:
                 if now_v < target:
                     time.sleep(target - now_v)
         self.round_index += 1
+        metrics.BROKER_ROUNDS.inc()
 
     def _apply_skew(self, offset_s: float) -> None:
         """SetClockSkew: the synchronizer's measured offset feeds phase

@@ -30,6 +30,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from freedm_tpu.core import metrics
 from freedm_tpu.runtime.module import DgiModule, PhaseContext
 
 FORMAT_VERSION = 1
@@ -120,10 +121,21 @@ def restore_state(state: Dict, broker, fleet) -> None:
     as well as on fake rigs.
     """
     if state.get("version") != FORMAT_VERSION:
+        metrics.EVENTS.emit(
+            "checkpoint.restore_rejected",
+            reason="version",
+            version=state.get("version"),
+        )
         raise ValueError(f"unknown checkpoint version {state.get('version')!r}")
     saved_nodes = state.get("nodes", [])
     uuids = [n.uuid for n in fleet.nodes]
     if saved_nodes != uuids:
+        metrics.EVENTS.emit(
+            "checkpoint.restore_rejected",
+            reason="node_mismatch",
+            saved=saved_nodes,
+            fleet=uuids,
+        )
         raise ValueError(
             f"checkpoint is for nodes {saved_nodes}, this fleet is {uuids}"
         )
@@ -167,9 +179,34 @@ def restore_state(state: Dict, broker, fleet) -> None:
     if mesh_s and "mesh" in broker._by_name:
         m = broker._by_name["mesh"].module
         if mesh_s.get("q_ctrl") is not None:
-            m._restore_q_ctrl = np.asarray(mesh_s["q_ctrl"])
+            q_ctrl = np.asarray(mesh_s["q_ctrl"])
+            # Validate against the module's scenario-tensor contract NOW
+            # (ADVICE r5): a resume with a different --mesh-scenarios or
+            # feeder would otherwise surface as an opaque mid-round
+            # sharding error on the first superstep.
+            expected = getattr(m, "q_ctrl_shape", None)
+            if expected is not None and tuple(q_ctrl.shape) != tuple(expected):
+                metrics.EVENTS.emit(
+                    "checkpoint.restore_rejected",
+                    reason="q_ctrl_shape",
+                    saved=list(q_ctrl.shape),
+                    expected=list(expected),
+                )
+                raise ValueError(
+                    f"checkpoint mesh q_ctrl has shape {tuple(q_ctrl.shape)}, "
+                    f"but this mesh module expects (n_scenarios, n_branches, 3) "
+                    f"= {tuple(expected)}; resume with the matching "
+                    f"--mesh-scenarios/feeder or drop the checkpoint"
+                )
+            m._restore_q_ctrl = q_ctrl
         m._prev_loss = mesh_s.get("prev_loss")
         m.rounds = mesh_s.get("rounds", 0)
+    metrics.CKPT_RESTORES.inc()
+    metrics.EVENTS.emit(
+        "checkpoint.restore",
+        round=broker.round_index,
+        nodes=len(uuids),
+    )
     gateway = state.get("gateway")
     if gateway is not None:
         # Staged, not written: restore runs before adapters start, and
@@ -218,3 +255,7 @@ class CheckpointModule(DgiModule):
         state["round_index"] = ctx.round_index + 1
         save(self.path, state)
         self.saves += 1
+        metrics.CKPT_SAVES.inc()
+        metrics.EVENTS.emit(
+            "checkpoint.save", path=self.path, round=ctx.round_index + 1
+        )

@@ -52,6 +52,7 @@ from typing import TYPE_CHECKING, Dict, NamedTuple, Optional, Set, Tuple
 
 import numpy as np
 
+from freedm_tpu.core import metrics
 from freedm_tpu.core.config import Timings
 from freedm_tpu.runtime.messages import ModuleMessage
 
@@ -290,6 +291,7 @@ class Federation:
             if u in self.members:
                 self.members.discard(u)
                 changed = True
+                self._peer_down(u, "ayc_silent")
         # Members that stopped AYT-ing are dead (the reference notices
         # via the AYT group_id mismatch after its next election).
         for u in list(self.members - {self.uuid}):
@@ -298,6 +300,7 @@ class Federation:
                 self.members.discard(u)
                 self._member_seen.pop(u, None)
                 changed = True
+                self._peer_down(u, "ayt_silent")
         if changed:
             self.counters["groups_broken"] += 1
             self._push_peer_list()
@@ -316,6 +319,18 @@ class Federation:
                 self._send(u, "gm", "ayc", seq=self._round)
                 self._pending_ayc[u] = self._now()
 
+    def _peer_down(self, uuid: str, reason: str) -> None:
+        """A member went silent — the liveness transition operators page
+        on (journal) and trend (counter)."""
+        metrics.FED_PEER_DOWN.inc()
+        metrics.EVENTS.emit(
+            "federation.peer_down",
+            peer=uuid,
+            reason=reason,
+            leader=self.leader,
+            members=len(self.members),
+        )
+
     def _merge(self) -> None:
         """Invite every seen coordinator and my old members into a new
         group (Merge + InviteGroupNodes, GroupManagement.cpp:710-813)."""
@@ -323,6 +338,10 @@ class Federation:
         self.counters["elections"] += 1
         self._group_seq += 1
         self.group_id = f"{self.uuid}#{self._group_seq}"
+        metrics.FED_ELECTIONS.inc()
+        metrics.EVENTS.emit(
+            "federation.election", leader=self.uuid, group_id=self.group_id
+        )
         targets = (self.coordinators | self.members) - {self.uuid}
         self.coordinators.clear()
         # Probes outstanding against the OLD group are void: a stale
@@ -352,6 +371,12 @@ class Federation:
             self._member_seen[u] = now
         self.state = NORMAL
         self.counters["groups_formed"] += 1
+        metrics.EVENTS.emit(
+            "federation.group_formed",
+            leader=self.uuid,
+            group_id=self.group_id,
+            members=sorted(self.members),
+        )
         self._push_peer_list()
 
     def _timeout(self) -> None:
@@ -370,6 +395,9 @@ class Federation:
         """Fall back to a singleton group led by self (Recovery,
         GroupManagement.cpp:437-466)."""
         self.counters["groups_broken"] += 1
+        metrics.EVENTS.emit(
+            "federation.recovery", uuid=self.uuid, old_leader=self.leader
+        )
         self._group_seq += 1
         self.group_id = f"{self.uuid}#{self._group_seq}"
         self.leader = self.uuid
@@ -451,6 +479,12 @@ class Federation:
                 self.members = set(p.get("members", [])) | {self.uuid}
                 if self.state == REORGANIZATION:
                     self.counters["groups_joined"] += 1
+                    metrics.EVENTS.emit(
+                        "federation.joined",
+                        leader=self.leader,
+                        group_id=self.group_id,
+                        members=sorted(self.members),
+                    )
                 self.state = NORMAL
                 self._ayt_ok = self._now()
                 self._ayt_strikes = 0
@@ -609,6 +643,7 @@ class Federation:
             ps = self._pending_select.pop(src, None)
             if ps is not None:
                 self.fed_migrations += 1
+                metrics.FED_MIGRATIONS.inc()
             else:
                 # Late accept: the select already timed out and rolled
                 # back, but the importer DID apply its -step (SR channels
@@ -620,6 +655,7 @@ class Federation:
                     node = self._pick_node(supply=True)
                     self._ensure_delta(n_local)[node] += amount
                     self.fed_migrations += 1
+                    metrics.FED_MIGRATIONS.inc()
         elif t == "too_late":
             ps = self._pending_select.pop(src, None)
             if ps is not None:

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from freedm_tpu.core import logging as dgilog
+from freedm_tpu.core import metrics
 from freedm_tpu.core.config import OMEGA_NOMINAL, GlobalConfig, Timings
 from freedm_tpu.devices import tensor as dt
 from freedm_tpu.devices.manager import DeviceManager
@@ -502,8 +503,25 @@ class GmModule(DgiModule):
         group = self._form(alive, reach, fleet.priority)
         if self.last is not None:
             c = gm.diff_counters(self.last, group)
-            self.counters["elections"] += int(c.elections)
-            self.counters["groups_broken"] += int(c.groups_broken)
+            elections = int(c.elections)
+            broken = int(c.groups_broken)
+            self.counters["elections"] += elections
+            self.counters["groups_broken"] += broken
+            if elections:
+                metrics.FLEET_ELECTIONS.inc(elections)
+                metrics.EVENTS.emit(
+                    "fleet.election",
+                    round=ctx.round_index,
+                    elections=elections,
+                    n_groups=int(group.n_groups),
+                )
+            if broken:
+                metrics.EVENTS.emit(
+                    "fleet.group_broken",
+                    round=ctx.round_index,
+                    groups_broken=broken,
+                    n_groups=int(group.n_groups),
+                )
         self.last = group
         ctx.shared["group"] = group
         if self.fed is not None:

@@ -72,6 +72,14 @@ class MeshFleetModule(DgiModule):
         # Scenario lanes: at least one per batch shard.
         self.n_scenarios = max(n_scenarios, batch_shards)
         self.n_scenarios += (-self.n_scenarios) % batch_shards
+        # The q_ctrl scenario tensor's shape contract, for checkpoint
+        # restore validation (a resume with different --mesh-scenarios
+        # or feeder must fail loudly, not as a mid-round sharding error).
+        self.q_ctrl_shape = (
+            (self.n_scenarios, feeder.n_branches, 3)
+            if feeder is not None
+            else None
+        )
         self.step, self.shard_state = make_superstep(
             mesh, feeder, migration_step=fleet.migration_step, vvc_config=vvc_config
         )

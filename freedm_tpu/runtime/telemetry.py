@@ -26,6 +26,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from freedm_tpu.core import metrics
 from freedm_tpu.runtime.module import DgiModule, PhaseContext
 
 #: Telemetry columns recorded every round.
@@ -142,6 +143,33 @@ class TelemetryModule(DgiModule):
             if dt is not None:
                 values[f"{name}_ms"] = dt
         self.telemetry.record(**values)
+        self._publish(values)
+
+    def _publish(self, values: Dict[str, float]) -> None:
+        """Fold the round's record into the fleet-wide registry
+        (``core.metrics``).  The registry roll-ups are derived from the
+        SAME values just written to the ring, so ``summary()`` and a
+        ``/metrics`` scrape can never disagree about a round."""
+        wall = values.get("wall_s")
+        if wall is not None and not np.isnan(wall):
+            metrics.ROUND_WALL.observe(wall)
+        if "n_groups" in values:
+            metrics.FLEET_GROUPS.set(values["n_groups"])
+        migs = values.get("migrations", 0)
+        if migs:
+            metrics.LB_MIGRATIONS.inc(migs)
+            metrics.EVENTS.emit(
+                "fleet.migration",
+                round=int(values["round"]),
+                migrations=int(migs),
+                intransit=values.get("intransit"),
+            )
+        if "intransit" in values:
+            metrics.LB_INTRANSIT.set(values["intransit"])
+        if "vvc_loss_kw" in values:
+            metrics.VVC_LOSS.set(values["vvc_loss_kw"])
+        if "fed_members" in values:
+            metrics.FED_MEMBERS.set(values["fed_members"])
 
 
 @contextlib.contextmanager

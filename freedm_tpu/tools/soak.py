@@ -47,14 +47,63 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 VVC_ROWS = (2, 3, 4, 5, 6, 7)
 
 
-def free_udp_ports(n: int) -> List[int]:
-    socks = [socket.socket(socket.AF_INET, socket.SOCK_DGRAM) for _ in range(n)]
+def _free_ports(n: int, sock_type: int) -> List[int]:
+    socks = [socket.socket(socket.AF_INET, sock_type) for _ in range(n)]
     for s in socks:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind(("127.0.0.1", 0))
     ports = [s.getsockname()[1] for s in socks]
     for s in socks:
         s.close()
     return ports
+
+
+def free_udp_ports(n: int) -> List[int]:
+    return _free_ports(n, socket.SOCK_DGRAM)
+
+
+def free_tcp_ports(n: int) -> List[int]:
+    return _free_ports(n, socket.SOCK_STREAM)
+
+
+#: Unlabelled counters lifted from each slice's /metrics scrape into the
+#: soak artifact — the transport/solver columns of the SOAK trajectory.
+SCRAPE_KEYS = (
+    "dcn_sends_total",
+    "dcn_retransmits_total",
+    "dcn_acks_total",
+    "dcn_expired_total",
+    "dcn_reconnects_total",
+    "dcn_datagrams_in_total",
+    "dcn_datagrams_out_total",
+    "broker_rounds_total",
+    "federation_migrations_total",
+)
+
+
+def scrape_slice_metrics(port: int, timeout_s: float = 3.0) -> Dict[str, float]:
+    """Pull the SCRAPE_KEYS counters from one slice's metrics endpoint;
+    an unreachable slice (killed, still compiling) returns {}."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=timeout_s
+        ) as r:
+            text = r.read().decode()
+    except Exception:
+        return {}
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        if name in SCRAPE_KEYS:
+            try:
+                out[name] = float(value)
+            except ValueError:
+                pass
+    return out
 
 
 _CACHE_DIR: Optional[str] = None
@@ -80,6 +129,7 @@ class SliceSpec:
     drain: float
     plant_port: Optional[int] = None
     cfg_path: Optional[Path] = None
+    metrics_port: Optional[int] = None  # the slice's /metrics TCP port
 
 
 class Check:
@@ -98,14 +148,45 @@ class Check:
 
 
 class Proc:
-    """One federated slice process with a summary-line reader."""
+    """One federated slice process with a summary-line reader.
+
+    Kill/restart without losing the slice's well-known UDP port (ADVICE
+    r5: the rejoin/re-merge checks were flaky because another process
+    could grab the port between ``kill()`` and the restart): ``kill()``
+    immediately re-binds the port on a SO_REUSEADDR reservation socket,
+    which closes the kill→restart window; ``start()`` releases it just
+    before spawning.  The remaining gap — child startup until its
+    endpoint binds — is covered by the spawn retry: a bind loser exits
+    immediately and is relaunched (with the reservation re-taken in
+    between).  The port must stay stable across a restart because every
+    OTHER slice's config names this slice as ``host:port``.
+    """
 
     def __init__(self, spec: SliceSpec):
         self.spec = spec
         self.lines: List[Dict] = []
         self.proc: Optional[subprocess.Popen] = None
+        self._holder: Optional[socket.socket] = None
+        self._started_once = False
 
-    def start(self) -> "Proc":
+    def _reserve_port(self) -> None:
+        if self._holder is not None:
+            return
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind(("127.0.0.1", self.spec.port))
+        except OSError:
+            s.close()  # transient holder; start() retries the spawn
+            return
+        self._holder = s
+
+    def _release_port(self) -> None:
+        if self._holder is not None:
+            self._holder.close()
+            self._holder = None
+
+    def _spawn(self) -> None:
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "freedm_tpu", "-c", str(self.spec.cfg_path),
              "--summary-every", "5", "--realtime"],
@@ -113,6 +194,30 @@ class Proc:
             env=_env(), text=True,
         )
         threading.Thread(target=self._pump, daemon=True).start()
+
+    def start(self) -> "Proc":
+        restart = self._started_once
+        self._started_once = True
+        attempts = 3 if restart else 1
+        for attempt in range(attempts):
+            self._release_port()
+            self._spawn()
+            if not restart:
+                return self
+            # A bind loser dies within seconds; a healthy slice keeps
+            # running (its first summary can take much longer under a
+            # cold JIT cache, so only an EXIT counts as failure).
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline:
+                if self.proc.poll() is not None:
+                    break
+                time.sleep(0.1)
+            if self.proc.poll() is None:
+                return self
+            print(f"[soak] restart of {self.spec.uuid} exited rc="
+                  f"{self.proc.returncode}, retry {attempt + 1}", flush=True)
+            self._reserve_port()
+            time.sleep(0.5)
         return self
 
     def _pump(self):
@@ -134,6 +239,8 @@ class Proc:
         if self.alive():
             self.proc.kill()
             self.proc.wait(timeout=10)
+        # Hold the port for the rejoin (released by the next start()).
+        self._reserve_port()
 
 
 def wait_for(procs: List[Proc], cond, timeout_s: float) -> bool:
@@ -249,9 +356,15 @@ def write_configs(
             f"add-host = {o.uuid}" for o in specs if o.uuid != spec.uuid
         )
         vvc_line = "vvc-case = vvc_9bus\n" if vvc else ""
+        metrics_line = (
+            f"metrics-port = {spec.metrics_port}\n"
+            f"events-log = {workdir}/events_{spec.port}.jsonl\n"
+            if spec.metrics_port is not None
+            else ""
+        )
         cfg.write_text(
             f"hostname = 127.0.0.1\nport = {spec.port}\nfederate = yes\n"
-            f"{peers}\nmigration-step = 1\n{vvc_line}"
+            f"{peers}\nmigration-step = 1\n{vvc_line}{metrics_line}"
             f"device-config = {workdir}/device.xml\n"
             f"adapter-config = {workdir}/adapter.xml\n"
             f"timings-config = {workdir}/timings.cfg\n"
@@ -285,6 +398,7 @@ def run_soak(
     _CACHE_DIR = str(wd / "jax_cache")
     os.makedirs(_CACHE_DIR, exist_ok=True)
     ports = free_udp_ports(n_slices)
+    metrics_ports = free_tcp_ports(n_slices)
     specs = []
     for i, port in enumerate(ports):
         rows = [r for j, r in enumerate(VVC_ROWS) if j % n_slices == i]
@@ -294,12 +408,13 @@ def run_soak(
         specs.append(
             SliceSpec(
                 uuid=f"127.0.0.1:{port}", port=port, rows=rows,
-                generation=gen, drain=drain,
+                generation=gen, drain=drain, metrics_port=metrics_ports[i],
             )
         )
     write_configs(wd, specs, loss_pct, vvc=vvc)
 
     check = Check()
+    slice_metrics: Dict[str, Dict[str, float]] = {}
     plant = subprocess.Popen(
         [sys.executable, "-m", "freedm_tpu.sim.plantserver", str(wd / "rig.xml")],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=_env(), text=True,
@@ -428,12 +543,30 @@ def run_soak(
 
         crashed = [p.spec.uuid for p in procs if not p.alive()]
         check.record("no_unexpected_crashes", not crashed, f"crashed={crashed}")
+
+        # Per-slice transport/solver counters, scraped from each live
+        # slice's metrics endpoint before teardown — the SOAK trajectory's
+        # retransmit columns.
+        slice_metrics.update(
+            (p.spec.uuid, scrape_slice_metrics(p.spec.metrics_port))
+            for p in procs
+            if p.alive() and p.spec.metrics_port is not None
+        )
     finally:
         for p in procs:
             p.kill()
+            p._release_port()
         plant.kill()
         plant.wait(timeout=10)
 
+    # Fleet totals summed over the scraped slices (the rig parent's own
+    # registry sees no traffic — the counters live in the slice
+    # processes): the SOAK trajectory's retransmit/round columns, with
+    # the per-slice breakdown alongside.
+    totals: Dict[str, float] = {}
+    for counters in slice_metrics.values():
+        for k, v in counters.items():
+            totals[k] = totals.get(k, 0.0) + v
     artifact = {
         "pass": check.passed,
         "slices": n_slices,
@@ -441,6 +574,8 @@ def run_soak(
         "duration_s": round(time.monotonic() - t_start, 1),
         "checks": check.results,
         "workdir": str(wd),
+        "metrics": totals,
+        "slice_metrics": slice_metrics,
     }
     if out:
         Path(out).write_text(json.dumps(artifact, indent=2))
