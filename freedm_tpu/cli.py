@@ -119,6 +119,9 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "(0 = ephemeral; unset = disabled)")
     ap.add_argument("--events-log", default=None, metavar="PATH",
                     help="append the structured event journal to PATH (JSONL)")
+    ap.add_argument("--trace-log", default=None, metavar="PATH",
+                    help="enable causal tracing and append finished spans "
+                         "to PATH (JSONL; also served at /trace)")
     ap.add_argument("--migration-step", type=float, default=None,
                     help="size of LB power migrations")
     ap.add_argument("--malicious-behavior", action="store_true", default=None,
@@ -154,6 +157,7 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("checkpoint", "checkpoint"), ("checkpoint_every", "checkpoint_every"),
         ("resume", "resume"),
         ("metrics_port", "metrics_port"), ("events_log", "events_log"),
+        ("trace_log", "trace_log"),
         ("migration_step", "migration_step"),
         ("malicious_behavior", "malicious_behavior"),
         ("check_invariant", "check_invariant"), ("verbose", "verbose"),
@@ -185,6 +189,17 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         # Attach the journal file FIRST so construction-time events
         # (checkpoint restore, federation bring-up) are captured too.
         obs.EVENTS.open(cfg.events_log)
+
+    from freedm_tpu.core import tracing
+
+    if cfg.trace_log:
+        # Enable the flight recorder before any module/endpoint exists:
+        # first-round compile-hit solve spans must be captured too.
+        tracing.TRACER.configure(enabled=True, node=cfg.uuid, path=cfg.trace_log)
+    else:
+        # Node identity even while disabled: a later programmatic enable
+        # (tests, embedders) stamps spans with the right node.
+        tracing.TRACER.configure(node=cfg.uuid)
 
     # Config sanity BEFORE any resource is bound: --mesh-devices and
     # --federate are different deployment shapes, and rejecting them

@@ -249,6 +249,11 @@ class GlobalConfig:
     # only).
     metrics_port: Optional[int] = None
     events_log: Optional[str] = None
+    # Causal tracing (freedm_tpu.core.tracing): JSONL span-export path.
+    # Setting it ENABLES tracing (disabled by default — the flight
+    # recorder costs nothing until asked for); spans also land in the
+    # in-memory ring served by the metrics server's /trace route.
+    trace_log: Optional[str] = None
 
     @property
     def uuid(self) -> str:

@@ -60,6 +60,44 @@ def _label_str(names: Tuple[str, ...], values: Tuple[str, ...], extra: str = "")
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def estimate_quantiles(bounds, counts, qs: Sequence[float] = (0.5, 0.95, 0.99)):
+    """Estimated quantiles from a fixed-bucket histogram.
+
+    ``bounds`` are the finite upper bucket bounds (ascending);
+    ``counts`` the per-bucket observation counts, one slot per finite
+    bucket plus the trailing +Inf overflow slot.  Semantics follow
+    Prometheus ``histogram_quantile``: linear interpolation inside the
+    winning bucket (from 0 below the first bound), and a quantile that
+    lands in the overflow bucket saturates at the largest finite bound.
+    Returns a list of floats (one per ``q``), or ``None`` for an empty
+    histogram.
+
+    This is what lets ``snapshot()`` and ``tools/trace_report.py``
+    report ack-RTT / phase-duration p50/p95/p99 without external
+    tooling.
+    """
+    bounds = np.asarray(bounds, np.float64)
+    counts = np.asarray(counts, np.float64)
+    total = float(counts.sum())
+    if total <= 0:
+        return None
+    cum = np.cumsum(counts)
+    out: List[float] = []
+    for q in qs:
+        target = min(max(float(q), 0.0), 1.0) * total
+        idx = int(np.searchsorted(cum, target, side="left"))
+        if idx >= len(bounds):
+            out.append(float(bounds[-1]))
+            continue
+        lo = 0.0 if idx == 0 else float(bounds[idx - 1])
+        hi = float(bounds[idx])
+        prev = 0.0 if idx == 0 else float(cum[idx - 1])
+        in_bucket = float(cum[idx]) - prev
+        frac = (target - prev) / in_bucket if in_bucket > 0 else 1.0
+        out.append(lo + (hi - lo) * frac)
+    return out
+
+
 class _Child:
     """One labelled series of a metric; shares the parent's lock."""
 
@@ -148,6 +186,16 @@ class _HistogramChild(_Child):
         out = {_fmt(b): int(c) for b, c in zip(self._bounds, cum[:-1])}
         out["+Inf"] = int(cum[-1])
         return out
+
+    def quantiles(self, qs: Sequence[float] = (0.5, 0.95, 0.99)) -> Optional[Dict[str, float]]:
+        """Estimated quantiles as ``{"p50": ..., "p95": ..., "p99": ...}``
+        (:func:`estimate_quantiles`); ``None`` while empty."""
+        with self._lock:
+            counts = self._counts.copy()
+        vals = estimate_quantiles(self._bounds, counts, qs)
+        if vals is None:
+            return None
+        return {f"p{int(round(q * 100))}": round(v, 9) for q, v in zip(qs, vals)}
 
 
 class _Metric:
@@ -327,11 +375,15 @@ class MetricsRegistry:
             for key, child in m.children():
                 k = ",".join(key)
                 if isinstance(child, _HistogramChild):
-                    values[k] = {
+                    entry_h: Dict[str, object] = {
                         "count": child.count,
                         "sum": child.sum,
                         "buckets": child.buckets(),
                     }
+                    q = child.quantiles()
+                    if q is not None:
+                        entry_h.update(q)
+                    values[k] = entry_h
                 else:
                     values[k] = child.value
             entry["values"] = values
@@ -397,9 +449,11 @@ class JsonlEventJournal:
         return rec
 
     def tail(self, n: int = 100) -> List[dict]:
+        if int(n) <= 0:
+            return []
         with self._lock:
             items = list(self._ring)
-        return items[-max(int(n), 0):]
+        return items[-int(n):]
 
     def __len__(self) -> int:
         with self._lock:
@@ -411,6 +465,9 @@ class MetricsServer:
 
     ``GET /metrics`` — Prometheus text format of the registry;
     ``GET /events?n=K`` — the journal's newest K events as JSONL;
+    ``GET /trace?n=K[&trace_id=T]`` — the tracing flight recorder's
+    newest K records as JSONL (``freedm_tpu.core.tracing``; empty until
+    tracing is enabled);
     anything else — a one-line index.  Runs ``http.server`` on a daemon
     thread; ``port=0`` binds an ephemeral port (read it back from
     ``.port``).
@@ -450,8 +507,21 @@ class MetricsServer:
                     )
                     self._reply(200, body + ("\n" if body else ""),
                                 "application/x-ndjson")
+                elif url.path == "/trace":
+                    from freedm_tpu.core import tracing as _tracing
+
+                    q = parse_qs(url.query)
+                    n = int(q.get("n", ["1000"])[0])
+                    tid = q.get("trace_id", [None])[0]
+                    body = "\n".join(
+                        json.dumps(r, default=str)
+                        for r in _tracing.TRACER.tail(n, trace_id=tid)
+                    )
+                    self._reply(200, body + ("\n" if body else ""),
+                                "application/x-ndjson")
                 elif url.path == "/":
-                    self._reply(200, "freedm_tpu metrics: /metrics /events\n",
+                    self._reply(200,
+                                "freedm_tpu metrics: /metrics /events /trace\n",
                                 "text/plain; charset=utf-8")
                 else:
                     self._reply(404, "not found\n", "text/plain; charset=utf-8")

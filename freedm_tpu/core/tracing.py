@@ -1,0 +1,445 @@
+"""Causal distributed tracing: spans, wire-propagated context, flight recorder.
+
+PR 1's registry answers *how much* (counters, histograms); this module
+answers *why*: when a round overruns or an election flaps, the span tree
+links the message sent on one fleet node to the phase work it triggers
+on another.  The design follows the per-actor-timeline school of
+multi-host debugging (Podracer, arxiv 2104.06272; TPU distributed
+linear algebra, arxiv 2112.09017): every actor records its own spans
+against its own clock, a tiny context (``trace_id``/``span_id``) rides
+the wire, and an offline reconstructor stitches the timelines into one
+causal timeline using the clock-sync offset table.
+
+Pieces:
+
+- :class:`Span` — one timed operation: ``trace_id`` (the causal tree it
+  belongs to), ``span_id``, ``parent_id``, wall-clock ``t0``/``t1``,
+  free-form ``tags``, and timestamped ``events`` (annotations).
+- :class:`Tracer` — the process-wide recorder.  **Disabled by default**:
+  ``start()`` then returns the shared :data:`NOOP` span, so the
+  instrumented hot paths (broker loop, DCN send/receive) pay one
+  attribute check.  Enabled (``--trace-log``), finished spans land in a
+  bounded in-memory ring (the "flight recorder", served by the metrics
+  server's ``/trace`` route) and are appended to a JSONL file.
+- Wire propagation — :meth:`Span.context` is the two-field dict that
+  ``ModuleMessage.trace`` / ``Frame.trace`` carry across the DCN, so
+  the send-span on node A becomes the (grand)parent of the handler span
+  on node B.
+- Clock records — :meth:`Tracer.record_clock_offset` journals the clock
+  synchronizer's measured offset into the same stream, which is what
+  lets ``tools/trace_report.py`` correct each node's timestamps onto
+  the shared virtual clock.
+
+Record schema (one JSON object per line; ``tools/trace_report.py`` and
+``docs/observability.md`` document the consumer side):
+
+    span:  {"trace_id", "span_id", "parent_id"?, "name", "kind",
+            "node", "t0", "t1", "tags"?, "events"?}
+    clock: {"rec": "clock", "node", "ts", "offset_s"}
+
+This module deliberately imports nothing heavyweight (no jax, no
+numpy): transport-only processes trace without paying a jax import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _new_id() -> str:
+    """16-hex-char random id (no uuid module: 2x faster, same entropy
+    class for a per-process flight recorder)."""
+    return os.urandom(8).hex()
+
+
+class _NoopSpan:
+    """The disabled-tracer span: every operation is a no-op.  One shared
+    instance (:data:`NOOP`) keeps the disabled hot path allocation-free."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+
+    def tag(self, **kv) -> "_NoopSpan":
+        return self
+
+    def annotate(self, name: str, **fields) -> "_NoopSpan":
+        return self
+
+    def context(self) -> None:
+        return None
+
+    def end(self, t: Optional[float] = None) -> None:
+        pass
+
+    def activate(self) -> "_NoopSpan":
+        return self
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+#: Shared no-op span returned by a disabled tracer.
+NOOP = _NoopSpan()
+
+
+class _Active:
+    """Context manager that pushes a span as the thread's current span
+    WITHOUT ending it on exit (the broker ends phase spans after
+    measuring the phase duration it wants to tag)."""
+
+    __slots__ = ("_span",)
+
+    def __init__(self, span: "Span"):
+        self._span = span
+
+    def __enter__(self) -> "Span":
+        self._span._tracer._push(self._span)
+        return self._span
+
+    def __exit__(self, *exc) -> bool:
+        self._span._tracer._pop(self._span)
+        return False
+
+
+class Span:
+    """One timed operation in a causal trace."""
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_id", "name", "kind", "node",
+        "t0", "t1", "tags", "events", "_tracer", "_done",
+    )
+
+    def __init__(self, tracer: "Tracer", trace_id: str, span_id: str,
+                 parent_id: Optional[str], name: str, kind: str,
+                 node: str, t0: float, tags: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.kind = kind
+        self.node = node
+        self.t0 = t0
+        self.t1: Optional[float] = None
+        self.tags: Dict[str, Any] = dict(tags) if tags else {}
+        self.events: List[Dict[str, Any]] = []
+        self._done = False
+
+    def tag(self, **kv) -> "Span":
+        self.tags.update(kv)
+        return self
+
+    def annotate(self, name: str, **fields) -> "Span":
+        """Timestamped point event inside the span (timer firings,
+        retransmissions, ...)."""
+        ev = {"name": name, "ts": round(self._tracer.clock(), 6)}
+        ev.update(fields)
+        self.events.append(ev)
+        return self
+
+    def context(self) -> Dict[str, str]:
+        """The wire-propagated trace context (``ModuleMessage.trace`` /
+        ``Frame.trace`` payload)."""
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def end(self, t: Optional[float] = None) -> None:
+        """Close the span and hand it to the recorder (idempotent)."""
+        if self._done:
+            return
+        self._done = True
+        self.t1 = self._tracer.clock() if t is None else t
+        self._tracer._record_span(self)
+
+    def activate(self) -> _Active:
+        """Make this span the thread's current span for a block, without
+        ending it on exit (see :class:`_Active`)."""
+        return _Active(self)
+
+    def __enter__(self) -> "Span":
+        self._tracer._push(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._tracer._pop(self)
+        self.end()
+        return False
+
+
+class Tracer:
+    """Process-wide span recorder with a ring-buffer flight recorder.
+
+    Disabled by default; :meth:`configure` with ``enabled=True`` (the
+    CLI's ``--trace-log``) turns recording on.  Thread-safe: spans are
+    created/ended from the broker thread and the DCN pump thread; the
+    thread-local current-span stack gives each thread its own implicit
+    parenting context.
+    """
+
+    def __init__(self, capacity: int = 8192, max_bytes: int = 200_000_000):
+        self.enabled = False
+        self.node = ""
+        self.clock: Callable[[], float] = time.time
+        self._lock = threading.RLock()
+        self._ring: deque = deque(maxlen=int(capacity))
+        self._fh = None
+        self.path: Optional[str] = None
+        # Like the event journal: the export file rotates once
+        # (path -> path.1) past max_bytes, so an unattended soak with
+        # tracing left on cannot fill the disk.
+        self.max_bytes = int(max_bytes)
+        self._written = 0
+        self._tls = threading.local()
+        self._last_offset: Optional[float] = None
+
+    # -- configuration -------------------------------------------------------
+    def configure(self, enabled: Optional[bool] = None,
+                  node: Optional[str] = None,
+                  path: Optional[str] = None,
+                  capacity: Optional[int] = None,
+                  clock: Optional[Callable[[], float]] = None) -> "Tracer":
+        """Set any subset of the tracer's knobs; omitted ones persist.
+        Attaching a ``path`` opens (append) the JSONL export file."""
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if node is not None:
+                self.node = str(node)
+            if clock is not None:
+                self.clock = clock
+            if capacity is not None:
+                self._ring = deque(self._ring, maxlen=int(capacity))
+            if path is not None:
+                if self._fh is not None:
+                    self._fh.close()
+                self.path = str(path)
+                self._fh = open(self.path, "a", encoding="utf-8")
+                self._written = os.path.getsize(self.path)
+        return self
+
+    def reset(self) -> None:
+        """Back to the disabled boot state (tests)."""
+        with self._lock:
+            self.enabled = False
+            self.node = ""
+            self.clock = time.time
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            self.path = None
+            self._written = 0
+            self._ring.clear()
+            self._last_offset = None
+        self._tls = threading.local()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # -- current-span stack (per thread) -------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _push(self, span: Span) -> None:
+        self._stack().append(span)
+
+    def _pop(self, span: Span) -> None:
+        st = self._stack()
+        if st and st[-1] is span:
+            st.pop()
+        elif span in st:  # tolerate out-of-order exits
+            st.remove(span)
+
+    def current(self) -> Optional[Span]:
+        st = getattr(self._tls, "stack", None)
+        return st[-1] if st else None
+
+    # -- span creation -------------------------------------------------------
+    def start(self, name: str, kind: str = "",
+              parent: Optional[Span] = None,
+              parent_ctx: Optional[Dict[str, str]] = None,
+              trace_id: Optional[str] = None,
+              tags: Optional[Dict[str, Any]] = None):
+        """Open a span.  Parent resolution, in priority order: explicit
+        ``parent`` span → wire ``parent_ctx`` dict → the thread's
+        current span → none (a fresh trace root).  Returns :data:`NOOP`
+        when disabled."""
+        if not self.enabled:
+            return NOOP
+        pid = None
+        tid = trace_id
+        if parent is not None and getattr(parent, "trace_id", None) is not None:
+            tid, pid = parent.trace_id, parent.span_id
+        elif parent_ctx:
+            tid = parent_ctx.get("trace_id") or tid
+            pid = parent_ctx.get("span_id")
+        else:
+            cur = self.current()
+            if cur is not None:
+                tid, pid = cur.trace_id, cur.span_id
+        if tid is None:
+            tid = _new_id()
+        return Span(self, tid, _new_id(), pid, name, kind, self.node,
+                    self.clock(), tags)
+
+    # -- recording -----------------------------------------------------------
+    def _record_span(self, span: Span) -> None:
+        rec: Dict[str, Any] = {
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "name": span.name,
+            "kind": span.kind,
+            "node": span.node,
+            "t0": round(span.t0, 6),
+            "t1": round(span.t1, 6),
+        }
+        if span.parent_id is not None:
+            rec["parent_id"] = span.parent_id
+        if span.tags:
+            rec["tags"] = span.tags
+        if span.events:
+            rec["events"] = span.events
+        self._write(rec)
+
+    def record_clock_offset(self, offset_s: float) -> None:
+        """Journal the clock synchronizer's measured offset (what
+        ``trace_report.py`` uses to correct this node's timestamps onto
+        the shared virtual clock).  Deduplicated: only a changed offset
+        writes a record."""
+        if not self.enabled:
+            return
+        if self._last_offset is not None and abs(offset_s - self._last_offset) < 1e-6:
+            return
+        self._last_offset = float(offset_s)
+        self._write({
+            "rec": "clock",
+            "node": self.node,
+            "ts": round(self.clock(), 6),
+            "offset_s": round(float(offset_s), 9),
+        })
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            self._ring.append(rec)
+            if self._fh is not None:
+                if self._written and self._written + len(line) + 1 > self.max_bytes:
+                    self._fh.close()
+                    os.replace(self.path, self.path + ".1")
+                    self._fh = open(self.path, "a", encoding="utf-8")
+                    self._written = 0
+                # Per-record flush is deliberate: the soak rig SIGKILLs
+                # slices, and a buffered tail would lose exactly the
+                # pre-kill spans a postmortem needs.  Hot readers use
+                # the in-memory ring (/trace), never this file.
+                self._fh.write(line + "\n")
+                self._fh.flush()
+                self._written += len(line) + 1
+
+    # -- introspection (the /trace route, tests) -----------------------------
+    def tail(self, n: int = 1000, trace_id: Optional[str] = None) -> List[dict]:
+        """Newest ``n`` records, optionally filtered to one trace."""
+        if int(n) <= 0:
+            return []
+        with self._lock:
+            items = list(self._ring)
+        if trace_id is not None:
+            items = [r for r in items if r.get("trace_id") == trace_id]
+        return items[-int(n):]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+#: The process-wide tracer every layer instruments against.
+TRACER = Tracer()
+
+
+# ---------------------------------------------------------------------------
+# Instrumentation helpers used by the runtime/dcn/pf layers
+# ---------------------------------------------------------------------------
+
+
+def traced_handler(handler_id: str, handler, msg):
+    """Wrap a dispatch target so its execution records a handler span
+    parented to the message's wire trace context (or, for loopback
+    messages, to the thread's current span — usually the phase span).
+
+    The span's ``t0``/``t1`` measure handler *execution*; the time a
+    queued handler waited between dispatch and its phase is carried as
+    the ``queue_ms`` tag (immediate handlers report ~0).
+
+    Returns ``handler`` unchanged when tracing is disabled, so the
+    dispatch hot path costs one attribute check.
+    """
+    if not TRACER.enabled:
+        return handler
+    ctx = getattr(msg, "trace", None)
+    dispatched_at = TRACER.clock()
+
+    def run(m, _h=handler, _ctx=ctx, _id=handler_id, _t=dispatched_at):
+        with TRACER.start(
+            f"handle:{m.type}", kind="handler", parent_ctx=_ctx,
+            tags={"module": _id, "source": m.source,
+                  "queue_ms": round(max(TRACER.clock() - _t, 0.0) * 1e3, 3)},
+        ):
+            _h(m)
+
+    return run
+
+
+def _in_jax_trace() -> bool:
+    """True while jax is tracing (vmap/jit/grad): solver spans must not
+    be recorded from inside a transformation trace."""
+    try:
+        from jax import core as _jc  # lazy: transport-only processes never pay it
+
+        return not _jc.trace_state_clean()
+    except Exception:
+        return False
+
+
+def traced_solver(solver: str, fn):
+    """Wrap a compiled power-flow solve so each call records a
+    ``pf.solve`` span, tagging the first call ``jit_compile=True`` (the
+    synchronous trace+compile hit) vs steady-state ``False``.
+
+    Steady-state spans measure the *dispatch* side of an async jax
+    execution (no ``block_until_ready`` is inserted — tracing must not
+    change the overlap the caller built); the first-call span is the
+    honest compile wall time, because jax compiles synchronously.
+    Calls made from inside a jax transformation (``vmap(solve)``)
+    record nothing.  Disabled tracing costs one attribute check.
+    """
+    import functools
+
+    seen = [False]
+
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        # First-call tracking is independent of the tracer state: the
+        # compile hit happens on the solver's actual first call, and a
+        # tracer enabled later must not mislabel a warm dispatch as it.
+        first = not seen[0]
+        seen[0] = True
+        if not TRACER.enabled or _in_jax_trace():
+            return fn(*a, **kw)
+        with TRACER.start(f"pf.solve:{solver}", kind="solve",
+                          tags={"solver": solver, "jit_compile": first}):
+            return fn(*a, **kw)
+
+    return wrapper

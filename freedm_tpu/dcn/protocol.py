@@ -34,7 +34,7 @@ from collections import deque
 from dataclasses import replace
 from typing import Deque, Dict, List, Optional
 
-from freedm_tpu.core import metrics
+from freedm_tpu.core import metrics, tracing
 from freedm_tpu.dcn import wire
 from freedm_tpu.dcn.wire import ACCEPTED, BAD_REQUEST, CREATED, MESSAGE, Frame
 from freedm_tpu.runtime.messages import ModuleMessage
@@ -96,22 +96,41 @@ class SrChannel:
         # per-peer outstanding-window gauge, bound once.
         self._sent_at: Dict[int, float] = {}
         self._g_outstanding = metrics.DCN_OUTSTANDING.labels(uuid)
+        # Tracing: live send span per in-flight seq (ended on ACK or
+        # expiry; empty while tracing is disabled).
+        self._spans: Dict[int, object] = {}
 
     # -- sender side ---------------------------------------------------------
     def send(self, msg: ModuleMessage, now: float) -> None:
         """Queue a message (CProtocolSR::Send): SYN-first when unsynced,
         assign seq + hash, stamp TTL."""
+        # Tracing: the send span parents to the message's existing
+        # context (a handler forwarding) or the thread's current span (a
+        # module sending mid-phase); its context rides the FRAME (only —
+        # duplicating it inside the packed message would double the
+        # ~70-byte wire overhead tracing adds per MESSAGE frame), so the
+        # peer's recv/handler spans join this trace.
+        span = tracing.NOOP
+        ctx = None
+        if tracing.TRACER.enabled:
+            span = tracing.TRACER.start(
+                "dcn.send", kind="send", parent_ctx=msg.trace,
+                tags={"peer": self.uuid, "type": msg.type},
+            )
+            ctx = span.context()
         # Oversize messages fail loudly at the caller — BEFORE any state
         # mutation, or the rejected send would burn a sequence number
         # and desync the stream.  Probe with worst-case seq digits and a
         # stamp margin: the pump's flush stamps wall-clock time, which
-        # can serialize longer than the monotonic `now` used here.
+        # can serialize longer than the monotonic `now` used here.  (An
+        # oversize raise abandons the unended span: never recorded.)
         probe = Frame(
             status=MESSAGE,
             seq=SEQUENCE_MODULO - 1,
             hash=msg.hash(),
             expire=now + self.ttl_s,
             msg=wire.pack_message(msg),
+            trace=ctx,
         )
         wire.encode_window(self.src_uuid, [probe], now, margin=_STAMP_MARGIN)
         if not self._out_synced:
@@ -120,6 +139,9 @@ class SrChannel:
         # end-to-end ModuleMessage.expire_time is wall-clock and is
         # enforced at dispatch (Dispatcher drops expired messages).
         frame = replace(probe, seq=self._take_seq())
+        if ctx is not None:
+            span.tag(seq=frame.seq)
+            self._spans[frame.seq] = span
         self._out_window.append(frame)
         self.sent += 1
         metrics.DCN_SENDS.inc()
@@ -164,6 +186,7 @@ class SrChannel:
             ):
                 dead = self._out_window.popleft()
                 self._sent_at.pop(dead.seq, None)
+                self._end_span(dead.seq, expired=True)
                 self._send_kills = True
                 self._dropped += 1
                 self.expired += 1
@@ -190,6 +213,9 @@ class SrChannel:
                 continue
             if f.seq in self._sent_at:
                 metrics.DCN_RETRANSMITS.inc()
+                sp = self._spans.get(f.seq)
+                if sp is not None:
+                    sp.annotate("retransmit")
             else:
                 self._sent_at[f.seq] = now
         self._g_outstanding.set(len(self._out_window))
@@ -212,11 +238,19 @@ class SrChannel:
         while self._out_window and self._out_window[0].expired(now):
             dead = self._out_window.popleft()
             self._sent_at.pop(dead.seq, None)
+            self._end_span(dead.seq, expired=True)
             self.expired += 1
             metrics.DCN_EXPIRED.inc()
         self._out_synced = False
         if self._out_window:
             self._push_syn(now)
+
+    def _end_span(self, seq: int, **tags) -> None:
+        """Close the send span of a retired seq (ACKed or expired)."""
+        sp = self._spans.pop(seq, None)
+        if sp is not None:
+            sp.tag(**tags)
+            sp.end()
 
     # -- receiver side -------------------------------------------------------
     def on_frames(self, frames: List[Frame], now: float) -> List[ModuleMessage]:
@@ -227,7 +261,24 @@ class SrChannel:
             if f.status == ACCEPTED:
                 self._receive_ack(f, now)
             elif self._receive(f, now) and f.msg is not None:
-                out.append(wire.unpack_message(f.msg))
+                m = wire.unpack_message(f.msg)
+                if tracing.TRACER.enabled:
+                    # The accept logic delivers exactly once, so exactly
+                    # one recv span exists per message however many times
+                    # the frame was retransmitted.  The message's context
+                    # is rewritten to the recv span, chaining
+                    # send → recv → handler across the node boundary.
+                    rs = tracing.TRACER.start(
+                        "dcn.recv", kind="recv",
+                        parent_ctx=f.trace or m.trace,
+                        tags={"peer": self.uuid, "seq": f.seq,
+                              "type": m.type},
+                    )
+                    rs.end()
+                    rctx = rs.context()
+                    if rctx is not None:
+                        m = replace(m, trace=rctx)
+                out.append(m)
                 self.accepted += 1
         return out
 
@@ -245,6 +296,10 @@ class SrChannel:
             sent_at = self._sent_at.pop(head.seq, None)
             if sent_at is not None and head.status == MESSAGE:
                 metrics.DCN_ACK_RTT.observe(max(now - sent_at, 0.0))
+                self._end_span(head.seq, acked=True,
+                               rtt_s=round(max(now - sent_at, 0.0), 6))
+            else:
+                self._end_span(head.seq, acked=True)
             self._g_outstanding.set(len(self._out_window))
 
     def _receive(self, f: Frame, now: float) -> bool:
@@ -310,10 +365,12 @@ class SrChannel:
         return False
 
     def _queue_ack(self, f: Frame) -> None:
-        """CProtocolSR::SendACK — ACKs echo seq/hash/expire and ride the
-        next wire flush."""
+        """CProtocolSR::SendACK — ACKs echo seq/hash/expire (and the
+        trace context, so the on-wire ACK links back to the originating
+        send span) and ride the next wire flush."""
         self._ack_window.append(
-            Frame(status=ACCEPTED, seq=f.seq, hash=f.hash, expire=f.expire)
+            Frame(status=ACCEPTED, seq=f.seq, hash=f.hash, expire=f.expire,
+                  trace=f.trace)
         )
 
     # -- introspection -------------------------------------------------------

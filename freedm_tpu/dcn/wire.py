@@ -15,6 +15,7 @@ debuggable, and language-neutral.  Datagrams are capped at
 
 from __future__ import annotations
 
+import dataclasses
 import json
 from dataclasses import asdict, dataclass
 from typing import Any, Dict, List, Optional, Tuple
@@ -42,13 +43,31 @@ class Frame:
     expire: Optional[float] = None  # unix seconds
     sync_time: Optional[float] = None  # SYN identity (duplicate detection)
     msg: Optional[Dict[str, Any]] = None  # serialized ModuleMessage
+    # Tracing context of the originating send span ({"trace_id",
+    # "span_id"}); ACKs echo it so the wire itself shows the link.
+    trace: Optional[Dict[str, Any]] = None
 
     def expired(self, now: float) -> bool:
         return self.expire is not None and now > self.expire
 
 
+#: Frame fields a decoder recognizes.  Forward compatibility rule: a
+#: datagram from a NEWER peer may carry frame keys this build does not
+#: know — they are dropped, never a decode error (the pre-PR-2 decoder
+#: crashed on any unknown key, so a fleet could not be upgraded node by
+#: node).
+_FRAME_FIELDS = frozenset(f.name for f in dataclasses.fields(Frame))
+
+
+def _frame_wire_dict(f: Frame) -> Dict[str, Any]:
+    """Serialized frame with ``None`` fields omitted: smaller datagrams,
+    and a frame without tracing context puts zero trace bytes on the
+    wire (absent keys decode back to the dataclass defaults)."""
+    return {k: v for k, v in asdict(f).items() if v is not None}
+
+
 def pack_message(m: ModuleMessage) -> Dict[str, Any]:
-    return {
+    d = {
         "recipient_module": m.recipient_module,
         "type": m.type,
         "payload": m.payload,
@@ -56,6 +75,9 @@ def pack_message(m: ModuleMessage) -> Dict[str, Any]:
         "send_time": m.send_time,
         "expire_time": m.expire_time,
     }
+    if m.trace is not None:
+        d["trace"] = m.trace
+    return d
 
 
 def unpack_message(d: Dict[str, Any]) -> ModuleMessage:
@@ -66,6 +88,7 @@ def unpack_message(d: Dict[str, Any]) -> ModuleMessage:
         source=d.get("source", ""),
         send_time=d.get("send_time"),
         expire_time=d.get("expire_time"),
+        trace=d.get("trace"),
     )
 
 
@@ -83,7 +106,7 @@ def encode_window(
         {
             "src": source_uuid,
             "sent": send_time,
-            "frames": [asdict(f) for f in frames],
+            "frames": [_frame_wire_dict(f) for f in frames],
         },
         separators=(",", ":"),
     ).encode()
@@ -102,7 +125,7 @@ def encode_windows(
     batch: List[Frame] = []
     size = _EMPTY_OVERHEAD + len(source_uuid)
     for f in frames:
-        fsize = len(json.dumps(asdict(f), separators=(",", ":")).encode()) + 1
+        fsize = len(json.dumps(_frame_wire_dict(f), separators=(",", ":")).encode()) + 1
         if batch and size + fsize > MAX_PACKET_SIZE:
             out.append(encode_window(source_uuid, batch, send_time))
             batch, size = [], _EMPTY_OVERHEAD + len(source_uuid)
@@ -118,10 +141,20 @@ _EMPTY_OVERHEAD = 64
 
 
 def decode_window(data: bytes) -> Tuple[str, float, List[Frame]]:
-    """Parse a datagram; raises ``ValueError`` on malformed input."""
+    """Parse a datagram; raises ``ValueError`` on malformed input.
+
+    Forward compatible: unknown frame keys (and unknown top-level window
+    keys — only ``src``/``sent``/``frames`` are read) from a newer peer
+    are dropped, so old nodes tolerate traced datagrams.  A frame
+    missing a *required* field (``status``, ``seq``) is still malformed.
+    """
     try:
         obj = json.loads(data.decode())
-        frames = [Frame(**f) for f in obj["frames"]]
+        frames = [
+            Frame(**{k: v for k, v in f.items() if k in _FRAME_FIELDS})
+            for f in obj["frames"]
+        ]
         return str(obj["src"]), float(obj["sent"]), frames
-    except (KeyError, TypeError, UnicodeDecodeError, json.JSONDecodeError) as e:
+    except (KeyError, TypeError, AttributeError, UnicodeDecodeError,
+            json.JSONDecodeError) as e:
         raise ValueError(f"malformed datagram: {e}") from e

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from freedm_tpu.core import tracing
 from freedm_tpu.grid.bus import PQ, SLACK, BusSystem, ybus_dense
 from freedm_tpu.pf.newton import NewtonResult, build_result, s_calc
 from freedm_tpu.pf.newton import record_result as _record_newton
@@ -212,4 +213,9 @@ def make_fdlf_solver(
                 y, theta, v, max_iter, _err_from(dp, dq, v), tol
             )
 
-    return solve, solve_fixed
+    # Tracing (core.tracing): pf.solve spans, first call tagged as the
+    # jit-compile hit; a no-op while tracing is disabled.
+    return (
+        tracing.traced_solver("fdlf", solve),
+        tracing.traced_solver("fdlf", solve_fixed),
+    )

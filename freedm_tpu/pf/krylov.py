@@ -69,6 +69,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from freedm_tpu.core import tracing
 from freedm_tpu.grid.bus import BusSystem, SLACK, PQ, ybus_dense
 from freedm_tpu.pf.fdlf import decoupled_parts
 from freedm_tpu.pf.mfree import make_injection_fn
@@ -356,7 +357,12 @@ def make_krylov_solver(
         x, ps, qs = _prep(p_inj, q_inj, v0, theta0)
         return _solve_fixed_impl(_bp_inv, _bq_inv, x, ps, qs, status)
 
-    return solve, solve_fixed
+    # Tracing (core.tracing): pf.solve spans, first call tagged as the
+    # jit-compile hit; a no-op while tracing is disabled.
+    return (
+        tracing.traced_solver("krylov", solve),
+        tracing.traced_solver("krylov", solve_fixed),
+    )
 
 
 def record_result(result: KrylovResult) -> None:

@@ -48,6 +48,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from freedm_tpu.core import tracing
 from freedm_tpu.grid.bus import PQ, SLACK, BusSystem, branch_admittances, ybus_dense
 from freedm_tpu.utils import cplx
 from freedm_tpu.utils.cplx import C
@@ -226,7 +227,13 @@ def make_newton_solver(
             err = jnp.max(jnp.abs(_residual(x, y, ps, qs) * free))
             return _finish(x, y, ps, qs, max_iter, err)
 
-    return solve, solve_fixed
+    # Tracing (core.tracing, --trace-log): each call records a
+    # ``pf.solve`` span, the first one tagged with its jit-compile hit.
+    # Disabled tracing is one attribute check per call.
+    return (
+        tracing.traced_solver("newton", solve),
+        tracing.traced_solver("newton", solve_fixed),
+    )
 
 
 def record_result(result: NewtonResult, solver: str = "newton") -> None:

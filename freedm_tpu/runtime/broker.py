@@ -31,7 +31,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from freedm_tpu.core import metrics
+from freedm_tpu.core import metrics, tracing
 from freedm_tpu.core.config import ALIGNMENT_DURATION_MS
 from freedm_tpu.runtime.dispatch import Dispatcher
 from freedm_tpu.runtime.messages import ModuleMessage
@@ -178,7 +178,7 @@ class Broker:
         self._stop = True
 
     # -- the loop (CBroker::Run / ChangePhase / Worker) ----------------------
-    def _fire_due_timers(self) -> None:
+    def _fire_due_timers(self) -> List[str]:
         now = time.monotonic()
         due = [t for t in self._timers if t[0] <= now]
         self._timers = [t for t in self._timers if t[0] > now]
@@ -188,6 +188,7 @@ class Broker:
         # must remain valid for schedule_timer.
         for _, handle, task in due:
             self.schedule(self._timer_owner.get(handle, handle), task, this_round=True)
+        return [handle for _, handle, _ in due]
 
     def _align(self) -> Optional[float]:
         """Wait for the next wall-clock round boundary (on the skewed
@@ -220,6 +221,15 @@ class Broker:
         """
         if realtime and aligned_start is None:
             aligned_start = self._clock() + self.clock_skew_s
+        # One round span, one child span per phase (freedm_tpu.core
+        # .tracing; NOOP singletons when tracing is disabled).  Messages
+        # sent by modules mid-phase parent their send spans to the
+        # active phase span, so cross-node traces root in the round that
+        # caused them.
+        round_span = tracing.TRACER.start(
+            "round", kind="round",
+            tags={"round": self.round_index, "realtime": realtime},
+        )
         budget_sum = 0.0
         for ph in self._phases:
             phase_start = time.time()
@@ -227,22 +237,40 @@ class Broker:
             with self._qlock:
                 ph.queue.extend(ph.next_queue)
                 ph.next_queue = []
-            self._fire_due_timers()
-            ctx = PhaseContext(
-                round_index=self.round_index,
-                phase_start=phase_start,
-                time_remaining_ms=ph.time_ms,
-                shared=self.shared,
+            ph_span = tracing.TRACER.start(
+                f"phase:{ph.module.name}", kind="phase", parent=round_span,
+                tags={"round": self.round_index, "budget_ms": ph.time_ms},
             )
-            # Drain queued work (messages + tasks), then the phase body.
-            # Tasks run outside the lock — they may schedule more work.
-            while True:
-                with self._qlock:
-                    if not ph.queue:
-                        break
-                    task = ph.queue.pop(0)
-                task()
-            ph.module.run_phase(ctx)
+            try:
+                with ph_span.activate():
+                    fired = self._fire_due_timers()
+                    for handle in fired:
+                        ph_span.annotate("timer_fired", handle=handle)
+                    ctx = PhaseContext(
+                        round_index=self.round_index,
+                        phase_start=phase_start,
+                        time_remaining_ms=ph.time_ms,
+                        shared=self.shared,
+                    )
+                    # Drain queued work (messages + tasks), then the
+                    # phase body.  Tasks run outside the lock — they may
+                    # schedule more work.
+                    while True:
+                        with self._qlock:
+                            if not ph.queue:
+                                break
+                            task = ph.queue.pop(0)
+                        task()
+                    ph.module.run_phase(ctx)
+            except BaseException as e:
+                # A crashing phase must still land in the flight
+                # recorder — the round that died is exactly the one a
+                # postmortem trace needs.
+                ph_span.tag(error=repr(e))
+                ph_span.end()
+                round_span.tag(error=True)
+                round_span.end()
+                raise
             # Per-phase duration for the telemetry arrays (SURVEY §5) —
             # monotonic, so an NTP step cannot corrupt the record.
             phase_ms = (time.monotonic() - phase_mono) * 1e3
@@ -251,21 +279,31 @@ class Broker:
                 # Budget exceeded.  Under realtime this is the skew the
                 # aligner has to absorb; free-running it still marks a
                 # phase slower than its configured slice (JIT warmup,
-                # regression) — either way operators want the count.
+                # regression) — either way operators want the count,
+                # and the trace the attribution.
                 metrics.BROKER_PHASE_OVERRUNS.labels(ph.module.name).inc()
+                ph_span.tag(overrun=True,
+                            overrun_ms=round(phase_ms - ph.time_ms, 3))
+            ph_span.tag(phase_ms=round(phase_ms, 3))
+            ph_span.end()
             if realtime:
                 budget_sum += ph.time_ms / 1000.0
                 target = aligned_start + budget_sum
                 now_v = self._clock() + self.clock_skew_s
                 if now_v < target:
                     time.sleep(target - now_v)
+        round_span.end()
         self.round_index += 1
         metrics.BROKER_ROUNDS.inc()
 
     def _apply_skew(self, offset_s: float) -> None:
         """SetClockSkew: the synchronizer's measured offset feeds phase
-        alignment, on top of the configured base skew."""
+        alignment, on top of the configured base skew.  The offset is
+        also journaled into the trace stream — it is the correction
+        table ``tools/trace_report.py`` uses to put this node's span
+        timestamps onto the fleet's shared virtual clock."""
         self.clock_skew_s = self._base_skew_s + offset_s
+        tracing.TRACER.record_clock_offset(offset_s)
 
     def run(self, n_rounds: Optional[int] = None, realtime: bool = False) -> int:
         """Run rounds until ``n_rounds`` or :meth:`stop`.

@@ -15,6 +15,7 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Callable, Dict, List, Tuple
 
+from freedm_tpu.core import tracing
 from freedm_tpu.runtime.messages import ALL_MODULES, ModuleMessage
 
 Handler = Callable[[ModuleMessage], None]
@@ -58,8 +59,14 @@ class Dispatcher:
         else:
             targets = list(self._handlers.get(msg.recipient_module, ()))
         for handler_id, handler, immediate in targets:
+            # Tracing: handler execution records a span parented to the
+            # message's wire context (cross-node causality) or to the
+            # phase span that dispatched it (loopback).  Wrapping
+            # happens here, at dispatch time, so a queued handler's
+            # dispatch-to-execution wait is captured as its queue_ms tag.
+            h = tracing.traced_handler(handler_id, handler, msg)
             if immediate:
-                handler(msg)
+                h(msg)
             else:
-                enqueue(handler_id, handler, msg)
+                enqueue(handler_id, h, msg)
         return len(targets)

@@ -37,6 +37,11 @@ class ModuleMessage:
     source: str = ""  # sender uuid (hostname:port discipline)
     send_time: Optional[float] = None  # unix seconds
     expire_time: Optional[float] = None
+    # Causal tracing context ({"trace_id", "span_id"} of the sender's
+    # span, freedm_tpu.core.tracing).  Deliberately OUTSIDE the content
+    # hash: the hash identifies the message across retransmissions, and
+    # a retransmitted frame carries the same trace context.
+    trace: Optional[Dict[str, str]] = None
 
     def stamped(self, now: Optional[float] = None) -> "ModuleMessage":
         """Stamp the send time (StampMessageSendtime)."""

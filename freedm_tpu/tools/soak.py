@@ -362,9 +362,12 @@ def write_configs(
             if spec.metrics_port is not None
             else ""
         )
+        # Per-slice trace files (core.tracing): trace_report.py merges
+        # them into the skew-corrected causal round timeline.
+        trace_line = f"trace-log = {workdir}/trace_{spec.port}.jsonl\n"
         cfg.write_text(
             f"hostname = 127.0.0.1\nport = {spec.port}\nfederate = yes\n"
-            f"{peers}\nmigration-step = 1\n{vvc_line}{metrics_line}"
+            f"{peers}\nmigration-step = 1\n{vvc_line}{metrics_line}{trace_line}"
             f"device-config = {workdir}/device.xml\n"
             f"adapter-config = {workdir}/adapter.xml\n"
             f"timings-config = {workdir}/timings.cfg\n"
@@ -567,6 +570,32 @@ def run_soak(
     for counters in slice_metrics.values():
         for k, v in counters.items():
             totals[k] = totals.get(k, 0.0) + v
+    # Per-slice trace files + a merged mini-report: the artifact records
+    # how causally connected the run was (cross-node links prove the
+    # wire trace context survived the lossy transport), with the full
+    # timeline reconstructable offline via trace_report.py.
+    trace_files = [
+        str(wd / f"trace_{s.port}.jsonl")
+        for s in specs
+        if (wd / f"trace_{s.port}.jsonl").exists()
+    ]
+    trace_summary: Dict[str, object] = {"files": trace_files}
+    if trace_files:
+        try:
+            from freedm_tpu.tools import trace_report
+
+            rep = trace_report.report(trace_files)
+            trace_summary.update(
+                spans=rep["spans"],
+                traces=len(rep["traces"]),
+                cross_node_links=sum(
+                    t["cross_node_links"] for t in rep["traces"].values()
+                ),
+                overruns=rep["overruns"],
+                phase_ms=rep["summaries"].get("phase_ms", {}),
+            )
+        except Exception as e:  # a truncated file must not fail the soak
+            trace_summary["error"] = repr(e)
     artifact = {
         "pass": check.passed,
         "slices": n_slices,
@@ -576,6 +605,7 @@ def run_soak(
         "workdir": str(wd),
         "metrics": totals,
         "slice_metrics": slice_metrics,
+        "trace": trace_summary,
     }
     if out:
         Path(out).write_text(json.dumps(artifact, indent=2))

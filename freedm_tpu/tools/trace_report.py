@@ -1,0 +1,448 @@
+"""Skew-corrected causal round-timeline reconstructor.
+
+Input: one or more per-node trace JSONL files (``--trace-log``, the
+``/trace`` route, or ``tools/soak.py``'s per-slice collection), each a
+stream of span records and ``clock`` records as written by
+:mod:`freedm_tpu.core.tracing`.
+
+What it does, in order:
+
+1. **Merge** every file's spans by ``trace_id`` — a cross-node trace has
+   its round/phase spans on the originating node and its recv/handler
+   spans on the peers, stitched by the wire-propagated context.
+2. **Correct timestamps** with each node's clock-sync offset table: the
+   ``clock`` records journal the synchronizer's measured offset
+   (``virtual_now = clock() + offset``), so adding each node's offset
+   (nearest record at or before the span; the earliest one for spans
+   recorded before the first measurement) puts all spans on the fleet's
+   shared virtual clock.  Without this, a ±seconds host-clock skew makes
+   node B's handler appear to run *before* node A sent the message.
+3. **Reconstruct** the causal timeline per trace: the span tree in
+   corrected time, the **critical path** (the parent chain that ends at
+   the trace's latest-ending span — the chain an operator must shorten
+   to shorten the round), and **phase-overrun attribution** (which
+   node/phase blew its ``timings.cfg`` budget, how often, by how much).
+4. **Summarize** phase durations and DCN ack RTTs as p50/p95/p99 via
+   the fixed-bucket estimator (:func:`freedm_tpu.core.metrics
+   .estimate_quantiles`) — no external tooling needed.
+
+Usage::
+
+    python -m freedm_tpu.tools.trace_report trace_*.jsonl
+    python -m freedm_tpu.tools.trace_report trace_*.jsonl --json report.json
+    python -m freedm_tpu.tools.trace_report trace_*.jsonl --trace 1a2b3c...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from freedm_tpu.core.metrics import estimate_quantiles
+
+#: Fixed buckets (seconds) for the p50/p95/p99 estimates.
+_SUMMARY_BUCKETS = (
+    0.0002, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+# ---------------------------------------------------------------------------
+# load + clock correction
+# ---------------------------------------------------------------------------
+
+
+def load_records(paths: Sequence[str]) -> Tuple[List[dict], Dict[str, List[Tuple[float, float]]]]:
+    """Read trace files into (spans, clock tables).
+
+    The clock table maps node → [(ts, offset_s), ...] sorted by ts;
+    unparseable lines are skipped (a killed process can truncate its
+    last line mid-write).
+    """
+    spans: List[dict] = []
+    clocks: Dict[str, List[Tuple[float, float]]] = {}
+    for path in paths:
+        for line in Path(path).read_text(encoding="utf-8").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("rec") == "clock":
+                clocks.setdefault(rec.get("node", ""), []).append(
+                    (float(rec.get("ts", 0.0)), float(rec.get("offset_s", 0.0)))
+                )
+            elif "span_id" in rec:
+                spans.append(rec)
+    for tbl in clocks.values():
+        tbl.sort()
+    return spans, clocks
+
+
+def _offset_at(tbl: Optional[List[Tuple[float, float]]], t: float) -> float:
+    """The node's offset in force at raw time ``t``: the newest record
+    at or before ``t``, or the earliest record for spans predating the
+    first measurement (better than assuming zero skew)."""
+    if not tbl:
+        return 0.0
+    off = tbl[0][1]
+    for ts, o in tbl:
+        if ts <= t:
+            off = o
+        else:
+            break
+    return off
+
+
+def correct_timestamps(
+    spans: List[dict],
+    clocks: Dict[str, List[Tuple[float, float]]],
+    overrides: Optional[Dict[str, float]] = None,
+) -> List[dict]:
+    """Shift every span onto the shared virtual clock (in place).
+
+    ``overrides`` (``--offsets``) pins a node's offset regardless of its
+    journaled table.  The applied correction is kept on the span as
+    ``clock_offset_s``.
+    """
+    for s in spans:
+        node = s.get("node", "")
+        if overrides is not None and node in overrides:
+            off = float(overrides[node])
+        else:
+            off = _offset_at(clocks.get(node), float(s["t0"]))
+        s["t0"] = float(s["t0"]) + off
+        s["t1"] = float(s["t1"]) + off
+        s["clock_offset_s"] = round(off, 9)
+    return spans
+
+
+# ---------------------------------------------------------------------------
+# reconstruction
+# ---------------------------------------------------------------------------
+
+
+def build_traces(spans: List[dict]) -> Dict[str, dict]:
+    """Group spans into traces: ``{trace_id: {"spans", "by_id",
+    "children", "roots", "t0", "t1"}}``.  Roots are spans whose parent
+    is absent from the trace (the round span, or an orphaned subtree
+    whose originating node's file was not supplied)."""
+    traces: Dict[str, dict] = {}
+    for s in spans:
+        tr = traces.setdefault(
+            s["trace_id"],
+            {"spans": [], "by_id": {}, "children": {}, "roots": []},
+        )
+        if s["span_id"] in tr["by_id"]:
+            continue  # overlapping exports (file + /trace scrape) dedup
+        tr["spans"].append(s)
+        tr["by_id"][s["span_id"]] = s
+    for tr in traces.values():
+        tr["spans"].sort(key=lambda s: (s["t0"], s["t1"]))
+        for s in tr["spans"]:
+            pid = s.get("parent_id")
+            if pid is not None and pid in tr["by_id"]:
+                tr["children"].setdefault(pid, []).append(s)
+            else:
+                tr["roots"].append(s)
+        tr["t0"] = min(s["t0"] for s in tr["spans"])
+        tr["t1"] = max(s["t1"] for s in tr["spans"])
+    return traces
+
+
+def critical_path(trace: dict) -> List[dict]:
+    """The parent chain ending at the trace's latest-ending span — the
+    sequence of causally-linked operations that determined when the
+    trace finished (shorten any link, the trace ends earlier)."""
+    if not trace["spans"]:
+        return []
+    cur = max(trace["spans"], key=lambda s: s["t1"])
+    chain = [cur]
+    by_id = trace["by_id"]
+    while True:
+        pid = chain[-1].get("parent_id")
+        if pid is None or pid not in by_id:
+            break
+        chain.append(by_id[pid])
+    chain.reverse()
+    return chain
+
+
+def cross_node_links(trace: dict) -> int:
+    """Parent-child edges whose endpoints live on different nodes — the
+    wire-propagated causality the trace context exists to preserve."""
+    n = 0
+    for s in trace["spans"]:
+        pid = s.get("parent_id")
+        if pid is not None:
+            parent = trace["by_id"].get(pid)
+            if parent is not None and parent.get("node") != s.get("node"):
+                n += 1
+    return n
+
+
+def overrun_attribution(spans: List[dict]) -> Dict[str, dict]:
+    """Aggregate phase-overrun tags per (node, phase): how often each
+    phase blew its budget and by how much."""
+    out: Dict[str, dict] = {}
+    for s in spans:
+        if s.get("kind") != "phase":
+            continue
+        tags = s.get("tags") or {}
+        if not tags.get("overrun"):
+            continue
+        key = f"{s.get('node', '')}/{s['name']}"
+        agg = out.setdefault(
+            key, {"count": 0, "total_ms": 0.0, "max_ms": 0.0, "rounds": []}
+        )
+        over = float(tags.get("overrun_ms", 0.0))
+        agg["count"] += 1
+        agg["total_ms"] = round(agg["total_ms"] + over, 3)
+        agg["max_ms"] = round(max(agg["max_ms"], over), 3)
+        rnd = tags.get("round")
+        if rnd is not None and len(agg["rounds"]) < 50:
+            agg["rounds"].append(rnd)
+    return out
+
+
+def _quantile_summary(durations_by_key: Dict[str, List[float]]) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    bounds = np.asarray(_SUMMARY_BUCKETS, np.float64)
+    for key, vals in sorted(durations_by_key.items()):
+        arr = np.asarray(vals, np.float64)
+        idx = np.searchsorted(bounds, arr, side="left")
+        counts = np.bincount(idx, minlength=len(bounds) + 1)
+        qs = estimate_quantiles(bounds, counts)
+        if qs is None:
+            continue
+        out[key] = {
+            "count": int(arr.size),
+            "p50_ms": round(qs[0] * 1e3, 3),
+            "p95_ms": round(qs[1] * 1e3, 3),
+            "p99_ms": round(qs[2] * 1e3, 3),
+        }
+    return out
+
+
+def summaries(spans: List[dict]) -> Dict[str, dict]:
+    """Fleet-wide p50/p95/p99 of phase durations (per phase name) and
+    DCN ack RTTs (per node), from the fixed-bucket estimator."""
+    phases: Dict[str, List[float]] = {}
+    rtts: Dict[str, List[float]] = {}
+    for s in spans:
+        if s.get("kind") == "phase":
+            phases.setdefault(s["name"], []).append(s["t1"] - s["t0"])
+        elif s.get("kind") == "send":
+            rtt = (s.get("tags") or {}).get("rtt_s")
+            if rtt is not None:
+                rtts.setdefault(s.get("node", ""), []).append(float(rtt))
+    return {
+        "phase_ms": _quantile_summary(phases),
+        "ack_rtt_ms": _quantile_summary(rtts),
+    }
+
+
+# ---------------------------------------------------------------------------
+# report assembly + rendering
+# ---------------------------------------------------------------------------
+
+
+def report(
+    paths: Sequence[str],
+    offsets: Optional[Dict[str, float]] = None,
+    correct: bool = True,
+) -> dict:
+    """The full JSON artifact: corrected, merged, reconstructed."""
+    spans, clocks = load_records(paths)
+    if correct:
+        correct_timestamps(spans, clocks, overrides=offsets)
+    traces = build_traces(spans)
+    trace_out: Dict[str, dict] = {}
+    for tid, tr in traces.items():
+        cp = critical_path(tr)
+        trace_out[tid] = {
+            "spans": len(tr["spans"]),
+            "nodes": sorted({s.get("node", "") for s in tr["spans"]}),
+            "roots": [s["name"] for s in tr["roots"]],
+            "duration_ms": round((tr["t1"] - tr["t0"]) * 1e3, 3),
+            "cross_node_links": cross_node_links(tr),
+            "critical_path": [
+                {
+                    "name": s["name"],
+                    "kind": s.get("kind", ""),
+                    "node": s.get("node", ""),
+                    "start_ms": round((s["t0"] - tr["t0"]) * 1e3, 3),
+                    "dur_ms": round((s["t1"] - s["t0"]) * 1e3, 3),
+                }
+                for s in cp
+            ],
+            "tree": tr,  # stripped before JSON dump (internal use)
+        }
+    return {
+        "files": [str(p) for p in paths],
+        "nodes": sorted(
+            {s.get("node", "") for s in spans} | set(clocks.keys())
+        ),
+        "clock_offsets_s": {
+            n: round(tbl[-1][1], 6) for n, tbl in clocks.items() if tbl
+        },
+        "spans": len(spans),
+        "traces": trace_out,
+        "overruns": overrun_attribution(spans),
+        "summaries": summaries(spans),
+    }
+
+
+def _render_tree(tr: dict, out: List[str]) -> None:
+    t0 = tr["t0"]
+
+    def walk(span: dict, depth: int) -> None:
+        tags = span.get("tags") or {}
+        extra = []
+        if span.get("kind") == "send":
+            if "rtt_s" in tags:
+                extra.append(f"rtt={tags['rtt_s'] * 1e3:.1f}ms")
+            if tags.get("expired"):
+                extra.append("EXPIRED")
+            retr = sum(
+                1 for e in span.get("events", ()) if e.get("name") == "retransmit"
+            )
+            if retr:
+                extra.append(f"retransmits={retr}")
+        if tags.get("overrun"):
+            extra.append(f"OVERRUN +{tags['overrun_ms']:.1f}ms")
+        timers = sum(
+            1 for e in span.get("events", ()) if e.get("name") == "timer_fired"
+        )
+        if timers:
+            extra.append(f"timers={timers}")
+        out.append(
+            "  {:>9.3f}ms {:>9.3f}ms  {}{:<28s} {}{}".format(
+                (span["t0"] - t0) * 1e3,
+                (span["t1"] - span["t0"]) * 1e3,
+                "  " * depth,
+                span["name"],
+                span.get("node", ""),
+                ("  [" + " ".join(extra) + "]") if extra else "",
+            )
+        )
+        for child in sorted(
+            tr["children"].get(span["span_id"], ()), key=lambda s: s["t0"]
+        ):
+            walk(child, depth + 1)
+
+    for root in sorted(tr["roots"], key=lambda s: s["t0"]):
+        walk(root, 0)
+
+
+def render_text(rep: dict, top: int = 3, trace_id: Optional[str] = None) -> str:
+    """Human-readable report: summaries, overruns, and the span tree of
+    the ``top`` longest traces that have a round root (or one specific
+    trace via ``trace_id``)."""
+    out: List[str] = []
+    out.append(
+        f"trace report: {rep['spans']} spans, {len(rep['traces'])} traces, "
+        f"nodes: {', '.join(rep['nodes'])}"
+    )
+    if rep["clock_offsets_s"]:
+        out.append(
+            "clock offsets (s): "
+            + ", ".join(f"{n}={o:+.6f}" for n, o in rep["clock_offsets_s"].items())
+        )
+    for section, unit in (("phase_ms", "phase"), ("ack_rtt_ms", "ack rtt")):
+        rows = rep["summaries"].get(section) or {}
+        for key, q in rows.items():
+            out.append(
+                f"{unit:>8s} {key:<28s} n={q['count']:<6d} "
+                f"p50={q['p50_ms']}ms p95={q['p95_ms']}ms p99={q['p99_ms']}ms"
+            )
+    if rep["overruns"]:
+        out.append("phase overruns:")
+        for key, agg in sorted(rep["overruns"].items()):
+            out.append(
+                f"  {key:<36s} count={agg['count']} "
+                f"total=+{agg['total_ms']}ms max=+{agg['max_ms']}ms"
+            )
+    if trace_id is not None:
+        chosen = [tid for tid in rep["traces"] if tid.startswith(trace_id)]
+    else:
+        # Round-rooted traces first, the causally richest (cross-node
+        # links) before the merely long: that is where the latency
+        # story of a fleet round lives.
+        rounds_first = sorted(
+            rep["traces"],
+            key=lambda tid: (
+                "round" not in rep["traces"][tid]["roots"],
+                -rep["traces"][tid]["cross_node_links"],
+                -rep["traces"][tid]["duration_ms"],
+            ),
+        )
+        chosen = rounds_first[:top]
+    for tid in chosen:
+        tr_rep = rep["traces"][tid]
+        out.append(
+            f"\ntrace {tid}  {tr_rep['duration_ms']}ms  "
+            f"spans={tr_rep['spans']}  nodes={','.join(tr_rep['nodes'])}  "
+            f"cross-node links={tr_rep['cross_node_links']}"
+        )
+        _render_tree(tr_rep["tree"], out)
+        if len(tr_rep["critical_path"]) > 1:
+            out.append("  critical path:")
+            for s in tr_rep["critical_path"]:
+                out.append(
+                    f"    {s['start_ms']:>9.3f}ms +{s['dur_ms']:<9.3f}ms "
+                    f"{s['name']} [{s['node']}]"
+                )
+    return "\n".join(out)
+
+
+def _strip_internal(rep: dict) -> dict:
+    """Drop the in-memory tree objects before JSON serialization."""
+    out = dict(rep)
+    out["traces"] = {
+        tid: {k: v for k, v in tr.items() if k != "tree"}
+        for tid, tr in rep["traces"].items()
+    }
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Merge per-node trace files into a skew-corrected "
+        "causal round timeline"
+    )
+    ap.add_argument("files", nargs="+", help="trace JSONL files (one per node)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the full JSON artifact here")
+    ap.add_argument("--trace", default=None, metavar="ID",
+                    help="render only the trace(s) whose id starts with ID")
+    ap.add_argument("--top", type=int, default=3,
+                    help="how many round timelines to render (default 3)")
+    ap.add_argument("--offsets", default=None, metavar="PATH",
+                    help="JSON file {node: offset_s} overriding the "
+                         "journaled clock tables")
+    ap.add_argument("--no-correct", action="store_true",
+                    help="skip the clock-offset correction (raw host clocks)")
+    args = ap.parse_args(argv)
+    overrides = None
+    if args.offsets:
+        overrides = {
+            str(k): float(v)
+            for k, v in json.loads(Path(args.offsets).read_text()).items()
+        }
+    rep = report(args.files, offsets=overrides, correct=not args.no_correct)
+    print(render_text(rep, top=args.top, trace_id=args.trace))
+    if args.json:
+        Path(args.json).write_text(json.dumps(_strip_internal(rep), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

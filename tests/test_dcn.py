@@ -48,6 +48,54 @@ def test_wire_roundtrip():
         wire.decode_window(b"not json")
 
 
+def test_decode_window_tolerates_unknown_fields():
+    # Forward compatibility: a newer peer's datagram may carry frame
+    # keys (and top-level window keys) this build has never heard of —
+    # they are dropped, not a decode crash (old nodes must tolerate
+    # traced datagrams).
+    import json
+
+    gram = json.dumps({
+        "src": "hostA:50000",
+        "sent": 99.0,
+        "future_window_key": {"x": 1},
+        "frames": [{
+            "status": wire.MESSAGE, "seq": 5, "hash": "abc",
+            "msg": wire.pack_message(msg(1)),
+            "trace": {"trace_id": "t", "span_id": "s"},
+            "future_frame_key": [1, 2, 3],
+        }],
+    }).encode()
+    src, sent, frames = wire.decode_window(gram)
+    assert src == "hostA:50000" and sent == 99.0
+    assert frames[0].seq == 5
+    assert frames[0].trace == {"trace_id": "t", "span_id": "s"}
+    assert not hasattr(frames[0], "future_frame_key")
+    # Required fields still required; non-dict frames still malformed.
+    with pytest.raises(ValueError):
+        wire.decode_window(json.dumps(
+            {"src": "a", "sent": 0.0, "frames": [{"hash": "h"}]}
+        ).encode())
+    with pytest.raises(ValueError):
+        wire.decode_window(json.dumps(
+            {"src": "a", "sent": 0.0, "frames": [[1, 2]]}
+        ).encode())
+
+
+def test_wire_omits_null_fields_on_the_wire():
+    # None-valued frame fields put zero bytes on the wire (an untraced
+    # frame looks exactly like a pre-tracing frame to an old peer).
+    import json
+
+    f = wire.Frame(status=wire.ACCEPTED, seq=4, hash="def")
+    gram = wire.encode_window("u", [f], 0.0)
+    keys = set(json.loads(gram.decode())["frames"][0])
+    assert keys == {"status", "seq", "hash"}
+    # And the roundtrip restores dataclass defaults for absent keys.
+    _, _, out = wire.decode_window(gram)
+    assert out[0].kill is None and out[0].trace is None
+
+
 def test_wire_size_cap():
     big = ModuleMessage("lb", "x", {"blob": "y" * wire.MAX_PACKET_SIZE})
     with pytest.raises(ValueError, match="too long"):
@@ -425,6 +473,43 @@ def test_sender_size_check_uses_local_uuid():
     with pytest.raises(ValueError, match="too long"):
         ep.send("b", msg)
     ep.stop()
+
+
+def test_trace_propagation_survives_lossy_udp_channel():
+    # Satellite (PR 2): across a 40%-loss UDP link, every message must
+    # yield exactly ONE recv span (retransmissions and duplicates
+    # collapse in the accept logic), each parent-linked to its
+    # originating send span through the wire trace context.
+    from freedm_tpu.core import tracing
+
+    tracing.TRACER.configure(enabled=True, node="hostA:1")
+    got = []
+    ea = ep_mod.UdpEndpoint("hostA:1", resend_time_s=0.01, seed=7).start()
+    eb = ep_mod.UdpEndpoint("hostB:2", sink=got.append, resend_time_s=0.01).start()
+    try:
+        ea.connect("hostB:2", eb.address, reliability=60)  # 40% outgoing drop
+        eb.connect("hostA:1", ea.address)
+        for i in range(10):
+            ea.send("hostB:2", ModuleMessage("lb", "ping", {"i": i}, source="hostA:1"))
+        deadline = time.time() + 10.0
+        while len(got) < 10 and time.time() < deadline:
+            time.sleep(0.02)
+        recs = tracing.TRACER.tail()
+    finally:
+        ea.stop(); eb.stop()
+        tracing.TRACER.reset()
+    assert [m.payload["i"] for m in got] == list(range(10))
+    sends = {r["span_id"]: r for r in recs
+             if r["kind"] == "send" and r["tags"]["type"] == "ping"}
+    recvs = [r for r in recs if r["kind"] == "recv"]
+    # Exactly one recv span per message, despite loss + retransmission.
+    assert len(recvs) == 10
+    parents = [r["parent_id"] for r in recvs]
+    assert len(set(parents)) == 10 and all(p in sends for p in parents)
+    # Delivered messages carry the recv span as their context, so the
+    # sink (normally broker.deliver) parents handler spans causally.
+    recv_ids = {r["span_id"] for r in recvs}
+    assert all(m.trace["span_id"] in recv_ids for m in got)
 
 
 def test_large_backlog_does_not_kill_pump():

@@ -93,6 +93,33 @@ def test_snapshot_is_json_serializable():
     assert snap["c_seconds"]["values"][""]["count"] == 1
 
 
+def test_estimate_quantiles_from_fixed_buckets():
+    # Buckets (1, 2, 4) + overflow; one observation per finite bucket.
+    qs = M.estimate_quantiles((1.0, 2.0, 4.0), (1, 1, 1, 0), (0.5, 1.0))
+    # target 1.5 of 3: half-way through the (1, 2] bucket.
+    assert qs[0] == pytest.approx(1.5)
+    assert qs[1] == pytest.approx(4.0)
+    # Everything in the overflow bucket saturates at the last bound.
+    assert M.estimate_quantiles((1.0,), (0, 5))[0] == pytest.approx(1.0)
+    # Empty histograms have no quantiles.
+    assert M.estimate_quantiles((1.0, 2.0), (0, 0, 0)) is None
+
+
+def test_snapshot_histograms_carry_estimated_quantiles():
+    reg = M.MetricsRegistry()
+    h = reg.histogram("d_seconds", buckets=(0.1, 1.0, 10.0))
+    empty = reg.snapshot()["d_seconds"]["values"][""]
+    assert "p50" not in empty  # no estimates until data exists
+    for _ in range(10):
+        h.observe(0.05)
+    h.observe(5.0)
+    snap = reg.snapshot()["d_seconds"]["values"][""]
+    assert 0.0 < snap["p50"] <= 0.1
+    assert 1.0 < snap["p99"] <= 10.0
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+    json.dumps(snap)  # still a JSON-clean artifact
+
+
 # ---------------------------------------------------------------------------
 # SrChannel transport counters under loss
 # ---------------------------------------------------------------------------

@@ -1,0 +1,556 @@
+"""Causal distributed tracing tests (``freedm_tpu.core.tracing`` +
+``tools/trace_report.py``).
+
+Covers: span recorder semantics and the disabled-by-default no-op path;
+wire propagation across the SR protocol (a dropped-then-retransmitted
+frame yields exactly one recv/handler span, parented to the original
+send span); broker round/phase spans with timer annotations and overrun
+tags; solver spans tagging the jit-compile hit; the skew-corrected
+timeline reconstructor; and a 3-node fleet traced end-to-end across OS
+processes with deliberately skewed host clocks.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from freedm_tpu.core import tracing
+from freedm_tpu.dcn.protocol import SrChannel
+from freedm_tpu.runtime.broker import Broker
+from freedm_tpu.runtime.messages import ModuleMessage
+from freedm_tpu.runtime.module import DgiModule
+from freedm_tpu.tools import trace_report
+
+
+@pytest.fixture
+def traced(tmp_path):
+    """Enable the process tracer for one test; hard-reset afterwards so
+    the rest of the suite runs on the disabled no-op path."""
+    tracing.TRACER.configure(
+        enabled=True, node="test:1", path=str(tmp_path / "trace.jsonl")
+    )
+    yield tracing.TRACER
+    tracing.TRACER.reset()
+
+
+# ---------------------------------------------------------------------------
+# recorder semantics
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_tracer_is_noop():
+    assert not tracing.TRACER.enabled
+    s = tracing.TRACER.start("anything", kind="x", tags={"a": 1})
+    assert s is tracing.NOOP
+    s.tag(b=2).annotate("ev")
+    s.end()
+    assert s.context() is None
+    assert len(tracing.TRACER) == 0
+
+
+def test_span_tree_ring_and_file_export(traced, tmp_path):
+    with traced.start("outer", kind="round", tags={"round": 7}) as outer:
+        inner = traced.start("inner", kind="phase")  # implicit parent: outer
+        inner.annotate("tick", n=1)
+        inner.end()
+    recs = traced.tail()
+    assert [r["name"] for r in recs] == ["inner", "outer"]  # ended in order
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["inner"]["trace_id"] == by_name["outer"]["trace_id"]
+    assert by_name["inner"]["events"][0]["name"] == "tick"
+    assert by_name["outer"]["tags"] == {"round": 7}
+    assert by_name["outer"]["node"] == "test:1"
+    assert all(r["t1"] >= r["t0"] for r in recs)
+    # The JSONL export carries the same records.
+    on_disk = [
+        json.loads(l)
+        for l in (tmp_path / "trace.jsonl").read_text().splitlines()
+    ]
+    assert on_disk == recs
+    # trace_id filter on the flight recorder.
+    assert traced.tail(trace_id=by_name["outer"]["trace_id"]) == recs
+    assert traced.tail(trace_id="nope") == []
+
+
+def test_trace_file_rotates_once_past_max_bytes(tmp_path):
+    t = tracing.Tracer(max_bytes=800)
+    t.configure(enabled=True, node="n", path=str(tmp_path / "t.jsonl"))
+    for i in range(40):
+        t.start(f"span{i}", kind="x", tags={"pad": "y" * 10}).end()
+    t.close()
+    assert (tmp_path / "t.jsonl.1").exists(), "rotation never happened"
+    recs = [
+        json.loads(l) for l in (tmp_path / "t.jsonl").read_text().splitlines()
+    ]
+    assert recs and recs[-1]["name"] == "span39"
+
+
+def test_clock_records_are_deduplicated(traced):
+    traced.record_clock_offset(0.25)
+    traced.record_clock_offset(0.25)  # unchanged: no second record
+    traced.record_clock_offset(-0.1)
+    clocks = [r for r in traced.tail() if r.get("rec") == "clock"]
+    assert [c["offset_s"] for c in clocks] == [0.25, -0.1]
+    assert all(c["node"] == "test:1" for c in clocks)
+
+
+# ---------------------------------------------------------------------------
+# wire propagation: dropped-then-retransmitted frame, sans-IO
+# ---------------------------------------------------------------------------
+
+
+def msg(i):
+    return ModuleMessage("lb", "draft_request", {"i": i}, source="hostA:1")
+
+
+def test_retransmitted_frame_yields_one_recv_span_linked_to_send(traced):
+    a = SrChannel("hostB:2", resend_time_s=0.05, ttl_s=60.0, src_uuid="hostA:1")
+    b = SrChannel("hostA:1", resend_time_s=0.05, ttl_s=60.0, src_uuid="hostB:2")
+    a.send(msg(0), 0.0)
+    a.poll(0.0)  # first transmission: eaten by the wire
+    frames = a.poll(0.1)  # retransmission
+    delivered = b.on_frames(frames, 0.1)
+    assert [m.payload["i"] for m in delivered] == [0]
+    # The same frames arrive again (duplicate datagram): no new span.
+    assert b.on_frames([f for f in frames if f.msg is not None], 0.1) == []
+    a.on_frames(b.poll(0.1), 0.1)  # ACKs retire the window
+    recs = traced.tail()
+    sends = [r for r in recs if r["kind"] == "send"]
+    recvs = [r for r in recs if r["kind"] == "recv"]
+    assert len(sends) == 1 and len(recvs) == 1
+    assert recvs[0]["parent_id"] == sends[0]["span_id"]
+    assert recvs[0]["trace_id"] == sends[0]["trace_id"]
+    # The send span saw its retransmission, and its ACK (with an RTT).
+    assert any(e["name"] == "retransmit" for e in sends[0]["events"])
+    assert sends[0]["tags"]["acked"] is True
+    assert sends[0]["tags"]["rtt_s"] >= 0.0
+    # The delivered message's context now points at the recv span, so a
+    # downstream handler span chains send → recv → handler.
+    assert delivered[0].trace["span_id"] == recvs[0]["span_id"]
+
+
+def test_expired_send_span_is_tagged(traced):
+    a = SrChannel("hostB:2", resend_time_s=0.05, ttl_s=0.2, src_uuid="hostA:1")
+    b = SrChannel("hostA:1", resend_time_s=0.05, ttl_s=0.2, src_uuid="hostB:2")
+    a.send(msg(0), 0.0)
+    b.on_frames(a.poll(0.0), 0.0)  # SYN + msg 0 delivered...
+    a.on_frames(b.poll(0.0), 0.0)  # ...and ACKed: channel synced
+    a.send(msg(1), 0.1)
+    a.poll(0.1)  # transmitted once, eaten by the wire
+    a.poll(1.0)  # long past the TTL: the message dies at the sender
+    sends = {r["tags"]["seq"]: r for r in traced.tail() if r["kind"] == "send"}
+    expired = [s for s in sends.values() if s["tags"].get("expired")]
+    assert len(expired) == 1
+    assert "acked" not in expired[0]["tags"]
+    assert expired[0]["tags"]["type"] == "draft_request"
+
+
+def test_handler_span_parents_to_wire_context(traced):
+    class Sink(DgiModule):
+        name = "lb"
+
+        def run_phase(self, ctx):
+            pass
+
+        def handle_message(self, m, ctx=None):
+            pass
+
+    broker = Broker()
+    broker.register_module(Sink(), 10)
+    ctx = {"trace_id": "feedfacefeedface", "span_id": "abadcafe00000000"}
+    broker.deliver(
+        ModuleMessage("lb", "ping", {"x": 1}, source="hostB:2", trace=ctx)
+    )
+    broker.run_round()
+    handlers = [r for r in traced.tail() if r["kind"] == "handler"]
+    assert len(handlers) == 1
+    assert handlers[0]["trace_id"] == "feedfacefeedface"
+    assert handlers[0]["parent_id"] == "abadcafe00000000"
+    assert handlers[0]["tags"]["module"] == "lb"
+    assert handlers[0]["name"] == "handle:ping"
+    # Dispatch-to-execution wait of the phase-queued handler.
+    assert handlers[0]["tags"]["queue_ms"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# broker round/phase spans
+# ---------------------------------------------------------------------------
+
+
+def test_round_phase_spans_overrun_tags_and_timer_annotations(traced):
+    class Slow(DgiModule):
+        name = "slow"
+
+        def run_phase(self, ctx):
+            time.sleep(0.02)
+
+    broker = Broker()
+    broker.register_module(Slow(), 1)  # 1 ms budget: guaranteed overrun
+    timer = broker.allocate_timer("slow")
+    broker.schedule_timer(timer, 0.0, lambda: None)
+    broker.run_round()
+    recs = traced.tail()
+    rounds = [r for r in recs if r["kind"] == "round"]
+    phases = [r for r in recs if r["kind"] == "phase"]
+    assert len(rounds) == 1 and len(phases) == 1
+    ph = phases[0]
+    assert ph["parent_id"] == rounds[0]["span_id"]
+    assert ph["name"] == "phase:slow"
+    assert ph["tags"]["overrun"] is True and ph["tags"]["overrun_ms"] > 0
+    assert ph["tags"]["phase_ms"] >= 20.0
+    fired = [e for e in ph.get("events", ()) if e["name"] == "timer_fired"]
+    assert len(fired) == 1 and fired[0]["handle"] == timer
+
+
+def test_crashing_phase_still_lands_in_flight_recorder(traced):
+    class Boom(DgiModule):
+        name = "boom"
+
+        def run_phase(self, ctx):
+            raise RuntimeError("kaput")
+
+    broker = Broker()
+    broker.register_module(Boom(), 10)
+    with pytest.raises(RuntimeError, match="kaput"):
+        broker.run_round()
+    recs = traced.tail()
+    phases = [r for r in recs if r["kind"] == "phase"]
+    rounds = [r for r in recs if r["kind"] == "round"]
+    assert len(phases) == 1 and "kaput" in phases[0]["tags"]["error"]
+    assert len(rounds) == 1 and rounds[0]["tags"]["error"] is True
+    assert phases[0]["parent_id"] == rounds[0]["span_id"]
+
+
+def test_loopback_message_handler_parents_to_phase_span(traced):
+    class Echo(DgiModule):
+        name = "gm"
+
+        def run_phase(self, ctx):
+            pass
+
+        def handle_message(self, m, ctx=None):
+            pass
+
+    broker = Broker()
+    broker.register_module(Echo(), 10)
+    broker.deliver(ModuleMessage("gm", "hello", {}, source="x"))  # no trace ctx
+    broker.run_round()
+    recs = traced.tail()
+    phases = {r["span_id"]: r for r in recs if r["kind"] == "phase"}
+    handlers = [r for r in recs if r["kind"] == "handler"]
+    assert len(handlers) == 1
+    # Queued before the round: it executes inside the gm phase span.
+    assert handlers[0]["parent_id"] in phases
+
+
+# ---------------------------------------------------------------------------
+# solver spans
+# ---------------------------------------------------------------------------
+
+
+def test_solver_spans_tag_jit_compile_hit(traced):
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.newton import make_newton_solver
+
+    sys_ = synthetic_mesh(10, seed=0, load_mw=1.0, chord_frac=1.0)
+    solve, _ = make_newton_solver(sys_)
+    solve()
+    solve()
+    solves = [r for r in traced.tail() if r["kind"] == "solve"]
+    assert [s["tags"]["jit_compile"] for s in solves] == [True, False]
+    assert all(s["name"] == "pf.solve:newton" for s in solves)
+    # The compile-hit span dwarfs the steady-state dispatch span.
+    d0 = solves[0]["t1"] - solves[0]["t0"]
+    d1 = solves[1]["t1"] - solves[1]["t0"]
+    assert d0 > d1
+
+
+def test_late_enabled_tracer_does_not_mislabel_compile_hit(tmp_path):
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.newton import make_newton_solver
+
+    sys_ = synthetic_mesh(10, seed=0, load_mw=1.0, chord_frac=1.0)
+    solve, _ = make_newton_solver(sys_)
+    solve()  # the real jit compile happens here, untraced
+    tracing.TRACER.configure(enabled=True, node="late:1")
+    try:
+        solve()
+        solves = [r for r in tracing.TRACER.tail() if r["kind"] == "solve"]
+        assert len(solves) == 1
+        assert solves[0]["tags"]["jit_compile"] is False  # warm dispatch
+    finally:
+        tracing.TRACER.reset()
+
+
+def test_solver_under_vmap_records_no_bogus_spans(traced):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.newton import make_newton_solver
+
+    sys_ = synthetic_mesh(10, seed=0, load_mw=1.0, chord_frac=1.0)
+    _, solve_fixed = make_newton_solver(sys_, max_iter=4)
+    scale = np.random.default_rng(0).uniform(0.9, 1.1, (3, 1))
+    p = jnp.asarray(scale * np.asarray(sys_.p_inj)[None, :])
+    q = jnp.asarray(scale * np.asarray(sys_.q_inj)[None, :])
+    before = len([r for r in traced.tail() if r["kind"] == "solve"])
+    jax.vmap(lambda pi, qi: solve_fixed(p_inj=pi, q_inj=qi))(p, q)
+    after = len([r for r in traced.tail() if r["kind"] == "solve"])
+    assert after == before  # transformation traces record nothing
+
+
+# ---------------------------------------------------------------------------
+# trace_report: merge, clock correction, critical path, overruns
+# ---------------------------------------------------------------------------
+
+
+def _write_jsonl(path, records):
+    path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+
+
+def test_trace_report_corrects_cross_node_skew(tmp_path):
+    # Node B's host clock runs 5 s ahead of node A's; the synchronizer
+    # measured offsets that meet in the middle (virtual = raw + offset).
+    _write_jsonl(tmp_path / "a.jsonl", [
+        {"rec": "clock", "node": "A", "ts": 90.0, "offset_s": 2.5},
+        {"trace_id": "t1", "span_id": "s1", "name": "dcn.send",
+         "kind": "send", "node": "A", "t0": 100.0, "t1": 100.05,
+         "tags": {"peer": "B", "acked": True, "rtt_s": 0.05}},
+    ])
+    _write_jsonl(tmp_path / "b.jsonl", [
+        {"rec": "clock", "node": "B", "ts": 95.0, "offset_s": -2.5},
+        {"trace_id": "t1", "span_id": "r1", "parent_id": "s1",
+         "name": "dcn.recv", "kind": "recv", "node": "B",
+         "t0": 105.01, "t1": 105.01},
+        {"trace_id": "t1", "span_id": "h1", "parent_id": "r1",
+         "name": "handle:ping", "kind": "handler", "node": "B",
+         "t0": 105.012, "t1": 105.08},
+    ])
+    files = [str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")]
+
+    raw = trace_report.report(files, correct=False)
+    tr_raw = raw["traces"]["t1"]["tree"]
+    send_raw = tr_raw["by_id"]["s1"]
+    recv_raw = tr_raw["by_id"]["r1"]
+    assert recv_raw["t0"] - send_raw["t0"] == pytest.approx(5.01)
+
+    rep = trace_report.report(files)
+    tr = rep["traces"]["t1"]
+    tree = tr["tree"]
+    send, recv, handler = tree["by_id"]["s1"], tree["by_id"]["r1"], tree["by_id"]["h1"]
+    # Corrected onto the shared virtual clock: the recv happens 10 ms
+    # after the send, not 5 s after.
+    assert recv["t0"] - send["t0"] == pytest.approx(0.01)
+    assert handler["t0"] >= send["t0"]
+    assert rep["clock_offsets_s"] == {"A": 2.5, "B": -2.5}
+    # One causal tree, one cross-node edge, critical path send→recv→handler.
+    assert tr["cross_node_links"] == 1
+    assert [s["name"] for s in tr["critical_path"]] == [
+        "dcn.send", "dcn.recv", "handle:ping"
+    ]
+    assert tr["nodes"] == ["A", "B"]
+    # The human rendering and JSON stripping both hold together.
+    text = trace_report.render_text(rep)
+    assert "dcn.send" in text and "handle:ping" in text
+    json.dumps(trace_report._strip_internal(rep))
+
+
+def test_trace_report_overrun_attribution_and_summaries(tmp_path):
+    _write_jsonl(tmp_path / "a.jsonl", [
+        {"trace_id": "t1", "span_id": "p1", "name": "phase:lb",
+         "kind": "phase", "node": "A", "t0": 10.0, "t1": 10.3,
+         "tags": {"round": 4, "budget_ms": 150, "overrun": True,
+                  "overrun_ms": 150.0, "phase_ms": 300.0}},
+        {"trace_id": "t2", "span_id": "p2", "name": "phase:lb",
+         "kind": "phase", "node": "A", "t0": 11.0, "t1": 11.1,
+         "tags": {"round": 5, "budget_ms": 150, "phase_ms": 100.0}},
+    ])
+    rep = trace_report.report([str(tmp_path / "a.jsonl")])
+    assert rep["overruns"] == {
+        "A/phase:lb": {"count": 1, "total_ms": 150.0, "max_ms": 150.0,
+                       "rounds": [4]}
+    }
+    q = rep["summaries"]["phase_ms"]["phase:lb"]
+    assert q["count"] == 2
+    assert 100.0 <= q["p50_ms"] <= 300.0
+    assert "OVERRUN" in trace_report.render_text(rep)
+
+
+# ---------------------------------------------------------------------------
+# 3-node fleet, end-to-end across OS processes with skewed host clocks
+# ---------------------------------------------------------------------------
+
+FLEET_CHILD = textwrap.dedent("""
+    import sys, time
+    sys.path.insert(0, "__REPO__")
+    from freedm_tpu.core import tracing
+    from freedm_tpu.dcn.endpoint import UdpEndpoint
+    from freedm_tpu.runtime.broker import Broker
+    from freedm_tpu.runtime.clocksync import ClockSynchronizer
+    from freedm_tpu.runtime.messages import ModuleMessage
+    from freedm_tpu.runtime.module import DgiModule
+
+    trace_path, port, skew = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+    peers = sys.argv[4:]
+    uuid = "127.0.0.1:%d" % port
+    clock = lambda: time.time() + skew  # this host's (skewed) wall clock
+    tracing.TRACER.configure(enabled=True, node=uuid, path=trace_path,
+                             clock=clock)
+
+    class Pinger(DgiModule):
+        name = "lb"
+        sent_rounds = 0
+        def run_phase(self, ctx):
+            # Ping peers only once the clock sync demonstrably
+            # converged: every peer's regression holds >= 8 sample
+            # pairs (pings sent earlier would be corrected with a
+            # half-formed offset table).
+            if self.sent_rounds >= 6:
+                return
+            ready = all(
+                len(clk._responses.get(p, ())) >= 16 for p in peers
+            )
+            if ready:
+                self.sent_rounds += 1
+                for p in peers:
+                    ep.send(p, ModuleMessage("lb", "ping",
+                                             {"r": ctx.round_index},
+                                             source=uuid))
+        def handle_message(self, m, ctx=None):
+            pass
+
+    broker = Broker(clock=clock)
+    broker.register_module(Pinger(), 40)  # one 40 ms phase per round
+    ep = UdpEndpoint(uuid, bind=("127.0.0.1", port), sink=broker.deliver,
+                     resend_time_s=0.02)
+    for p in peers:
+        host, _, pp = p.rpartition(":")
+        ep.connect(p, (host, int(pp)))
+    clk = ClockSynchronizer(uuid, peers, ep.send, clock=clock,
+                            query_interval_s=0.2)
+    broker.attach_clock_sync(clk)
+    ep.start()
+    # Generous tail (rounds past the ping window + drain sleep): the
+    # three children start staggered under load, and a peer that exits
+    # early would leave this node's last sends un-ACKed — their spans
+    # would never close.
+    broker.run(n_rounds=120, realtime=True)
+    time.sleep(1.0)
+    ep.stop()
+    tracing.TRACER.close()
+""")
+
+
+def _run_three_node_fleet(workdir):
+    """Spawn the three skewed children; return the trace file paths."""
+    import os
+
+    from test_federation import free_udp_ports
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    workdir.mkdir(parents=True, exist_ok=True)
+    ports = free_udp_ports(3)
+    uuids = [f"127.0.0.1:{p}" for p in ports]
+    skews = [-2.0, 0.0, 2.0]
+    files = [workdir / f"trace_{p}.jsonl" for p in ports]
+    procs = []
+    for i, port in enumerate(ports):
+        peers = [u for u in uuids if u != uuids[i]]
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", FLEET_CHILD.replace("__REPO__", repo),
+             str(files[i]), str(port), str(skews[i]), *peers],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        ))
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()
+    return [str(f) for f in files], uuids, skews
+
+
+def test_three_node_fleet_traced_end_to_end(tmp_path):
+    """The acceptance scenario: three OS processes with host clocks
+    skewed by up to 4 s, federated over real UDP with clock sync.  The
+    merged report must show round spans from every node, cross-node
+    message spans parent-linked through the wire trace context, and
+    timestamps corrected by the journaled clocksync offsets.
+
+    Multi-process + wall-clock regression = inherently load-sensitive,
+    so a failed scenario is retried once before the assertions count.
+    """
+    last = None
+    for attempt in range(2):
+        try:
+            _assert_three_node_fleet(tmp_path / f"attempt{attempt}")
+            return
+        except AssertionError as e:
+            last = e
+    raise last
+
+
+def _assert_three_node_fleet(workdir):
+    paths, uuids, skews = _run_three_node_fleet(workdir)
+    spans, clocks = trace_report.load_records(paths)
+    # Every node journaled rounds and clock offsets.
+    assert {s["node"] for s in spans if s["kind"] == "round"} == set(uuids)
+    assert set(clocks) == set(uuids)
+    # The synchronizer measured (roughly) the injected skews: corrected
+    # clocks meet near the fleet mean, so each offset ≈ -skew.  The
+    # tolerance is loose — under CI load convergence is slower, and the
+    # acceptance-critical property (corrected cross-node deltas) is
+    # asserted separately below.
+    final = {n: tbl[-1][1] for n, tbl in clocks.items()}
+    for uuid, skew in zip(uuids, skews):
+        assert final[uuid] == pytest.approx(-skew, abs=0.8), final
+
+    rep = trace_report.report(paths)
+    cross = {
+        tid: tr for tid, tr in rep["traces"].items()
+        if tr["cross_node_links"] > 0
+    }
+    assert cross, "no cross-node parent-linked spans survived"
+    # Pick the traced pings (sent AFTER the synchronizer converged —
+    # spans from the bootstrap clk exchanges predate any offset
+    # measurement and are uncorrectable by construction): each send
+    # (node A) and the peer's recv must be a parent-linked pair on
+    # DIFFERENT nodes.  After correction, causality must hold (a recv
+    # cannot precede its send beyond the correction noise) and the
+    # typical pair must sit close together despite the 4 s raw clock
+    # spread — individual pairs may carry genuine delivery latency
+    # (retransmissions under load), so the upper bound is a median.
+    deltas = []
+    for tr in cross.values():
+        tree = tr["tree"]
+        for s in tree["spans"]:
+            if s["kind"] != "recv":
+                continue
+            parent = tree["by_id"].get(s.get("parent_id"))
+            if (parent is None or parent["kind"] != "send"
+                    or parent["tags"].get("type") != "ping"):
+                continue
+            assert parent["node"] != s["node"]
+            deltas.append(s["t0"] - parent["t0"])
+    assert deltas
+    assert all(d > -0.5 for d in deltas), deltas  # causality restored
+    assert sorted(deltas)[len(deltas) // 2] < 0.5, deltas
+    # A cross-node trace roots in the sending node's round span.
+    assert any("round" in tr["roots"] for tr in cross.values())
+    # And the raw (uncorrected) stamps really were seconds apart — the
+    # correction did the work, not clock luck.
+    raw = trace_report.report(paths, correct=False)
+    raw_deltas = []
+    for tr in raw["traces"].values():
+        tree = tr["tree"]
+        for s in tree["spans"]:
+            parent = tree["by_id"].get(s.get("parent_id"))
+            if (parent is not None and s["kind"] == "recv"
+                    and parent["kind"] == "send"
+                    and parent["node"] != s["node"]):
+                raw_deltas.append(abs(s["t0"] - parent["t0"]))
+    assert raw_deltas and max(raw_deltas) > 1.0
