@@ -64,6 +64,8 @@ class Runtime:
     telemetry: Optional[object] = None  # TelemetryModule
     mesh: Optional[object] = None  # MeshFleetModule in --mesh-devices mode
     metrics_server: Optional[object] = None  # MetricsServer (--metrics-port)
+    serve_service: Optional[object] = None  # serve.Service (--serve-port)
+    serve_server: Optional[object] = None  # serve.ServeServer (--serve-port)
 
     def start(self) -> "Runtime":
         if self.endpoint is not None:
@@ -77,6 +79,10 @@ class Runtime:
             f.stop()
         if self.endpoint is not None:
             self.endpoint.stop()
+        if self.serve_server is not None:
+            self.serve_server.stop()
+        if self.serve_service is not None:
+            self.serve_service.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
 
@@ -122,6 +128,16 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--trace-log", default=None, metavar="PATH",
                     help="enable causal tracing and append finished spans "
                          "to PATH (JSONL; also served at /trace)")
+    ap.add_argument("--serve-port", type=int, default=None, metavar="PORT",
+                    help="serve the JSON what-if query API (pf/N-1/VVC) on "
+                         "PORT (0 = ephemeral; unset = disabled)")
+    ap.add_argument("--serve-max-batch", type=int, default=None, metavar="N",
+                    help="lanes per micro-batch dispatch (default 64)")
+    ap.add_argument("--serve-max-wait-ms", type=float, default=None,
+                    metavar="MS", help="batch coalescing window (default 2)")
+    ap.add_argument("--serve-queue-depth", type=int, default=None, metavar="N",
+                    help="admission bound in lanes; beyond it requests shed "
+                         "with a typed overloaded error (default 512)")
     ap.add_argument("--migration-step", type=float, default=None,
                     help="size of LB power migrations")
     ap.add_argument("--malicious-behavior", action="store_true", default=None,
@@ -158,6 +174,9 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("resume", "resume"),
         ("metrics_port", "metrics_port"), ("events_log", "events_log"),
         ("trace_log", "trace_log"),
+        ("serve_port", "serve_port"), ("serve_max_batch", "serve_max_batch"),
+        ("serve_max_wait_ms", "serve_max_wait_ms"),
+        ("serve_queue_depth", "serve_queue_depth"),
         ("migration_step", "migration_step"),
         ("malicious_behavior", "malicious_behavior"),
         ("check_invariant", "check_invariant"), ("verbose", "verbose"),
@@ -405,9 +424,26 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             f"metrics: http://127.0.0.1:{metrics_server.port}/metrics "
             f"(events: /events)"
         )
+    serve_service = serve_server = None
+    if cfg.serve_port is not None:
+        # The what-if query service (freedm_tpu.serve): rides alongside
+        # the broker loop — solver engines compile lazily per served
+        # case, so an unqueried server costs one idle thread.
+        from freedm_tpu.serve import ServeConfig, ServeServer, Service
+
+        serve_service = Service(ServeConfig(
+            max_batch=cfg.serve_max_batch,
+            max_wait_ms=cfg.serve_max_wait_ms,
+            queue_depth=cfg.serve_queue_depth,
+        ))
+        serve_server = ServeServer(serve_service, port=cfg.serve_port).start()
+        logger.status(
+            f"serve: http://127.0.0.1:{serve_server.port}/v1/pf "
+            f"(n1: /v1/n1, vvc: /v1/vvc, health: /healthz)"
+        )
     return Runtime(
         cfg, timings, broker, fleet, factories, vvc, endpoint, federation,
-        telemetry, mesh_mod, metrics_server,
+        telemetry, mesh_mod, metrics_server, serve_service, serve_server,
     )
 
 

@@ -254,6 +254,14 @@ class GlobalConfig:
     # recorder costs nothing until asked for); spans also land in the
     # in-memory ring served by the metrics server's /trace route.
     trace_log: Optional[str] = None
+    # Query serving (freedm_tpu.serve): TCP port for the JSON what-if
+    # endpoint (0 = ephemeral, None = disabled), and the micro-batcher
+    # knobs — lanes per dispatch, coalescing window, admission bound in
+    # lanes (past it requests shed with a typed `overloaded` error).
+    serve_port: Optional[int] = None
+    serve_max_batch: int = 64
+    serve_max_wait_ms: float = 2.0
+    serve_queue_depth: int = 512
 
     @property
     def uuid(self) -> str:

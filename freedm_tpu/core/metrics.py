@@ -460,7 +460,34 @@ class JsonlEventJournal:
             return len(self._ring)
 
 
-class MetricsServer:
+class BackgroundHttpServer:
+    """Shared scaffold for the framework's zero-dependency HTTP
+    endpoints (this module's :class:`MetricsServer`, the serve front
+    end): a ``ThreadingHTTPServer`` with daemon worker threads, run on
+    a daemon thread by :meth:`start`; ``port=0`` binds an ephemeral
+    port (read back from ``.port``)."""
+
+    def __init__(self, handler_cls, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, int(port)), handler_cls)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+class MetricsServer(BackgroundHttpServer):
     """Zero-dependency exposition endpoint (``--metrics-port``).
 
     ``GET /metrics`` — Prometheus text format of the registry;
@@ -526,23 +553,7 @@ class MetricsServer:
                 else:
                     self._reply(404, "not found\n", "text/plain; charset=utf-8")
 
-        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
-        self._httpd.daemon_threads = True
-        self.port = int(self._httpd.server_address[1])
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self) -> "MetricsServer":
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
-        )
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
+        super().__init__(Handler, port=port, host=host)
 
 
 # ---------------------------------------------------------------------------
@@ -630,6 +641,34 @@ CKPT_SAVES = REGISTRY.counter(
     "checkpoint_saves_total", "Round-boundary checkpoints written")
 CKPT_RESTORES = REGISTRY.counter(
     "checkpoint_restores_total", "Checkpoints restored into a fresh stack")
+
+# -- query serving (freedm_tpu.serve) ---------------------------------------
+SERVE_REQUESTS = REGISTRY.counter(
+    "serve_requests_total",
+    "Serving requests by final outcome "
+    "(ok/invalid/overloaded/deadline/shutdown/error)",
+    labels=("workload", "outcome"))
+SERVE_SHED = REGISTRY.counter(
+    "serve_shed_total",
+    "Requests rejected at admission because the queue was at depth")
+SERVE_RECOMPILES = REGISTRY.counter(
+    "serve_recompiles_total",
+    "First dispatches of a (workload, case, bucket) shape — each is one "
+    "synchronous XLA compile; bounded by the bucket table",
+    labels=("workload",))
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "serve_queue_depth", "Lanes admitted but not yet dispatched")
+SERVE_BATCH_LANES = REGISTRY.histogram(
+    "serve_batch_lanes", "Real (pre-padding) lanes per dispatched batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256), labels=("workload",))
+SERVE_QUEUE_WAIT = REGISTRY.histogram(
+    "serve_queue_wait_seconds", "Admission to batch dispatch, per request",
+    buckets=(0.0005, 0.002, 0.005, 0.02, 0.05, 0.2, 0.5, 2.0, 10.0))
+SERVE_SOLVE_LATENCY = REGISTRY.histogram(
+    "serve_solve_seconds",
+    "Batched solve wall time (block_until_ready), per dispatch",
+    buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.5, 1.0, 5.0, 20.0),
+    labels=("workload",))
 
 
 def observe_pf_result(solver: str, result) -> None:

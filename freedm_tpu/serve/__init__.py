@@ -1,0 +1,30 @@
+"""Micro-batching query serving for power-flow, N-1, and VVC what-ifs.
+
+See ``docs/serving.md``.  Pieces: admission + typed errors
+(:mod:`freedm_tpu.serve.queue`), the coalescing/bucketing dispatcher
+(:mod:`freedm_tpu.serve.batcher`), the typed workloads and the
+:class:`Service` facade (:mod:`freedm_tpu.serve.service`), and the JSON
+front end (:mod:`freedm_tpu.serve.http`, CLI ``--serve-port``).
+"""
+
+from freedm_tpu.serve.queue import (  # noqa: F401
+    AdmissionQueue,
+    DeadlineExceeded,
+    InvalidRequest,
+    Overloaded,
+    ServeError,
+    ShuttingDown,
+)
+from freedm_tpu.serve.service import (  # noqa: F401
+    N1Request,
+    N1Response,
+    PowerFlowRequest,
+    PowerFlowResponse,
+    ServeConfig,
+    Service,
+    VVCRequest,
+    VVCResponse,
+    default_buckets,
+    parse_request,
+)
+from freedm_tpu.serve.http import ServeServer  # noqa: F401

@@ -1,0 +1,165 @@
+"""Micro-batching dispatch: coalesce, bucket, solve once, scatter.
+
+The serving economics this module exists for: a jitted ``vmap``-ed
+solve's wall time is dominated by dispatch/launch overhead at snapshot
+sizes, so 32 coalesced power-flow lanes cost barely more than one.
+SABLE's batched power flow and Podracer's centralized-batched compute
+(PAPERS.md) both hinge on exactly this; the batcher is the host-side
+machinery that converts concurrent independent requests into that one
+batched device program:
+
+- **Coalescing window** — the first admitted ticket opens a batch; the
+  batcher then drains *compatible* tickets (same (workload, case) key)
+  for up to ``max_wait_ms`` or until ``max_batch`` lanes, whichever
+  first.  A lone request therefore pays the full window (2 ms default)
+  waiting for peers that never come — that flat cost IS the price of
+  coalescing at low load, which is why ``max_wait_ms`` must stay well
+  under a single solve time; a full batch dispatches the moment it
+  fills.
+- **Shape buckets** — the real lane count is padded up to the smallest
+  bucket (default: powers of two ≤ ``max_batch``), so XLA compiles at
+  most ``len(buckets)`` programs per engine, ever.  The first dispatch
+  of each (engine, bucket) is counted on ``serve_recompiles_total`` —
+  the compile storm is bounded *and observable*.
+- **Scatter** — per-request responses (with each request's own lanes
+  sliced back out) resolve the waiters' futures; a solver exception
+  fails the whole batch's tickets with a typed ``internal`` error
+  rather than hanging them.
+
+One dispatch thread per service is deliberate: the solvers share one
+device, so a second dispatcher would only interleave compiles and
+ruin the latency accounting.  Spans: each dispatch records
+``serve.batch`` (parented to the oldest request's ``serve.request``
+span) with a child ``pf.solve`` span around the device work, so
+``/trace`` and ``tools/trace_report.py`` explain serving tails with
+the same machinery that explains broker rounds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from freedm_tpu.core import metrics as obs
+from freedm_tpu.core import tracing
+from freedm_tpu.serve.queue import ServeError, Ticket
+
+
+class _InternalError(ServeError):
+    code = "internal"
+    http_status = 500
+
+
+class MicroBatcher:
+    """The dispatch loop (one daemon thread per :class:`~freedm_tpu.serve.service.Service`)."""
+
+    def __init__(self, service, config):
+        self.service = service
+        self.config = config
+        self.buckets = config.bucket_table()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="serve-batcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    # -- bucketing -----------------------------------------------------------
+    def bucket_for(self, lanes: int) -> int:
+        for b in self.buckets:
+            if lanes <= b:
+                return b
+        return self.buckets[-1]
+
+    # -- main loop -----------------------------------------------------------
+    def _run(self) -> None:
+        q = self.service.queue
+        window_s = max(self.config.max_wait_ms, 0.0) / 1000.0
+        while not self._stop.is_set():
+            head = q.pop(timeout=0.2)
+            if head is None:
+                continue
+            group = [head]
+            lanes = head.lanes
+            window_end = time.monotonic() + window_s
+            while lanes < self.config.max_batch:
+                remaining = window_end - time.monotonic()
+                if remaining <= 0:
+                    break
+                t = q.pop_compatible(
+                    head.key, self.config.max_batch - lanes, remaining
+                )
+                if t is None:
+                    break
+                group.append(t)
+                lanes += t.lanes
+            self._dispatch(group, lanes)
+
+    # -- dispatch ------------------------------------------------------------
+    def _dispatch(self, group: List[Ticket], lanes: int) -> None:
+        workload, case = group[0].key
+        engine = self.service.engine(workload, case)
+        bucket = self.bucket_for(lanes)
+        now = time.monotonic()
+        # One array observe for the whole batch (histogram observe is
+        # vectorized; per-ticket calls were measurable on the hot path).
+        obs.SERVE_QUEUE_WAIT.observe(
+            [max(now - t.enqueued_at, 0.0) for t in group]
+        )
+        obs.SERVE_BATCH_LANES.labels(workload).observe(lanes)
+
+        new_shape = bucket not in engine.compiled_buckets
+        if new_shape:
+            obs.SERVE_RECOMPILES.labels(workload).inc()
+
+        span = tracing.TRACER.start(
+            "serve.batch", kind="serve",
+            parent_ctx=group[0].span.context(),
+            tags={"workload": workload, "case": case, "requests": len(group),
+                  "lanes": lanes, "bucket": bucket},
+        )
+        try:
+            with span.activate():
+                batch = engine.assemble(group, bucket)
+                t0 = time.monotonic()
+                with tracing.TRACER.start(
+                    f"pf.solve:{workload}", kind="solve",
+                    tags={"solver": workload, "bucket": bucket,
+                          "jit_compile": new_shape},
+                ):
+                    results = engine.solve(batch)
+                solve_s = time.monotonic() - t0
+                engine.compiled_buckets.add(bucket)
+                obs.SERVE_SOLVE_LATENCY.labels(workload).observe(solve_s)
+
+                from freedm_tpu.serve.service import BatchInfo
+
+                info = BatchInfo(
+                    lanes=lanes,
+                    bucket=bucket,
+                    queue_ms=round((now - group[0].enqueued_at) * 1e3, 3),
+                    solve_ms=round(solve_s * 1e3, 3),
+                )
+                engine.scatter(group, results, info)
+            span.tag(solve_ms=round(solve_s * 1e3, 3))
+            span.end()
+            for t in group:
+                self.service._complete_ok(t, info)
+        except Exception as e:  # noqa: BLE001 — waiters must never hang
+            span.tag(error=repr(e))
+            span.end()
+            err = _InternalError(f"batch dispatch failed: {e!r}")
+            for t in group:
+                self.service._complete_error(t, err)

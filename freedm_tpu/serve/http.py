@@ -1,0 +1,116 @@
+"""Zero-dependency JSON front end for the query service.
+
+Same machinery as the metrics exposition endpoint
+(:class:`freedm_tpu.core.metrics.MetricsServer`): stdlib
+``ThreadingHTTPServer`` on a daemon thread, loopback bind by default,
+ephemeral port when asked for 0.  One OS thread per in-flight request
+is exactly what the micro-batcher wants — concurrent waiters are what
+it coalesces.
+
+Routes:
+
+- ``POST /v1/pf`` / ``POST /v1/n1`` / ``POST /v1/vvc`` — a JSON body
+  matching the workload's request record
+  (:mod:`freedm_tpu.serve.service`); 200 with the typed response dict
+  on success.
+- ``GET /healthz`` — liveness + the workload/case table.
+- ``GET /stats`` — queue depth, bucket table, serve metric snapshot.
+
+Errors are *typed*, never free-text-only: the body is always
+``{"error": {"type": <ServeError.code>, "detail": ...}}`` with the
+matching HTTP status (400 invalid_request, 429 overloaded, 503
+shutting_down, 504 deadline_exceeded, 500 internal).  Clients switch on
+``error.type``; 429/503 mean back off and retry, 400/504 mean don't.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler
+from urllib.parse import urlparse
+
+from freedm_tpu.core.metrics import BackgroundHttpServer
+from freedm_tpu.serve.queue import InvalidRequest, ServeError
+from freedm_tpu.serve.service import BUS_CASES, FEEDER_CASES, WORKLOADS, Service
+
+#: Request bodies past this are rejected before parsing (a 256-outage
+#: N-1 request is ~2 KB; nothing legitimate approaches a megabyte).
+MAX_BODY_BYTES = 4_000_000
+
+
+class ServeServer(BackgroundHttpServer):
+    """``--serve-port``: the JSON query endpoint."""
+
+    def __init__(self, service: Service, port: int = 0,
+                 host: str = "127.0.0.1"):
+        # Loopback by default, like the metrics server: the service has
+        # no auth; widening the bind is an explicit caller decision.
+        svc = service
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # load generators must not spam stderr
+                pass
+
+            def _reply(self, code: int, obj) -> None:
+                data = (json.dumps(obj) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _error(self, err: ServeError) -> None:
+                self._reply(err.http_status,
+                            {"error": {"type": err.code, "detail": str(err)}})
+
+            def do_GET(self):
+                path = urlparse(self.path).path
+                if path == "/healthz":
+                    self._reply(200, {
+                        "ok": True,
+                        "workloads": list(WORKLOADS),
+                        "bus_cases": list(BUS_CASES),
+                        "feeder_cases": list(FEEDER_CASES),
+                    })
+                elif path == "/stats":
+                    self._reply(200, svc.stats())
+                elif path == "/":
+                    self._reply(200, {
+                        "service": "freedm_tpu serve",
+                        "post": [f"/v1/{w}" for w in WORKLOADS],
+                        "get": ["/healthz", "/stats"],
+                    })
+                else:
+                    self._reply(404, {"error": {"type": "not_found",
+                                                "detail": path}})
+
+            def do_POST(self):
+                path = urlparse(self.path).path
+                if not path.startswith("/v1/"):
+                    self._reply(404, {"error": {"type": "not_found",
+                                                "detail": path}})
+                    return
+                workload = path[len("/v1/"):]
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    if length <= 0:
+                        raise InvalidRequest("missing JSON request body")
+                    if length > MAX_BODY_BYTES:
+                        raise InvalidRequest(
+                            f"request body over {MAX_BODY_BYTES} bytes"
+                        )
+                    try:
+                        payload = json.loads(self.rfile.read(length))
+                    except ValueError as e:
+                        raise InvalidRequest(f"malformed JSON: {e}") from None
+                    response = svc.request(workload, payload)
+                    self._reply(200, response.to_dict())
+                except ServeError as e:
+                    self._error(e)
+                except Exception as e:  # noqa: BLE001 — always answer typed
+                    self._reply(500, {"error": {"type": "internal",
+                                                "detail": repr(e)}})
+
+        super().__init__(Handler, port=port, host=host)

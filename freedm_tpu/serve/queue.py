@@ -1,0 +1,280 @@
+"""Admission control for the query-serving subsystem.
+
+The serving path must never "queue forever": a grid operator's what-if
+console and a planning screen's 500-outage sweep share one device, and
+the only honest behaviors under overload are (a) a bounded wait and
+(b) an explicit, *typed* rejection the client can back off on.  This
+module is that boundary:
+
+- the :class:`ServeError` hierarchy — every way a request can fail
+  without an answer, each with a stable wire ``code`` and an HTTP status
+  the front end (:mod:`freedm_tpu.serve.http`) maps directly;
+- :class:`Ticket` — one admitted request: its validated payload, its
+  lane weight (an N-1 screen of 40 outages costs 40 lanes, a power-flow
+  snapshot costs 1), its monotonic deadline, and the future its waiter
+  blocks on;
+- :class:`AdmissionQueue` — a bounded FIFO measured in *lanes*, not
+  requests, so a single huge screen cannot sneak past a depth limit
+  sized for snapshots.  ``put`` raises :class:`Overloaded` instead of
+  blocking (shed-on-overload; the client retries with backoff, the
+  server's latency distribution stays bounded); expired tickets are
+  completed with :class:`DeadlineExceeded` at pop time so a stale
+  request never wastes a solve.
+
+Depth accounting feeds the ``serve_queue_depth`` gauge
+(:mod:`freedm_tpu.core.metrics`) on every transition, so a scrape sees
+backpressure building before the shed counter moves.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+
+class ServeError(Exception):
+    """Base of the typed serving errors.
+
+    ``code`` is the stable wire identifier (the JSON ``error.type``
+    field); ``http_status`` is the front-end mapping.  Clients switch on
+    ``code``, never on the message text.
+    """
+
+    code = "internal"
+    http_status = 500
+
+
+class Overloaded(ServeError):
+    """Admission rejected: the queue is at depth.  Shed-on-overload is
+    deliberate — rejecting now with a typed error beats an unbounded
+    queue whose p99 grows with depth."""
+
+    code = "overloaded"
+    http_status = 429
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline passed before a batch picked it up."""
+
+    code = "deadline_exceeded"
+    http_status = 504
+
+
+class InvalidRequest(ServeError):
+    """The request failed validation (unknown case, wrong vector length,
+    islanding outage, non-finite values, ...)."""
+
+    code = "invalid_request"
+    http_status = 400
+
+
+class ShuttingDown(ServeError):
+    """The service is stopping; queued requests are drained with this."""
+
+    code = "shutting_down"
+    http_status = 503
+
+
+class Ticket:
+    """One admitted request, queued for a batch slot."""
+
+    __slots__ = (
+        "key", "request", "prepared", "lanes", "enqueued_at",
+        "deadline", "future", "span", "taken",
+    )
+
+    def __init__(self, key: Tuple[str, str], request, prepared, lanes: int,
+                 deadline: Optional[float], span=None):
+        self.key = key  # (workload, case) — only same-key tickets batch
+        self.request = request
+        self.prepared = prepared  # engine-validated arrays
+        self.lanes = int(lanes)
+        self.enqueued_at = time.monotonic()
+        self.deadline = deadline  # monotonic, or None
+        self.future: Future = Future()
+        self.span = span  # serve.request span (or tracing NOOP)
+        self.taken = False  # popped from one index; lazily dropped from the other
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) > self.deadline
+
+
+class AdmissionQueue:
+    """Bounded FIFO of :class:`Ticket`\\ s, measured in lanes.
+
+    Two indexes over the same tickets, both O(1) per operation at
+    serving rates: a global arrival-order deque (head-of-line fairness
+    across keys) and a per-key deque (the batcher's compatible-ticket
+    drain).  A ticket popped through one index is flagged ``taken`` and
+    lazily discarded when it surfaces at the other's head — no linear
+    scans on the hot path.
+
+    ``max_depth`` bounds the *sum of lane weights* waiting — the
+    quantity that actually determines how much solve work is promised
+    but not delivered.  Expired tickets are completed with
+    :class:`DeadlineExceeded` when they surface at a head, so a stale
+    request never wastes a solve.
+    """
+
+    def __init__(self, max_depth: int = 512, depth_gauge=None,
+                 on_expired=None):
+        self.max_depth = int(max_depth)
+        self._cond = threading.Condition()
+        self._fifo: deque = deque()
+        self._by_key: Dict[Tuple[str, str], deque] = {}
+        self._lanes = 0
+        self._closed = False
+        self._depth_gauge = depth_gauge
+        self._on_expired = on_expired  # callback(ticket) for accounting
+
+    # -- accounting ----------------------------------------------------------
+    def _set_gauge_locked(self) -> None:
+        if self._depth_gauge is not None:
+            self._depth_gauge.set(self._lanes)
+
+    @property
+    def depth_lanes(self) -> int:
+        with self._cond:
+            return self._lanes
+
+    def __len__(self) -> int:
+        with self._cond:
+            return sum(1 for t in self._fifo if not t.taken)
+
+    # -- producer side -------------------------------------------------------
+    def put(self, ticket: Ticket) -> None:
+        """Admit or shed.  Raises :class:`Overloaded` when the ticket's
+        lanes would push the queue past ``max_depth`` (the caller
+        completes the future with the error and counts the shed), and
+        :class:`ShuttingDown` after :meth:`close`."""
+        with self._cond:
+            if self._closed:
+                raise ShuttingDown("service is stopping")
+            if self._lanes + ticket.lanes > self.max_depth:
+                raise Overloaded(
+                    f"queue at depth ({self._lanes}/{self.max_depth} lanes); "
+                    f"retry with backoff"
+                )
+            self._fifo.append(ticket)
+            kq = self._by_key.get(ticket.key)
+            if kq is None:
+                kq = self._by_key[ticket.key] = deque()
+            kq.append(ticket)
+            self._lanes += ticket.lanes
+            self._set_gauge_locked()
+            self._cond.notify_all()
+
+    # -- consumer side (batcher thread) --------------------------------------
+    def _take_locked(self, ticket: Ticket) -> None:
+        ticket.taken = True
+        self._lanes -= ticket.lanes
+        self._set_gauge_locked()
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Ticket]:
+        """Oldest live ticket, blocking up to ``timeout`` seconds.
+        Expired tickets encountered on the way are completed with
+        :class:`DeadlineExceeded` and skipped."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            dead: List[Ticket] = []
+            took = None
+            with self._cond:
+                now = time.monotonic()
+                while self._fifo:
+                    t = self._fifo[0]
+                    if t.taken:
+                        self._fifo.popleft()
+                        continue
+                    if t.expired(now):
+                        self._fifo.popleft()
+                        self._take_locked(t)
+                        dead.append(t)
+                        continue
+                    self._fifo.popleft()
+                    self._take_locked(t)
+                    took = t
+                    break
+                if took is None and not dead:
+                    if self._closed:
+                        return None
+                    remaining = None if deadline is None else deadline - now
+                    if remaining is not None and remaining <= 0:
+                        return None
+                    self._cond.wait(remaining)
+                    continue
+            self._fail_expired(dead)
+            if took is not None:
+                return took
+
+    def pop_compatible(self, key: Tuple[str, str], max_lanes: int,
+                       timeout: float) -> Optional[Ticket]:
+        """Oldest queued ticket with this ``key`` whose lanes fit in
+        ``max_lanes``, blocking up to ``timeout`` for one to arrive.
+        A head ticket too big for the remaining batch space stays put
+        (it opens the next batch); other keys' tickets are untouched."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while True:
+            dead: List[Ticket] = []
+            took = None
+            blocked = False  # head fits the key but not the batch space
+            with self._cond:
+                now = time.monotonic()
+                kq = self._by_key.get(key)
+                while kq:
+                    t = kq[0]
+                    if t.taken:
+                        kq.popleft()
+                        continue
+                    if t.expired(now):
+                        kq.popleft()
+                        self._take_locked(t)
+                        dead.append(t)
+                        continue
+                    if t.lanes <= max_lanes:
+                        kq.popleft()
+                        self._take_locked(t)
+                        took = t
+                    else:
+                        blocked = True
+                    break
+                if took is None and not dead:
+                    if blocked or self._closed:
+                        return None
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        return None
+                    self._cond.wait(remaining)
+                    continue
+            self._fail_expired(dead)
+            if took is not None:
+                return took
+            if time.monotonic() >= deadline:
+                return None
+
+    def _fail_expired(self, dead: List[Ticket]) -> None:
+        for t in dead:
+            if self._on_expired is not None:
+                self._on_expired(t)
+            else:
+                t.future.set_exception(
+                    DeadlineExceeded("deadline passed while queued")
+                )
+
+    # -- shutdown ------------------------------------------------------------
+    def close(self) -> List[Ticket]:
+        """Refuse new work and return the still-queued tickets (the
+        service drains them with :class:`ShuttingDown`)."""
+        with self._cond:
+            self._closed = True
+            drained = [t for t in self._fifo if not t.taken]
+            for t in drained:
+                self._take_locked(t)
+            self._fifo.clear()
+            self._by_key.clear()
+            self._cond.notify_all()
+        return drained

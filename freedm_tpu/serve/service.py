@@ -1,0 +1,844 @@
+"""Typed query workloads + the multi-tenant serving facade.
+
+Three what-if workloads, each a thin typed shell over a solver the tree
+already ships (the serving layer adds *no* numerics of its own):
+
+- **pf** — snapshot AC power flow: a named case plus per-bus injection
+  overrides (or a uniform stress ``scale``), solved by the batched
+  Newton-Raphson path (:mod:`freedm_tpu.pf.newton`).  One request = one
+  ``vmap`` lane.
+- **n1** — N-1 contingency screen over a *subset* of branches, through
+  the Sherman-Morrison-Woodbury fast-decoupled screen
+  (:mod:`freedm_tpu.pf.n1`).  One request = ``len(outages)`` lanes;
+  islanding (bridge) outages are rejected at validation, because their
+  lanes are mathematically garbage (singular B′).
+- **vvc** — Volt-VAR what-if: a proposed Q-setpoint vector for a feeder,
+  answered with the loss/voltage-band report the proposal would produce
+  (:mod:`freedm_tpu.pf.ladder`).  One request = one scenario lane.
+
+Every response is stamped with the solver's own convergence evidence
+(``residual_pu``/``converged``) plus a conservation check (power-flow:
+Σ realized P = network losses, which must be small and non-negative;
+VVC: substation minus load power), so a client never has to trust a
+200 status alone.
+
+:class:`Service` ties the pieces together: per-request validation
+(synchronous, so an invalid request never occupies queue depth),
+admission (:mod:`freedm_tpu.serve.queue`), micro-batched dispatch
+(:mod:`freedm_tpu.serve.batcher`), and engine caching — one compiled
+engine per (workload, case), shape-bucketed so the jit recompile count
+is bounded by the bucket table and *counted*
+(``serve_recompiles_total``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time as _time
+from concurrent.futures import TimeoutError as _FuturesTimeout
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from freedm_tpu.core import metrics as obs
+from freedm_tpu.core import tracing
+from freedm_tpu.serve.queue import (
+    AdmissionQueue,
+    DeadlineExceeded,
+    InvalidRequest,
+    Overloaded,
+    ServeError,
+    ShuttingDown,
+    Ticket,
+)
+
+WORKLOADS = ("pf", "n1", "vvc")
+
+#: Voltage band for the VVC report, pu (ANSI C84.1 service band).
+V_BAND = (0.95, 1.05)
+
+
+# ---------------------------------------------------------------------------
+# Request / response records
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PowerFlowRequest:
+    """Snapshot power flow: ``case`` + injection overrides.
+
+    ``p_inj``/``q_inj`` are full per-bus vectors in system pu (length
+    ``n_bus``); omitted, the case's stored injections scaled by
+    ``scale`` are used.
+    """
+
+    case: str
+    p_inj: Optional[Sequence[float]] = None
+    q_inj: Optional[Sequence[float]] = None
+    scale: float = 1.0
+    # Full [n] voltage/angle vectors in the response.  Off by default:
+    # summary stats answer most what-ifs, and building per-bus lists is
+    # measurable per-request work on the scatter path.
+    return_state: bool = False
+    timeout_s: float = 30.0
+
+
+@dataclass(frozen=True)
+class N1Request:
+    """Contingency screen over a branch subset (indices into the case's
+    branch table; each must be non-islanding)."""
+
+    case: str
+    outages: Sequence[int] = ()
+    timeout_s: float = 30.0
+
+
+@dataclass(frozen=True)
+class VVCRequest:
+    """Volt-VAR what-if: a proposed ``[nb, 3]`` Q-setpoint table (kvar,
+    0 where not controlled) for a feeder case."""
+
+    case: str
+    q_ctrl_kvar: Sequence[Sequence[float]] = ()
+    timeout_s: float = 30.0
+
+
+@dataclass
+class BatchInfo:
+    """How this request was served — the micro-batching receipt."""
+
+    lanes: int  # real lanes in the dispatched batch (all requests)
+    bucket: int  # padded static shape the batch ran at
+    queue_ms: float  # admission -> dispatch
+    solve_ms: float  # batched solve wall time (shared by the batch)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class PowerFlowResponse:
+    workload: str
+    case: str
+    scale: float
+    converged: bool
+    iterations: int
+    residual_pu: float
+    p_balance_pu: float  # Σ realized P = network losses (small, >= ~0)
+    q_balance_pu: float
+    v_min_pu: float
+    v_max_pu: float
+    batch: BatchInfo
+    v: Optional[List[float]] = None  # per-bus |V| (return_state=True)
+    theta: Optional[List[float]] = None  # per-bus angle, rad
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["batch"] = self.batch.to_dict()
+        return d
+
+
+@dataclass
+class N1Response:
+    workload: str
+    case: str
+    outages: List[int]
+    converged: List[bool]
+    residual_pu: List[float]
+    v_min_pu: List[float]
+    v_max_pu: List[float]
+    worst_residual_pu: float
+    all_converged: bool
+    batch: BatchInfo
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["batch"] = self.batch.to_dict()
+        return d
+
+
+@dataclass
+class VVCResponse:
+    workload: str
+    case: str
+    converged: bool
+    residual: float
+    loss_kw: float
+    loss_base_kw: float  # losses at the zero-injection baseline
+    loss_delta_kw: float  # loss_kw - loss_base_kw (negative = improvement)
+    v_min_pu: float
+    v_max_pu: float
+    band_violations: int  # live node-phases outside V_BAND
+    batch: BatchInfo
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["batch"] = self.batch.to_dict()
+        return d
+
+
+# ---------------------------------------------------------------------------
+# Case registry
+# ---------------------------------------------------------------------------
+
+#: Bus-system cases servable by pf/n1 (MATPOWER builtins).
+BUS_CASES = ("case14", "case_ieee30")
+#: Feeder cases servable by vvc.
+FEEDER_CASES = ("vvc_9bus",)
+
+
+#: Cap on the client-named synthetic meshN size: the dense Newton path
+#: is O(n^2) memory, and the case name is attacker-controlled input.
+MAX_MESH_BUSES = 2000
+
+
+def _resolve_bus_case(name: str):
+    if name in BUS_CASES:
+        from freedm_tpu.grid.matpower import load_builtin
+
+        return load_builtin(name)
+    if name.startswith("mesh") and name[4:].isdigit():
+        # meshN: the synthetic transmission generator at N buses —
+        # the scale-test tenant (bench.py uses mesh118).
+        n = int(name[4:])
+        if not 2 <= n <= MAX_MESH_BUSES:
+            raise InvalidRequest(
+                f"meshN size must be in [2, {MAX_MESH_BUSES}], got {n}"
+            )
+        from freedm_tpu.grid.cases import synthetic_mesh
+
+        return synthetic_mesh(n, seed=1, load_mw=10.0, chord_frac=1.0)
+    raise InvalidRequest(
+        f"unknown bus case {name!r} (have: {', '.join(BUS_CASES)}, meshN)"
+    )
+
+
+def _resolve_feeder_case(name: str):
+    if name in FEEDER_CASES:
+        from freedm_tpu.grid import cases
+
+        return getattr(cases, name)()
+    raise InvalidRequest(
+        f"unknown feeder case {name!r} (have: {', '.join(FEEDER_CASES)})"
+    )
+
+
+def _pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad a stacked batch up to its bucket by repeating the last row —
+    a real, convergent lane, so padding can never poison batch numerics."""
+    pad = bucket - arr.shape[0]
+    if pad <= 0:
+        return arr
+    return np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)])
+
+
+def _as_vector(val, n: int, what: str) -> np.ndarray:
+    arr = np.asarray(val, np.float64)
+    if arr.shape != (n,):
+        raise InvalidRequest(f"{what} must be a length-{n} vector, got shape {arr.shape}")
+    if not np.all(np.isfinite(arr)):
+        raise InvalidRequest(f"{what} contains non-finite values")
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# Engines: one compiled solver front per (workload, case)
+# ---------------------------------------------------------------------------
+
+
+class _Engine:
+    """Common engine shape the batcher drives.
+
+    ``validate`` runs on the submitter's thread (before admission);
+    ``assemble``/``solve``/``scatter`` run on the batcher thread.
+    ``solve`` must block until the result is materialized — the batch's
+    latency accounting and the response's convergence stamps both need
+    host data.
+    """
+
+    workload = ""
+
+    def __init__(self, case: str):
+        self.case = case
+        self.key = (self.workload, case)
+        self.compiled_buckets: set = set()
+
+    def validate(self, req):  # -> prepared payload (host arrays)
+        raise NotImplementedError
+
+    def lanes(self, prepared) -> int:
+        return 1
+
+    def assemble(self, group: List[Ticket], bucket: int):
+        raise NotImplementedError
+
+    def solve(self, batch):
+        raise NotImplementedError
+
+    def scatter(self, group: List[Ticket], results, info: BatchInfo) -> None:
+        raise NotImplementedError
+
+
+class PowerFlowEngine(_Engine):
+    workload = "pf"
+
+    def __init__(self, case: str, max_iter: int = 12):
+        super().__init__(case)
+        import jax
+
+        from freedm_tpu.pf.newton import make_newton_solver
+
+        sys_ = _resolve_bus_case(case)
+        self.n_bus = sys_.n_bus
+        self._p0 = np.asarray(sys_.p_inj, np.float64)
+        self._q0 = np.asarray(sys_.q_inj, np.float64)
+        _, solve_fixed = make_newton_solver(sys_, max_iter=max_iter)
+        self._batched = jax.jit(
+            jax.vmap(lambda p, q: solve_fixed(p_inj=p, q_inj=q))
+        )
+
+    def validate(self, req: PowerFlowRequest):
+        if not (math.isfinite(req.scale) and 0.0 < req.scale <= 10.0):
+            raise InvalidRequest(f"scale must be in (0, 10], got {req.scale!r}")
+        p = (
+            _as_vector(req.p_inj, self.n_bus, "p_inj")
+            if req.p_inj is not None
+            else self._p0 * req.scale
+        )
+        q = (
+            _as_vector(req.q_inj, self.n_bus, "q_inj")
+            if req.q_inj is not None
+            else self._q0 * req.scale
+        )
+        return {"p": p, "q": q}
+
+    def assemble(self, group: List[Ticket], bucket: int):
+        p = _pad_rows(np.stack([t.prepared["p"] for t in group]), bucket)
+        q = _pad_rows(np.stack([t.prepared["q"] for t in group]), bucket)
+        return p, q
+
+    def solve(self, batch):
+        import jax
+
+        r = self._batched(*batch)
+        jax.block_until_ready(r.v)
+        return r
+
+    def scatter(self, group: List[Ticket], r, info: BatchInfo) -> None:
+        v = np.asarray(r.v)
+        theta = np.asarray(r.theta)
+        p = np.asarray(r.p)
+        q = np.asarray(r.q)
+        its = np.asarray(r.iterations)
+        conv = np.asarray(r.converged)
+        mism = np.asarray(r.mismatch)
+        p_bal = p.sum(axis=1)
+        q_bal = q.sum(axis=1)
+        v_min = v.min(axis=1)
+        v_max = v.max(axis=1)
+        for i, t in enumerate(group):
+            want_state = bool(t.request.return_state)
+            t.future.set_result(PowerFlowResponse(
+                workload="pf",
+                case=self.case,
+                scale=float(t.request.scale),
+                converged=bool(conv[i]),
+                iterations=int(its[i]),
+                residual_pu=float(mism[i]),
+                p_balance_pu=float(p_bal[i]),
+                q_balance_pu=float(q_bal[i]),
+                v_min_pu=float(v_min[i]),
+                v_max_pu=float(v_max[i]),
+                v=np.round(v[i], 9).tolist() if want_state else None,
+                theta=np.round(theta[i], 9).tolist() if want_state else None,
+                batch=info,
+            ))
+
+
+class N1Engine(_Engine):
+    workload = "n1"
+
+    #: Validation cap on outages per request (also the largest bucket).
+    MAX_OUTAGES = 256
+
+    def __init__(self, case: str, max_iter: int = 24):
+        super().__init__(case)
+        from freedm_tpu.pf.n1 import make_n1_screen, secure_outages
+
+        sys_ = _resolve_bus_case(case)
+        self.n_branch = sys_.n_branch
+        self._secure = sorted(secure_outages(sys_))
+        self._secure_set = frozenset(self._secure)
+        self._screen = make_n1_screen(sys_, max_iter=max_iter)
+
+    def validate(self, req: N1Request):
+        ks = list(req.outages)
+        if not ks:
+            raise InvalidRequest("outages must be a non-empty list of branch indices")
+        if len(ks) > self.MAX_OUTAGES:
+            raise InvalidRequest(
+                f"at most {self.MAX_OUTAGES} outages per request, got {len(ks)}"
+            )
+        bad = [
+            k for k in ks
+            if not (isinstance(k, (int, np.integer)) and 0 <= k < self.n_branch)
+        ]
+        if bad:
+            raise InvalidRequest(
+                f"outage indices must be ints in [0, {self.n_branch}), got {bad}"
+            )
+        islanding = [k for k in ks if k not in self._secure_set]
+        if islanding:
+            raise InvalidRequest(
+                f"outages {islanding} island the network (bridge branches); "
+                f"their screen lanes would be singular"
+            )
+        return {"ks": np.asarray(ks, np.int64)}
+
+    def lanes(self, prepared) -> int:
+        return int(prepared["ks"].shape[0])
+
+    def assemble(self, group: List[Ticket], bucket: int):
+        ks = np.concatenate([t.prepared["ks"] for t in group])
+        if ks.shape[0] < bucket:
+            # Pad with replicas of the first requested outage — a real
+            # non-islanding lane the screen solves anyway.
+            ks = np.concatenate(
+                [ks, np.full(bucket - ks.shape[0], ks[0], np.int64)]
+            )
+        return ks
+
+    def solve(self, batch):
+        import jax
+
+        r = self._screen(batch)
+        jax.block_until_ready(r.v)
+        return r
+
+    def scatter(self, group: List[Ticket], r, info: BatchInfo) -> None:
+        v = np.asarray(r.v)
+        conv = np.asarray(r.converged)
+        mism = np.asarray(r.mismatch)
+        off = 0
+        for t in group:
+            k = int(t.prepared["ks"].shape[0])
+            sl = slice(off, off + k)
+            off += k
+            res = mism[sl].astype(np.float64).tolist()
+            t.future.set_result(N1Response(
+                workload="n1",
+                case=self.case,
+                outages=t.prepared["ks"].tolist(),
+                converged=conv[sl].tolist(),
+                residual_pu=res,
+                v_min_pu=v[sl].min(axis=1).astype(np.float64).tolist(),
+                v_max_pu=v[sl].max(axis=1).astype(np.float64).tolist(),
+                worst_residual_pu=max(res),
+                all_converged=bool(conv[sl].all()),
+                batch=info,
+            ))
+
+
+class VVCEngine(_Engine):
+    workload = "vvc"
+
+    def __init__(self, case: str, pf_iters: int = 20):
+        super().__init__(case)
+        import jax
+        import jax.numpy as jnp
+
+        from freedm_tpu.pf import ladder
+        from freedm_tpu.utils import cplx
+        from freedm_tpu.utils.cplx import C
+
+        feeder = _resolve_feeder_case(case)
+        self.nb = feeder.n_branches
+        mask = np.asarray(feeder.phase_mask, np.float64)
+        self._mask = mask
+        # Live node-phases incl. the always-3-phase substation row —
+        # the denominator of the voltage-band report.
+        self._live = np.concatenate([np.ones((1, 3)), mask]) > 0
+
+        _, solve_fixed = ladder.make_ladder_solver(feeder, max_iter=pf_iters)
+        s = cplx.as_c(feeder.s_load)
+        s_re, s_im = jnp.asarray(s.re), jnp.asarray(s.im)
+        mask_j = jnp.asarray(mask, s_re.dtype)
+
+        def one(q_kvar):
+            # Injecting Q reduces the load's Q draw (modules/vvc.py).
+            res = solve_fixed(C(s_re, s_im - q_kvar * mask_j))
+            loss = ladder.total_loss_kw(feeder, res)
+            return loss, res.v_node.abs(), res.converged, res.residual
+
+        self._batched = jax.jit(jax.vmap(one))
+        base = solve_fixed(s)
+        self.loss_base_kw = float(ladder.total_loss_kw(feeder, base))
+
+    def validate(self, req: VVCRequest):
+        q = np.asarray(req.q_ctrl_kvar, np.float64)
+        if q.shape != (self.nb, 3):
+            raise InvalidRequest(
+                f"q_ctrl_kvar must be [{self.nb}, 3] (kvar per node-phase), "
+                f"got shape {q.shape}"
+            )
+        if not np.all(np.isfinite(q)):
+            raise InvalidRequest("q_ctrl_kvar contains non-finite values")
+        dead = (self._mask == 0) & (q != 0)
+        if dead.any():
+            raise InvalidRequest(
+                f"q_ctrl_kvar proposes injection on {int(dead.sum())} dead "
+                f"node-phase(s) (phase does not exist there)"
+            )
+        return {"q": q}
+
+    def assemble(self, group: List[Ticket], bucket: int):
+        return _pad_rows(np.stack([t.prepared["q"] for t in group]), bucket)
+
+    def solve(self, batch):
+        import jax
+
+        out = self._batched(batch)
+        jax.block_until_ready(out[0])
+        return out
+
+    def scatter(self, group: List[Ticket], out, info: BatchInfo) -> None:
+        loss, vmag, conv, residual = out
+        loss = np.asarray(loss)
+        vmag = np.asarray(vmag)
+        conv = np.asarray(conv)
+        residual = np.asarray(residual)
+        # Vectorize the band report over the batch (the per-lane Python
+        # loop below must stay cheap — it runs on the dispatch thread).
+        vm_live = vmag[:, self._live]  # [b, n_live]
+        v_min = vm_live.min(axis=1)
+        v_max = vm_live.max(axis=1)
+        viols = np.sum(
+            (vm_live < V_BAND[0]) | (vm_live > V_BAND[1]), axis=1
+        )
+        for i, t in enumerate(group):
+            t.future.set_result(VVCResponse(
+                workload="vvc",
+                case=self.case,
+                converged=bool(conv[i]),
+                residual=float(residual[i]),
+                loss_kw=float(loss[i]),
+                loss_base_kw=self.loss_base_kw,
+                loss_delta_kw=float(loss[i]) - self.loss_base_kw,
+                v_min_pu=float(v_min[i]),
+                v_max_pu=float(v_max[i]),
+                band_violations=int(viols[i]),
+                batch=info,
+            ))
+
+
+_ENGINE_TYPES = {
+    "pf": PowerFlowEngine,
+    "n1": N1Engine,
+    "vvc": VVCEngine,
+}
+
+_REQUEST_TYPES = {
+    "pf": PowerFlowRequest,
+    "n1": N1Request,
+    "vvc": VVCRequest,
+}
+
+
+def parse_request(workload: str, payload: dict):
+    """Build the typed request record from a JSON payload, rejecting
+    unknown workloads and unknown fields with typed errors."""
+    cls = _REQUEST_TYPES.get(workload)
+    if cls is None:
+        raise InvalidRequest(
+            f"unknown workload {workload!r} (have: {', '.join(WORKLOADS)})"
+        )
+    if not isinstance(payload, dict):
+        raise InvalidRequest("request body must be a JSON object")
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(payload) - names
+    if unknown:
+        raise InvalidRequest(
+            f"unknown field(s) {sorted(unknown)} for workload {workload!r}"
+        )
+    if "case" not in payload:
+        raise InvalidRequest("missing required field 'case'")
+    try:
+        return cls(**payload)
+    except TypeError as e:
+        raise InvalidRequest(str(e)) from None
+
+
+# ---------------------------------------------------------------------------
+# Service facade
+# ---------------------------------------------------------------------------
+
+
+def default_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Powers of two up to (and including) ``max_batch`` — the static
+    shape set jit programs are compiled for."""
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch))
+    return tuple(out)
+
+
+class ServeConfig(NamedTuple):
+    """Serving knobs (CLI: ``--serve-port`` and friends).
+
+    ``max_batch`` bounds lanes per dispatch; ``max_wait_ms`` is the
+    coalescing window the batcher holds the first request of a batch
+    open for; ``queue_depth`` is the admission bound in lanes (beyond
+    it, requests shed with ``overloaded``); ``buckets`` defaults to the
+    powers of two up to ``max_batch``.
+    """
+
+    max_batch: int = 64
+    max_wait_ms: float = 2.0
+    queue_depth: int = 512
+    default_timeout_s: float = 30.0
+    pf_max_iter: int = 12
+    n1_max_iter: int = 24
+    vvc_pf_iters: int = 20
+    buckets: Optional[Tuple[int, ...]] = None
+
+    def bucket_table(self) -> Tuple[int, ...]:
+        bs = self.buckets if self.buckets else default_buckets(self.max_batch)
+        bs = tuple(sorted(set(int(b) for b in bs)))
+        if bs[-1] < self.max_batch:
+            bs = bs + (int(self.max_batch),)
+        return bs
+
+
+class Service:
+    """The multi-tenant query service: validate → admit → micro-batch →
+    solve → scatter.
+
+    ``submit`` returns a :class:`concurrent.futures.Future` resolving to
+    a typed response (or raising a :class:`ServeError`); ``request`` is
+    the blocking convenience.  Engines are built lazily per
+    (workload, case) and cached for the service's lifetime.
+    """
+
+    #: Distinct (workload, case) engines one service will build; each is
+    #: a permanent cache entry with its own compiled programs.
+    MAX_ENGINES = 32
+
+    def __init__(self, config: ServeConfig = ServeConfig(), start: bool = True):
+        from freedm_tpu.serve.batcher import MicroBatcher
+
+        self.config = config
+        self._engines: Dict[Tuple[str, str], _Engine] = {}
+        # Global lock guards the maps only; SLOW engine construction
+        # (XLA compiles in VVCEngine/N1Engine __init__) happens under a
+        # per-key build lock so a first-touch tenant cannot stall the
+        # batcher's engine lookups for everyone else.
+        self._engines_lock = threading.Lock()
+        self._build_locks: Dict[Tuple[str, str], threading.Lock] = {}
+        # Pre-resolved "ok" counter children: the per-request completion
+        # path skips the labels() lookup.
+        self._ok_counters = {
+            w: obs.SERVE_REQUESTS.labels(w, "ok") for w in WORKLOADS
+        }
+        self.queue = AdmissionQueue(
+            max_depth=config.queue_depth,
+            depth_gauge=obs.SERVE_QUEUE_DEPTH,
+            on_expired=self._expire,
+        )
+        self.batcher = MicroBatcher(self, config)
+        if start:
+            self.batcher.start()
+
+    # -- engine cache --------------------------------------------------------
+    def engine(self, workload: str, case: str) -> _Engine:
+        if workload not in _ENGINE_TYPES:
+            raise InvalidRequest(
+                f"unknown workload {workload!r} (have: {', '.join(WORKLOADS)})"
+            )
+        if not isinstance(case, str) or not case:
+            raise InvalidRequest("'case' must be a non-empty string")
+        key = (workload, case)
+        with self._engines_lock:
+            eng = self._engines.get(key)
+            if eng is not None:
+                return eng
+            if len(self._engines) >= self.MAX_ENGINES:
+                # Engines (and their jit programs) are never evicted: a
+                # client cycling case names must not grow the cache
+                # without bound.
+                raise InvalidRequest(
+                    f"engine cache full ({self.MAX_ENGINES} cases); "
+                    f"reuse an already-served case"
+                )
+            build_lock = self._build_locks.get(key)
+            if build_lock is None:
+                build_lock = self._build_locks[key] = threading.Lock()
+        with build_lock:
+            with self._engines_lock:
+                eng = self._engines.get(key)
+            if eng is not None:  # another submitter built it meanwhile
+                return eng
+            cfg = self.config
+            kwargs = {
+                "pf": {"max_iter": cfg.pf_max_iter},
+                "n1": {"max_iter": cfg.n1_max_iter},
+                "vvc": {"pf_iters": cfg.vvc_pf_iters},
+            }[workload]
+            eng = _ENGINE_TYPES[workload](case, **kwargs)
+            with self._engines_lock:
+                self._engines[key] = eng
+            return eng
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, workload: str, request):
+        """Validate and admit one request; returns its Future.
+
+        ``request`` may be a typed record or a JSON-shaped dict.  Raises
+        :class:`InvalidRequest` / :class:`Overloaded` synchronously —
+        an unservable request never occupies queue depth.
+        """
+        # Clamp the metric label to the known vocabulary: a typo'd or
+        # hostile workload string must not mint unbounded label series.
+        wl = workload if workload in WORKLOADS else "unknown"
+        try:
+            if isinstance(request, dict):
+                request = parse_request(workload, request)
+            eng = self.engine(workload, request.case)
+            prepared = eng.validate(request)
+            lanes = eng.lanes(prepared)
+            if lanes > self.config.max_batch:
+                raise InvalidRequest(
+                    f"request needs {lanes} lanes but max_batch is "
+                    f"{self.config.max_batch}; split it"
+                )
+            timeout = float(getattr(request, "timeout_s", 0) or 0)
+        except InvalidRequest:
+            obs.SERVE_REQUESTS.labels(wl, "invalid").inc()
+            raise
+        except (TypeError, ValueError) as e:
+            # Wrong-typed field VALUES (e.g. scale="1.1", outages=5) come
+            # out of numpy/float coercion as raw TypeError/ValueError —
+            # still the client's fault, still a typed 400.
+            obs.SERVE_REQUESTS.labels(wl, "invalid").inc()
+            raise InvalidRequest(f"malformed request field: {e}") from None
+        if timeout <= 0:
+            timeout = self.config.default_timeout_s
+        span = tracing.TRACER.start(
+            "serve.request", kind="serve",
+            tags={"workload": workload, "case": request.case, "lanes": lanes},
+        )
+        ticket = Ticket(
+            key=eng.key, request=request, prepared=prepared, lanes=lanes,
+            deadline=_time.monotonic() + timeout, span=span,
+        )
+        try:
+            self.queue.put(ticket)
+        except Overloaded:
+            obs.SERVE_SHED.inc()
+            obs.SERVE_REQUESTS.labels(workload, "overloaded").inc()
+            span.tag(outcome="overloaded")
+            span.end()
+            raise
+        except ShuttingDown:
+            obs.SERVE_REQUESTS.labels(workload, "shutdown").inc()
+            span.tag(outcome="shutdown")
+            span.end()
+            raise
+        return ticket.future
+
+    def request(self, workload: str, request, timeout_s: Optional[float] = None):
+        """Blocking submit: the typed response, or a raised ServeError.
+
+        The wait honors the REQUEST's own ``timeout_s`` (plus a margin
+        for the in-flight solve, which is never cancelled), so a client
+        asking for 300 s to cover a first-bucket compile actually gets
+        it; an explicit ``timeout_s`` argument REPLACES the record's
+        value (so the ticket's queue deadline moves with it too); a wait
+        that still runs out surfaces as the typed
+        :class:`DeadlineExceeded`, not a raw future timeout.
+        """
+        if isinstance(request, dict):
+            try:
+                request = parse_request(workload, request)
+            except InvalidRequest:
+                wl = workload if workload in WORKLOADS else "unknown"
+                obs.SERVE_REQUESTS.labels(wl, "invalid").inc()
+                raise
+        if timeout_s is not None and hasattr(request, "timeout_s"):
+            request = dataclasses.replace(request, timeout_s=float(timeout_s))
+        fut = self.submit(workload, request)
+        t = float(getattr(request, "timeout_s", 0) or 0)
+        if t <= 0:
+            t = self.config.default_timeout_s
+        wait = t + 10.0
+        try:
+            return fut.result(timeout=wait)
+        except _FuturesTimeout:
+            raise DeadlineExceeded(
+                f"no result within {wait:.0f}s (the batch may still "
+                f"be solving; its result is discarded)"
+            ) from None
+
+    # -- completion accounting (called by the batcher / queue) ---------------
+    def _expire(self, ticket: Ticket) -> None:
+        obs.SERVE_REQUESTS.labels(ticket.key[0], "deadline").inc()
+        ticket.span.tag(outcome="deadline")
+        ticket.span.end()
+        ticket.future.set_exception(
+            DeadlineExceeded("deadline passed while queued")
+        )
+
+    def _complete_ok(self, ticket: Ticket, info: BatchInfo) -> None:
+        self._ok_counters[ticket.key[0]].inc()
+        span = ticket.span
+        if span is not tracing.NOOP:
+            span.tag(outcome="ok", bucket=info.bucket,
+                     batch_lanes=info.lanes)
+            span.end()
+
+    def _complete_error(self, ticket: Ticket, err: BaseException) -> None:
+        outcome = err.code if isinstance(err, ServeError) else "error"
+        obs.SERVE_REQUESTS.labels(ticket.key[0], outcome).inc()
+        ticket.span.tag(outcome=outcome)
+        ticket.span.end()
+        if not ticket.future.done():
+            ticket.future.set_exception(err)
+
+    # -- introspection / lifecycle -------------------------------------------
+    def stats(self) -> dict:
+        snap = obs.REGISTRY.snapshot()
+
+        def metric(name):
+            return snap.get(name, {}).get("values", {})
+
+        return {
+            "queue_depth_lanes": self.queue.depth_lanes,
+            "engines": sorted(
+                f"{w}/{c}" for (w, c) in self._engines
+            ),
+            "buckets": list(self.config.bucket_table()),
+            "max_batch": self.config.max_batch,
+            "max_wait_ms": self.config.max_wait_ms,
+            "requests": metric("serve_requests_total"),
+            "shed": metric("serve_shed_total"),
+            "recompiles": metric("serve_recompiles_total"),
+            "batch_lanes": metric("serve_batch_lanes"),
+            "queue_wait_seconds": metric("serve_queue_wait_seconds"),
+            "solve_seconds": metric("serve_solve_seconds"),
+        }
+
+    def start(self) -> "Service":
+        self.batcher.start()
+        return self
+
+    def stop(self) -> None:
+        """Refuse new work, drain the queue with ``shutting_down``, stop
+        the batcher."""
+        for t in self.queue.close():
+            self._complete_error(t, ShuttingDown("service stopped"))
+        self.batcher.stop()

@@ -1,0 +1,108 @@
+"""CI smoke for the serving stack: boot, query, verify residuals.
+
+Starts a real :class:`~freedm_tpu.serve.ServeServer` on an ephemeral
+port, POSTs a small mixed batch of pf / N-1 / VVC queries over HTTP
+(several concurrently, so the micro-batcher actually coalesces), and
+asserts every answer is 200 with its solver residual below tolerance
+and its conservation stamp sane.  One command, exit code 0 iff healthy:
+
+    python -m freedm_tpu.tools.serve_smoke
+
+Used by ``.github/workflows/ci.yml``; also a handy local sanity check
+after touching the serve path.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Tuple
+
+#: f32-appropriate residual ceiling (CI runs on CPU without x64).
+TOL_PU = 1e-3
+
+
+def _post(port: int, path: str, payload: dict) -> Tuple[int, dict]:
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from freedm_tpu.serve import ServeConfig, ServeServer, Service
+
+    svc = Service(ServeConfig(max_batch=8, max_wait_ms=10.0,
+                              buckets=(1, 4, 8)))
+    srv = ServeServer(svc, port=0).start()
+    print(f"[serve-smoke] server on port {srv.port}", flush=True)
+    failures: List[str] = []
+
+    def ok(name: str, cond: bool, detail: str = "") -> None:
+        print(f"[serve-smoke] {'ok  ' if cond else 'FAIL'} {name}  {detail}",
+              flush=True)
+        if not cond:
+            failures.append(name)
+
+    try:
+        queries = [
+            ("pf", "/v1/pf", {"case": "case14", "scale": 1.0}),
+            ("pf", "/v1/pf", {"case": "case14", "scale": 1.1}),
+            ("pf", "/v1/pf", {"case": "case14", "scale": 0.9}),
+            ("n1", "/v1/n1", {"case": "case14", "outages": [0, 1]}),
+            ("vvc", "/v1/vvc", {"case": "vvc_9bus",
+                                "q_ctrl_kvar": [[0.0] * 3] * 8}),
+        ]
+        # Concurrent POSTs: the three pf queries must coalesce.
+        with ThreadPoolExecutor(len(queries)) as pool:
+            results = list(pool.map(
+                lambda q: (q[0], *_post(srv.port, q[1], q[2])), queries
+            ))
+        for workload, code, d in results:
+            ok(f"{workload}_status_200", code == 200, f"code={code} {d}")
+            if code != 200:
+                continue
+            if workload == "pf":
+                ok("pf_residual", d["converged"] and d["residual_pu"] < TOL_PU,
+                   f"residual={d['residual_pu']}")
+                ok("pf_conservation", 0.0 <= d["p_balance_pu"] < 0.5,
+                   f"p_balance={d['p_balance_pu']}")
+            elif workload == "n1":
+                ok("n1_residuals",
+                   d["all_converged"] and d["worst_residual_pu"] < TOL_PU,
+                   f"worst={d['worst_residual_pu']}")
+            else:
+                ok("vvc_residual", d["converged"],
+                   f"residual={d['residual']}")
+                ok("vvc_baseline", abs(d["loss_delta_kw"]) < 1e-3,
+                   f"delta={d['loss_delta_kw']}")
+        code, d = _post(srv.port, "/v1/pf", {"case": "bogus"})
+        ok("typed_invalid_request",
+           code == 400 and d["error"]["type"] == "invalid_request",
+           f"code={code}")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/stats", timeout=10
+        ) as r:
+            stats = json.loads(r.read())
+        ok("stats_served", bool(stats["engines"]),
+           f"engines={stats['engines']}")
+    finally:
+        srv.stop()
+        svc.stop()
+    print(json.dumps({"serve_smoke_pass": not failures,
+                      "failed": failures}), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

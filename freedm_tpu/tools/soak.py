@@ -78,6 +78,7 @@ SCRAPE_KEYS = (
     "dcn_datagrams_out_total",
     "broker_rounds_total",
     "federation_migrations_total",
+    "serve_shed_total",
 )
 
 
@@ -130,6 +131,7 @@ class SliceSpec:
     plant_port: Optional[int] = None
     cfg_path: Optional[Path] = None
     metrics_port: Optional[int] = None  # the slice's /metrics TCP port
+    serve_port: Optional[int] = None  # the slice's what-if query TCP port
 
 
 class Check:
@@ -241,6 +243,90 @@ class Proc:
             self.proc.wait(timeout=10)
         # Hold the port for the rejoin (released by the next start()).
         self._reserve_port()
+
+
+class ServeLoader:
+    """Closed-loop background query load against one slice's what-if
+    endpoint (``freedm_tpu.serve``, ``serve-port``).
+
+    Runs for the whole fault schedule: the point is that serving and the
+    broker round loop coexist through kills, rejoins, and re-elections.
+    Counts completed queries, typed 429 sheds, and transport errors
+    (expected while the target slice is down or still compiling); the
+    summary folds into the soak artifact's ``metrics`` object.
+    """
+
+    def __init__(self, port: int, case: str = "case14", n_conns: int = 2):
+        self.port = int(port)
+        self.case = case
+        self.n_conns = int(n_conns)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self.ok = 0
+        self.shed = 0
+        self.errors = 0
+        self._t0: Optional[float] = None
+        self._elapsed: Optional[float] = None
+
+    def _loop(self, seed: int) -> None:
+        import random
+        import urllib.error
+        import urllib.request
+
+        rng = random.Random(seed)
+        url = f"http://127.0.0.1:{self.port}/v1/pf"
+        while not self._stop.is_set():
+            body = json.dumps(
+                {"case": self.case, "scale": round(rng.uniform(0.9, 1.1), 3)}
+            ).encode()
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"}
+            )
+            try:
+                # Generous timeout: the first query compiles the solver
+                # inside the slice process.
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    json.loads(r.read())
+                with self._lock:
+                    self.ok += 1
+            except urllib.error.HTTPError as e:
+                e.close()
+                with self._lock:
+                    if e.code == 429:
+                        self.shed += 1
+                    else:
+                        self.errors += 1
+            except Exception:
+                with self._lock:
+                    self.errors += 1
+                # The slice is down (fault schedule) or not yet serving.
+                self._stop.wait(0.5)
+
+    def start(self) -> "ServeLoader":
+        self._t0 = time.monotonic()
+        self._threads = [
+            threading.Thread(target=self._loop, args=(i,), daemon=True)
+            for i in range(self.n_conns)
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> Dict[str, float]:
+        if self._elapsed is None and self._t0 is not None:
+            self._elapsed = time.monotonic() - self._t0
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=65)
+        dur = self._elapsed or 0.0
+        return {
+            "serve_requests_ok": float(self.ok),
+            "serve_qps_achieved": round(self.ok / dur, 2) if dur else 0.0,
+            "serve_client_shed_429": float(self.shed),
+            "serve_client_errors": float(self.errors),
+            "serve_window_s": round(dur, 1),
+        }
 
 
 def wait_for(procs: List[Proc], cond, timeout_s: float) -> bool:
@@ -365,9 +451,18 @@ def write_configs(
         # Per-slice trace files (core.tracing): trace_report.py merges
         # them into the skew-corrected causal round timeline.
         trace_line = f"trace-log = {workdir}/trace_{spec.port}.jsonl\n"
+        # What-if query endpoint (freedm_tpu.serve): the soak drives a
+        # closed-loop load against one slice to prove serving and the
+        # broker round loop coexist through kills/rejoins.
+        serve_line = (
+            f"serve-port = {spec.serve_port}\n"
+            if spec.serve_port is not None
+            else ""
+        )
         cfg.write_text(
             f"hostname = 127.0.0.1\nport = {spec.port}\nfederate = yes\n"
-            f"{peers}\nmigration-step = 1\n{vvc_line}{metrics_line}{trace_line}"
+            f"{peers}\nmigration-step = 1\n{vvc_line}{metrics_line}"
+            f"{trace_line}{serve_line}"
             f"device-config = {workdir}/device.xml\n"
             f"adapter-config = {workdir}/adapter.xml\n"
             f"timings-config = {workdir}/timings.cfg\n"
@@ -391,6 +486,7 @@ def run_soak(
     workdir: Optional[str] = None,
     out: Optional[str] = None,
     vvc: bool = True,
+    serve_load: bool = True,
 ) -> Dict:
     import tempfile
 
@@ -402,6 +498,7 @@ def run_soak(
     os.makedirs(_CACHE_DIR, exist_ok=True)
     ports = free_udp_ports(n_slices)
     metrics_ports = free_tcp_ports(n_slices)
+    serve_ports = free_tcp_ports(n_slices) if serve_load else [None] * n_slices
     specs = []
     for i, port in enumerate(ports):
         rows = [r for j, r in enumerate(VVC_ROWS) if j % n_slices == i]
@@ -412,12 +509,14 @@ def run_soak(
             SliceSpec(
                 uuid=f"127.0.0.1:{port}", port=port, rows=rows,
                 generation=gen, drain=drain, metrics_port=metrics_ports[i],
+                serve_port=serve_ports[i],
             )
         )
     write_configs(wd, specs, loss_pct, vvc=vvc)
 
     check = Check()
     slice_metrics: Dict[str, Dict[str, float]] = {}
+    loader: Optional[ServeLoader] = None
     plant = subprocess.Popen(
         [sys.executable, "-m", "freedm_tpu.sim.plantserver", str(wd / "rig.xml")],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=_env(), text=True,
@@ -461,6 +560,12 @@ def run_soak(
             f"group_of_{n_slices}_forms", ok,
             f"members={[p.last().get('fed_members') for p in procs]}",
         )
+
+        # Background what-if query load against ONE slice's serve port,
+        # running through the whole fault schedule (the target may be a
+        # kill victim — the loader tolerates the gap and reconnects).
+        if serve_load and specs[-1].serve_port is not None:
+            loader = ServeLoader(specs[-1].serve_port).start()
         leaders = {p.last().get("fed_leader") for p in procs}
         check.record("single_leader", len(leaders) == 1, f"leaders={leaders}")
         leader_uuid = next(iter(leaders)) if leaders else None
@@ -556,6 +661,7 @@ def run_soak(
             if p.alive() and p.spec.metrics_port is not None
         )
     finally:
+        serve_summary = loader.stop() if loader is not None else None
         for p in procs:
             p.kill()
             p._release_port()
@@ -570,6 +676,12 @@ def run_soak(
     for counters in slice_metrics.values():
         for k, v in counters.items():
             totals[k] = totals.get(k, 0.0) + v
+    if serve_summary is not None:
+        # Loader-side achieved QPS/sheds alongside the server-side
+        # serve_shed_total scraped above (absent if the serving slice
+        # died before the final scrape).
+        totals.update(serve_summary)
+        totals.setdefault("serve_shed_total", serve_summary["serve_client_shed_429"])
     # Per-slice trace files + a merged mini-report: the artifact records
     # how causally connected the run was (cross-node links prove the
     # wire trace context survived the lossy transport), with the full
@@ -628,10 +740,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="write the JSON artifact here")
     ap.add_argument("--no-vvc", action="store_true",
                     help="run without the VVC module (debug)")
+    ap.add_argument("--no-serve-load", action="store_true",
+                    help="skip the background what-if query load")
     args = ap.parse_args(argv)
     artifact = run_soak(
         n_slices=args.slices, duration_s=args.duration, loss_pct=args.loss,
         workdir=args.workdir, out=args.out, vvc=not args.no_vvc,
+        serve_load=not args.no_serve_load,
     )
     return 0 if artifact["pass"] else 1
 

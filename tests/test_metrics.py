@@ -105,6 +105,24 @@ def test_estimate_quantiles_from_fixed_buckets():
     assert M.estimate_quantiles((1.0, 2.0), (0, 0, 0)) is None
 
 
+def test_all_zero_count_histogram_has_no_quantiles():
+    # An all-zero-count histogram has no distribution to interpolate:
+    # estimate_quantiles must return None (not garbage like 0.0 or the
+    # first bound) through every consumer layer.
+    assert M.estimate_quantiles((0.5, 1.0, 2.0), np.zeros(4)) is None
+    assert M.estimate_quantiles((0.5,), (0, 0), qs=(0.0, 0.5, 1.0)) is None
+    reg = M.MetricsRegistry()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0), labels=("peer",))
+    h.labels("a")  # registered series, zero observations
+    assert h.labels("a").quantiles() is None
+    snap = reg.snapshot()["lat_seconds"]["values"]["a"]
+    assert snap["count"] == 0
+    assert not any(k.startswith("p") for k in snap)  # no p50/p95/p99 keys
+    # ...and quantiles appear as soon as one observation lands.
+    h.labels("a").observe(0.05)
+    assert h.labels("a").quantiles()["p50"] > 0.0
+
+
 def test_snapshot_histograms_carry_estimated_quantiles():
     reg = M.MetricsRegistry()
     h = reg.histogram("d_seconds", buckets=(0.1, 1.0, 10.0))
