@@ -66,6 +66,7 @@ class Runtime:
     metrics_server: Optional[object] = None  # MetricsServer (--metrics-port)
     serve_service: Optional[object] = None  # serve.Service (--serve-port)
     serve_server: Optional[object] = None  # serve.ServeServer (--serve-port)
+    qsts_jobs: Optional[object] = None  # scenarios.JobManager (--serve-port)
 
     def start(self) -> "Runtime":
         if self.endpoint is not None:
@@ -81,6 +82,8 @@ class Runtime:
             self.endpoint.stop()
         if self.serve_server is not None:
             self.serve_server.stop()
+        if self.qsts_jobs is not None:
+            self.qsts_jobs.stop()
         if self.serve_service is not None:
             self.serve_service.stop()
         if self.metrics_server is not None:
@@ -138,6 +141,17 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--serve-queue-depth", type=int, default=None, metavar="N",
                     help="admission bound in lanes; beyond it requests shed "
                          "with a typed overloaded error (default 512)")
+    ap.add_argument("--qsts-workers", type=int, default=None, metavar="N",
+                    help="background workers for QSTS scenario jobs "
+                         "(default 1; jobs ride the serve port)")
+    ap.add_argument("--qsts-max-jobs", type=int, default=None, metavar="N",
+                    help="pending QSTS jobs bound; past it submissions shed "
+                         "with a typed overloaded error (default 16)")
+    ap.add_argument("--qsts-chunk-steps", type=int, default=None, metavar="T",
+                    help="default QSTS time-chunk length in steps (default 24)")
+    ap.add_argument("--qsts-checkpoint-dir", default=None, metavar="DIR",
+                    help="directory for QSTS chunk-boundary checkpoints "
+                         "(keyed jobs resume across restarts; unset = none)")
     ap.add_argument("--migration-step", type=float, default=None,
                     help="size of LB power migrations")
     ap.add_argument("--malicious-behavior", action="store_true", default=None,
@@ -177,6 +191,9 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("serve_port", "serve_port"), ("serve_max_batch", "serve_max_batch"),
         ("serve_max_wait_ms", "serve_max_wait_ms"),
         ("serve_queue_depth", "serve_queue_depth"),
+        ("qsts_workers", "qsts_workers"), ("qsts_max_jobs", "qsts_max_jobs"),
+        ("qsts_chunk_steps", "qsts_chunk_steps"),
+        ("qsts_checkpoint_dir", "qsts_checkpoint_dir"),
         ("migration_step", "migration_step"),
         ("malicious_behavior", "malicious_behavior"),
         ("check_invariant", "check_invariant"), ("verbose", "verbose"),
@@ -424,11 +441,14 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             f"metrics: http://127.0.0.1:{metrics_server.port}/metrics "
             f"(events: /events)"
         )
-    serve_service = serve_server = None
+    serve_service = serve_server = qsts_jobs = None
     if cfg.serve_port is not None:
         # The what-if query service (freedm_tpu.serve): rides alongside
         # the broker loop — solver engines compile lazily per served
-        # case, so an unqueried server costs one idle thread.
+        # case, so an unqueried server costs one idle thread.  QSTS
+        # scenario jobs (freedm_tpu.scenarios) share the port as the
+        # long-running-batch workload class beside the sync queries.
+        from freedm_tpu.scenarios.jobs import JobManager
         from freedm_tpu.serve import ServeConfig, ServeServer, Service
 
         serve_service = Service(ServeConfig(
@@ -436,14 +456,23 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             max_wait_ms=cfg.serve_max_wait_ms,
             queue_depth=cfg.serve_queue_depth,
         ))
-        serve_server = ServeServer(serve_service, port=cfg.serve_port).start()
+        qsts_jobs = JobManager(
+            workers=cfg.qsts_workers,
+            max_pending=cfg.qsts_max_jobs,
+            checkpoint_dir=cfg.qsts_checkpoint_dir,
+            default_chunk_steps=cfg.qsts_chunk_steps,
+        ).start()
+        serve_server = ServeServer(
+            serve_service, port=cfg.serve_port, jobs=qsts_jobs
+        ).start()
         logger.status(
             f"serve: http://127.0.0.1:{serve_server.port}/v1/pf "
-            f"(n1: /v1/n1, vvc: /v1/vvc, health: /healthz)"
+            f"(n1: /v1/n1, vvc: /v1/vvc, qsts: /v1/qsts, health: /healthz)"
         )
     return Runtime(
         cfg, timings, broker, fleet, factories, vvc, endpoint, federation,
         telemetry, mesh_mod, metrics_server, serve_service, serve_server,
+        qsts_jobs,
     )
 
 

@@ -262,6 +262,16 @@ class GlobalConfig:
     serve_max_batch: int = 64
     serve_max_wait_ms: float = 2.0
     serve_queue_depth: int = 512
+    # QSTS scenario jobs (freedm_tpu.scenarios), exposed on the serve
+    # port as POST /v1/qsts + GET /v1/jobs/<id>: background worker
+    # count (the solvers share one device — 1 is the right default),
+    # pending-queue bound (past it submissions shed with `overloaded`),
+    # the default time-chunk length in steps, and the directory keyed
+    # jobs write chunk-boundary checkpoints into (unset = no resume).
+    qsts_workers: int = 1
+    qsts_max_jobs: int = 16
+    qsts_chunk_steps: int = 24
+    qsts_checkpoint_dir: Optional[str] = None
 
     @property
     def uuid(self) -> str:

@@ -669,6 +669,30 @@ SERVE_SOLVE_LATENCY = REGISTRY.histogram(
     "Batched solve wall time (block_until_ready), per dispatch",
     buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.5, 1.0, 5.0, 20.0),
     labels=("workload",))
+SERVE_WARM_START = REGISTRY.counter(
+    "serve_warm_start_total",
+    "pf requests that supplied a v0/theta0 warm start")
+
+# -- QSTS scenario engine (freedm_tpu.scenarios) ----------------------------
+QSTS_SUBMITTED = REGISTRY.counter(
+    "qsts_jobs_submitted_total", "QSTS jobs accepted by the jobs API")
+QSTS_JOBS = REGISTRY.counter(
+    "qsts_jobs_total",
+    "QSTS jobs by final outcome (completed/failed/cancelled)",
+    labels=("outcome",))
+for _outcome in ("completed", "failed", "cancelled"):
+    QSTS_JOBS.labels(_outcome)
+QSTS_RUNNING = REGISTRY.gauge(
+    "qsts_jobs_running", "QSTS jobs currently executing on a worker")
+QSTS_CHUNK_SECONDS = REGISTRY.histogram(
+    "qsts_chunk_seconds",
+    "Wall time per QSTS time-chunk (profile materialize + batched solve)",
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 20.0, 60.0, 240.0))
+QSTS_SCENARIO_RATE = REGISTRY.gauge(
+    "qsts_scenario_steps_per_sec",
+    "Scenario-timesteps per second of the most recent QSTS chunk")
+QSTS_RESUMES = REGISTRY.counter(
+    "qsts_resumes_total", "QSTS jobs resumed from a chunk checkpoint")
 
 
 def observe_pf_result(solver: str, result) -> None:

@@ -1,0 +1,23 @@
+"""Batched quasi-static time-series (QSTS) scenario engine.
+
+See ``docs/scenarios.md``.  Pieces: seeded deterministic profile
+generators (:mod:`freedm_tpu.scenarios.profiles`), the chunked
+scan-over-time x vmap-over-scenarios runner with warm starts, streaming
+reductions, and chunk-boundary checkpoints
+(:mod:`freedm_tpu.scenarios.engine`), and the async jobs layer the
+serving front end exposes as ``POST /v1/qsts`` / ``GET /v1/jobs/<id>``
+(:mod:`freedm_tpu.scenarios.jobs`).
+"""
+
+from freedm_tpu.scenarios.engine import (  # noqa: F401
+    QstsEngine,
+    StudyCancelled,
+    StudySpec,
+    run_study,
+)
+from freedm_tpu.scenarios.jobs import JobManager, parse_job_request  # noqa: F401
+from freedm_tpu.scenarios.profiles import (  # noqa: F401
+    PROFILE_KINDS,
+    ProfileSet,
+    ProfileSpec,
+)

@@ -1,0 +1,577 @@
+"""Batched quasi-static time-series (QSTS) runner.
+
+The time dimension the one-shot serving queries lack: sweep a day (or
+many days) of per-bus injections over a Monte-Carlo population of
+scenarios, as ``lax.scan`` over timesteps inside a chunk x ``jax.vmap``
+over scenarios, on top of the solvers the tree already ships — the
+batched Newton path for bus cases (:mod:`freedm_tpu.pf.newton`) and the
+ladder sweep for feeder cases (:mod:`freedm_tpu.pf.ladder`).  This is
+the scan-over-time x vmap-over-population shape of ABMax's JAX agent
+populations and SABLE's batched accelerator power flow (PAPERS.md).
+
+Design points:
+
+- **Warm starts.**  Consecutive QSTS operating points differ by one
+  timestep of load drift, so each step's Newton solve starts from the
+  previous step's ``(theta, v)`` — the ``v0``/``theta0`` arguments
+  ``make_newton_solver`` already traces.  ``warm_start=False`` re-seeds
+  the flat start every step (the bench's comparison baseline).  The
+  ladder solver has no warm-start surface (it re-sweeps from the source
+  voltage); feeder studies note ``"warm_start": false`` in the summary.
+- **Streaming on-device reductions.**  The scan carry accumulates
+  voltage-band violation minutes, the min/max voltage envelope, peak
+  branch loading, per-scenario cumulative energy losses, and the
+  worst-case Newton iteration count — host transfer per chunk is
+  O(S + summary), never O(S·T·nb).
+- **Bounded recompiles.**  One jitted program per chunk *shape*: every
+  full chunk shares one program, a ragged final chunk adds at most one
+  more (``QstsEngine.compiles`` counts them; the bench asserts the
+  bound).
+- **Chunk-boundary checkpoints.**  The host-side state (warm-start
+  carry + accumulators) round-trips through numpy between chunks, so a
+  checkpoint (atomic tmp+rename via :func:`runtime.checkpoint.save`)
+  written at a chunk boundary is EXACTLY the state the uninterrupted
+  run would carry — a killed job resumes bit-for-bit, which
+  ``tests/test_scenarios.py`` and the bench's kill/resume row pin.
+  Profile determinism independent of chunking
+  (:mod:`freedm_tpu.scenarios.profiles`) is the other half of that
+  contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, NamedTuple, Optional
+
+import numpy as np
+
+from freedm_tpu.core import tracing
+from freedm_tpu.scenarios.profiles import PROFILE_KINDS, ProfileSet, ProfileSpec
+
+#: Voltage band for violation accounting, pu (ANSI C84.1 service band —
+#: same band the VVC what-if reports against).
+V_BAND = (0.95, 1.05)
+
+CKPT_VERSION = 1
+
+#: Summary keys that legitimately differ between two runs of the same
+#: study (wall-clock and bookkeeping) — the resume-exactness contract
+#: is "summaries equal modulo these"; bench/soak/tests import this so
+#: the strip list cannot drift per consumer.
+SUMMARY_TIMING_KEYS = ("wall_s", "scenario_steps_per_sec", "compiles",
+                       "resumed_from_chunk", "chunks_done")
+
+
+def strip_timing(summary: dict) -> dict:
+    """The comparison view of a summary: timing/bookkeeping keys out."""
+    return {k: v for k, v in summary.items() if k not in SUMMARY_TIMING_KEYS}
+
+#: Finite envelope sentinels (any real voltage replaces them; keeps the
+#: checkpoint JSON free of Infinity literals).
+_V_LO_INIT = 100.0
+_V_HI_INIT = -100.0
+
+
+class StudyCancelled(Exception):
+    """Raised between chunks when the caller's cancel event is set; the
+    last chunk checkpoint (if any) stays on disk for a later resume."""
+
+
+@dataclass(frozen=True)
+class StudySpec:
+    """One QSTS study: case + horizon + profile population.
+
+    ``case`` is the serving registry's vocabulary (bus cases ``case14``
+    / ``case_ieee30`` / ``meshN``, feeder case ``vvc_9bus``).
+    """
+
+    case: str
+    scenarios: int = 16
+    steps: int = 96
+    dt_minutes: float = 15.0
+    seed: int = 0
+    profile: str = "residential"
+    chunk_steps: int = 24
+    warm_start: bool = True
+    max_iter: int = 12
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StudySpec":
+        return cls(**d)
+
+    def profile_spec(self) -> ProfileSpec:
+        return ProfileSpec(
+            scenarios=self.scenarios,
+            steps=self.steps,
+            dt_minutes=self.dt_minutes,
+            seed=self.seed,
+            kind=self.profile,
+        )
+
+
+class BusState(NamedTuple):
+    """Bus-case chunk carry: warm-start point + streaming accumulators."""
+
+    v: np.ndarray  # [S, n] warm-start voltage magnitudes
+    theta: np.ndarray  # [S, n] warm-start angles
+    viol_min: np.ndarray  # [S] bus-minutes outside V_BAND
+    loss_puh: np.ndarray  # [S] cumulative losses, pu·h
+    it_sum: np.ndarray  # [S] total Newton iterations
+    it_max: np.ndarray  # [] worst per-step iteration count
+    nonconv: np.ndarray  # [] lane-steps that failed to converge
+    v_lo: np.ndarray  # [] envelope min
+    v_hi: np.ndarray  # [] envelope max
+    peak_pu: np.ndarray  # [] peak branch apparent power, pu
+
+
+class FeederState(NamedTuple):
+    """Feeder-case chunk carry (ladder restarts cold; no warm carry)."""
+
+    viol_min: np.ndarray  # [S]
+    loss_kwh: np.ndarray  # [S]
+    it_sum: np.ndarray  # [S]
+    it_max: np.ndarray  # []
+    nonconv: np.ndarray  # []
+    v_lo: np.ndarray  # []
+    v_hi: np.ndarray  # []
+    peak_kva: np.ndarray  # []
+
+
+def _resolve_case(name: str):
+    """(kind, case object) via the serving registry's vocabulary — QSTS
+    and the synchronous queries must agree on what a case name means."""
+    from freedm_tpu.serve.service import (
+        FEEDER_CASES,
+        _resolve_bus_case,
+        _resolve_feeder_case,
+    )
+
+    if name in FEEDER_CASES:
+        return "feeder", _resolve_feeder_case(name)
+    return "bus", _resolve_bus_case(name)
+
+
+class QstsEngine:
+    """Compiled chunk runner for one :class:`StudySpec`.
+
+    ``run_chunk`` takes and returns *numpy* state — the host round-trip
+    between chunks is what makes chunk-boundary checkpoints exact.
+    """
+
+    def __init__(self, spec: StudySpec):
+        if spec.profile not in PROFILE_KINDS:
+            raise ValueError(
+                f"unknown profile {spec.profile!r} "
+                f"(have: {', '.join(PROFILE_KINDS)})"
+            )
+        self.spec = spec
+        self.kind, self._case = _resolve_case(spec.case)
+        self.compiles = 0  # distinct chunk shapes compiled (bench bound)
+        self._fns: Dict[int, Callable] = {}
+        if self.kind == "bus":
+            self._init_bus()
+        else:
+            self._init_feeder()
+        self.profiles = ProfileSet(spec.profile_spec(), self._n_profile)
+
+    # -- bus (Newton) path ---------------------------------------------------
+    def _init_bus(self):
+        from freedm_tpu.grid.bus import PQ
+        from freedm_tpu.pf.newton import make_newton_solver
+        from freedm_tpu.utils import cplx
+
+        sys_ = self._case
+        self.solver_name = "newton"
+        self.rdtype = np.dtype(cplx.default_rdtype(None))
+        n = sys_.n_bus
+        self._n_profile = n
+        self._p0 = np.asarray(sys_.p_inj, np.float64)
+        self._q0 = np.asarray(sys_.q_inj, np.float64)
+        load = np.abs(self._p0[self._p0 < 0])
+        self._pv_base = float(load.mean()) if load.size else 0.0
+        self.base_mva = float(sys_.base_mva)
+        bt = np.asarray(sys_.bus_type)
+        self._v_flat = np.where(
+            bt == PQ, 1.0, np.asarray(sys_.v_set, np.float64)
+        ).astype(self.rdtype)
+        solve, _ = make_newton_solver(sys_, max_iter=self.spec.max_iter)
+        self._solve = solve
+
+    def _build_bus_chunk(self, tc: int) -> Callable:
+        import jax
+        import jax.numpy as jnp
+
+        from freedm_tpu.grid.bus import branch_admittances
+        from freedm_tpu.utils import cplx
+
+        spec = self.spec
+        sys_ = self._case
+        solve = self._solve
+        rdtype = self.rdtype
+        dt_min = float(spec.dt_minutes)
+        dt_h = dt_min / 60.0
+        lo, hi = V_BAND
+        f_idx = jnp.asarray(sys_.from_bus)
+        t_idx = jnp.asarray(sys_.to_bus)
+        yff, yft, ytf, ytt = branch_admittances(sys_, dtype=rdtype)
+        flat_v = jnp.asarray(
+            np.broadcast_to(self._v_flat, (spec.scenarios, sys_.n_bus))
+        )
+        flat_th = jnp.zeros_like(flat_v)
+
+        def flow_peak(v, theta):
+            vc = cplx.polar(v, theta)
+            vf, vt = vc[f_idx], vc[t_idx]
+            s_f = vf * (yff * vf + yft * vt).conj()
+            s_t = vt * (ytf * vf + ytt * vt).conj()
+            return jnp.maximum(jnp.max(s_f.abs()), jnp.max(s_t.abs()))
+
+        def step(st: BusState, inj):
+            p_t, q_t = inj
+            r = jax.vmap(
+                lambda p, q, v0, th0: solve(
+                    p_inj=p, q_inj=q, v0=v0, theta0=th0
+                )
+            )(p_t, q_t, st.v, st.theta)
+            vm = r.v
+            outside = (vm < lo) | (vm > hi)
+            iters = r.iterations.astype(jnp.int32)
+            peak = jax.vmap(flow_peak)(r.v, r.theta)
+            nxt_v = r.v if spec.warm_start else flat_v
+            nxt_th = r.theta if spec.warm_start else flat_th
+            return BusState(
+                v=nxt_v,
+                theta=nxt_th,
+                viol_min=st.viol_min
+                + dt_min * jnp.sum(outside, axis=1).astype(st.viol_min.dtype),
+                loss_puh=st.loss_puh
+                + jnp.sum(r.p, axis=1).astype(st.loss_puh.dtype) * dt_h,
+                it_sum=st.it_sum + iters,
+                it_max=jnp.maximum(st.it_max, jnp.max(iters)),
+                nonconv=st.nonconv
+                + jnp.sum(~r.converged).astype(jnp.int32),
+                v_lo=jnp.minimum(st.v_lo, jnp.min(vm)),
+                v_hi=jnp.maximum(st.v_hi, jnp.max(vm)),
+                peak_pu=jnp.maximum(st.peak_pu, jnp.max(peak)),
+            ), None
+
+        def chunk(state: BusState, p, q):  # p, q: [Tc, S, n]
+            out, _ = jax.lax.scan(step, state, (p, q))
+            return out
+
+        return jax.jit(chunk)
+
+    def _bus_injections(self, t0: int, t1: int):
+        """[Tc, S, n] scheduled injections for timesteps [t0, t1):
+        generation tracks load through the common multiplier (the
+        ``scale`` discipline of the serving pf workload), PV rides on
+        top as positive injection at its sited buses."""
+        load, pv = self.profiles.chunk(t0, t1)  # [S, Tc, n]
+        p = self._p0[None, None, :] * load + pv * self._pv_base
+        q = self._q0[None, None, :] * load
+        p = np.ascontiguousarray(p.swapaxes(0, 1)).astype(self.rdtype)
+        q = np.ascontiguousarray(q.swapaxes(0, 1)).astype(self.rdtype)
+        return p, q
+
+    # -- feeder (ladder) path ------------------------------------------------
+    def _init_feeder(self):
+        from freedm_tpu.pf import ladder
+        from freedm_tpu.utils import cplx
+
+        feeder = self._case
+        self.solver_name = "ladder"
+        self.rdtype = np.dtype(cplx.default_rdtype(None))
+        self._n_profile = feeder.n_branches
+        s0 = cplx.as_c(np.asarray(feeder.s_load))
+        self._s0_re = np.asarray(s0.re, np.float64)  # [nb, 3] kW
+        self._s0_im = np.asarray(s0.im, np.float64)  # [nb, 3] kvar
+        load = self._s0_re[self._s0_re > 0]
+        self._pv_base = float(load.mean()) if load.size else 0.0
+        self._live = np.concatenate(
+            [np.ones((1, 3)), np.asarray(feeder.phase_mask)]
+        ) > 0
+        solve, _ = ladder.make_ladder_solver(
+            feeder, max_iter=self.spec.max_iter
+        )
+        self._solve = solve
+
+    def _build_feeder_chunk(self, tc: int) -> Callable:
+        import jax
+        import jax.numpy as jnp
+
+        from freedm_tpu.pf import ladder
+        from freedm_tpu.utils.cplx import C
+
+        spec = self.spec
+        feeder = self._case
+        solve = self._solve
+        dt_min = float(spec.dt_minutes)
+        dt_h = dt_min / 60.0
+        lo, hi = V_BAND
+        live = jnp.asarray(self._live)
+
+        def step(st: FeederState, inj):
+            s_re, s_im = inj  # [S, nb, 3]
+            r = jax.vmap(solve)(C(s_re, s_im))
+            vm = r.v_node.abs()  # [S, nn, 3]
+            outside = ((vm < lo) | (vm > hi)) & live[None]
+            vm_live = jnp.where(live[None], vm, 1.0)
+            loss_kw = jax.vmap(lambda ri: ladder.total_loss_kw(feeder, ri))(r)
+            peak = jax.vmap(
+                lambda ri: jnp.max(ladder.branch_power_kva(feeder, ri).abs())
+            )(r)
+            iters = r.iterations.astype(jnp.int32)
+            return FeederState(
+                viol_min=st.viol_min
+                + dt_min
+                * jnp.sum(outside, axis=(1, 2)).astype(st.viol_min.dtype),
+                loss_kwh=st.loss_kwh + loss_kw.astype(st.loss_kwh.dtype) * dt_h,
+                it_sum=st.it_sum + iters,
+                it_max=jnp.maximum(st.it_max, jnp.max(iters)),
+                nonconv=st.nonconv + jnp.sum(~r.converged).astype(jnp.int32),
+                v_lo=jnp.minimum(st.v_lo, jnp.min(vm_live)),
+                v_hi=jnp.maximum(st.v_hi, jnp.max(vm_live)),
+                peak_kva=jnp.maximum(st.peak_kva, jnp.max(peak)),
+            ), None
+
+        def chunk(state: FeederState, s_re, s_im):  # [Tc, S, nb, 3]
+            out, _ = jax.lax.scan(step, state, (s_re, s_im))
+            return out
+
+        return jax.jit(chunk)
+
+    def _feeder_injections(self, t0: int, t1: int):
+        """[Tc, S, nb, 3] net loads: base loads under the multiplier,
+        PV offsetting real power at its sited nodes."""
+        load, pv = self.profiles.chunk(t0, t1)  # [S, Tc, nb]
+        s_re = (
+            self._s0_re[None, None, :, :] * load[..., None]
+            - (pv * self._pv_base)[..., None]
+        )
+        s_im = self._s0_im[None, None, :, :] * load[..., None]
+        s_re = np.ascontiguousarray(s_re.swapaxes(0, 1)).astype(self.rdtype)
+        s_im = np.ascontiguousarray(s_im.swapaxes(0, 1)).astype(self.rdtype)
+        return s_re, s_im
+
+    # -- state lifecycle -----------------------------------------------------
+    def initial_state(self):
+        s = self.spec.scenarios
+        rd = self.rdtype
+        if self.kind == "bus":
+            n = self._case.n_bus
+            return BusState(
+                v=np.broadcast_to(self._v_flat, (s, n)).astype(rd),
+                theta=np.zeros((s, n), rd),
+                viol_min=np.zeros(s, rd),
+                loss_puh=np.zeros(s, rd),
+                it_sum=np.zeros(s, np.int32),
+                it_max=np.int32(0),
+                nonconv=np.int32(0),
+                v_lo=rd.type(_V_LO_INIT),
+                v_hi=rd.type(_V_HI_INIT),
+                peak_pu=rd.type(0.0),
+            )
+        return FeederState(
+            viol_min=np.zeros(s, rd),
+            loss_kwh=np.zeros(s, rd),
+            it_sum=np.zeros(s, np.int32),
+            it_max=np.int32(0),
+            nonconv=np.int32(0),
+            v_lo=rd.type(_V_LO_INIT),
+            v_hi=rd.type(_V_HI_INIT),
+            peak_kva=rd.type(0.0),
+        )
+
+    def run_chunk(self, state, t0: int, t1: int):
+        """One chunk on device; numpy state in, numpy state out."""
+        import jax
+
+        tc = int(t1 - t0)
+        spec = self.spec
+        with tracing.TRACER.start(
+            "qsts.chunk", kind="qsts",
+            tags={"t0": t0, "steps": tc, "scenarios": spec.scenarios},
+        ):
+            if self.kind == "bus":
+                arrays = self._bus_injections(t0, t1)
+            else:
+                arrays = self._feeder_injections(t0, t1)
+            new_shape = tc not in self._fns
+            if new_shape:
+                self._fns[tc] = (
+                    self._build_bus_chunk(tc)
+                    if self.kind == "bus"
+                    else self._build_feeder_chunk(tc)
+                )
+                self.compiles += 1
+            with tracing.TRACER.start(
+                f"pf.solve:{self.solver_name}", kind="solve",
+                tags={"solver": self.solver_name, "jit_compile": new_shape,
+                      "steps": tc},
+            ):
+                out = self._fns[tc](state, *arrays)
+                out = jax.block_until_ready(out)
+        return type(state)(*(np.asarray(x) for x in out))
+
+    # -- checkpoint serialization -------------------------------------------
+    def state_to_jsonable(self, state) -> dict:
+        # float -> repr-roundtrip-exact JSON; the restored state is
+        # bit-identical, which the resume-equality contract needs.
+        return {k: np.asarray(v).tolist() for k, v in state._asdict().items()}
+
+    def state_from_jsonable(self, d: dict):
+        cls = BusState if self.kind == "bus" else FeederState
+        ref = self.initial_state()
+        return cls(**{
+            k: np.asarray(d[k], dtype=np.asarray(getattr(ref, k)).dtype)
+            for k in cls._fields
+        })
+
+    # -- summary -------------------------------------------------------------
+    def summarize(self, state, steps_done: int, wall_s: float = 0.0) -> dict:
+        spec = self.spec
+        lane_steps = max(int(steps_done) * spec.scenarios, 1)
+        out = {
+            "case": spec.case,
+            "solver": self.solver_name,
+            "scenarios": spec.scenarios,
+            "steps": int(steps_done),
+            "dt_minutes": spec.dt_minutes,
+            "warm_start": bool(spec.warm_start and self.kind == "bus"),
+            "violation_bus_minutes_mean": round(
+                float(np.mean(state.viol_min)), 6
+            ),
+            "violation_bus_minutes_max": round(
+                float(np.max(state.viol_min)), 6
+            ),
+            "v_min_pu": round(float(state.v_lo), 6),
+            "v_max_pu": round(float(state.v_hi), 6),
+            "iters_mean": round(float(np.sum(state.it_sum)) / lane_steps, 4),
+            "iters_max": int(state.it_max),
+            "lane_steps_not_converged": int(state.nonconv),
+            "compiles": self.compiles,
+            "wall_s": round(float(wall_s), 3),
+        }
+        if self.kind == "bus":
+            loss_mwh = np.asarray(state.loss_puh, np.float64) * self.base_mva
+            out["energy_loss_mwh_mean"] = float(np.mean(loss_mwh))
+            out["energy_loss_mwh_max"] = float(np.max(loss_mwh))
+            out["peak_branch_mva"] = float(state.peak_pu) * self.base_mva
+            # Conservation stamp: Σ realized P = network losses — small
+            # and non-negative on a sane trajectory (f32 mismatch noise
+            # allows a tiny negative epsilon).
+            out["energy_balance_ok"] = bool(
+                np.min(np.asarray(state.loss_puh, np.float64)) > -1e-4
+            )
+        else:
+            loss_kwh = np.asarray(state.loss_kwh, np.float64)
+            out["energy_loss_kwh_mean"] = float(np.mean(loss_kwh))
+            out["energy_loss_kwh_max"] = float(np.max(loss_kwh))
+            out["peak_branch_kva"] = float(state.peak_kva)
+            # PV backfeed can push a scenario's net substation draw
+            # negative; the stamp bounds the magnitude instead.
+            out["energy_balance_ok"] = bool(
+                np.all(np.isfinite(loss_kwh))
+            )
+        if wall_s > 0:
+            out["scenario_steps_per_sec"] = round(lane_steps / wall_s, 1)
+        return out
+
+
+def run_study(
+    spec: StudySpec,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = True,
+    cancel=None,
+    on_chunk=None,
+    stop_after_chunks: Optional[int] = None,
+    engine: Optional[QstsEngine] = None,
+) -> dict:
+    """Run a QSTS study chunk by chunk; returns the summary dict.
+
+    - ``checkpoint_path``: write the chunk-boundary state there (atomic
+      tmp+rename) and, with ``resume=True``, continue a matching
+      previous study from its last completed chunk.  A checkpoint whose
+      spec differs is ignored (the study restarts clean).
+    - ``cancel``: a ``threading.Event``-like object checked between
+      chunks; set -> :class:`StudyCancelled` (checkpoint retained).
+    - ``on_chunk(done, total, chunk_s, lane_steps)``: progress callback
+      (the jobs layer's metrics hook).
+    - ``stop_after_chunks``: run at most this many chunks this call and
+      return a partial result (``"completed": False``) — the bench's
+      simulated kill.
+    - ``engine``: reuse an already-built :class:`QstsEngine` (and its
+      compiled chunk programs) across calls — the bench's steady-state
+      throughput measurement; its spec must match.
+
+    The returned summary carries ``"completed"``/``"resumed_from_chunk"``
+    alongside the engine's reductions.
+    """
+    if engine is None:
+        engine = QstsEngine(spec)
+    elif engine.spec != spec:
+        raise ValueError("engine was built for a different StudySpec")
+    chunk = max(int(spec.chunk_steps), 1)
+    n_chunks = math.ceil(spec.steps / chunk)
+    state = engine.initial_state()
+    start_chunk = 0
+    if checkpoint_path and resume and os.path.exists(checkpoint_path):
+        from freedm_tpu.runtime import checkpoint as ckpt
+
+        saved = ckpt.load(checkpoint_path)
+        if (
+            saved.get("version") == CKPT_VERSION
+            and saved.get("spec") == spec.to_dict()
+        ):
+            state = engine.state_from_jsonable(saved["state"])
+            start_chunk = int(saved["chunk_index"])
+    t_start = time.monotonic()
+    done_chunks_this_call = 0
+    for k in range(start_chunk, n_chunks):
+        if cancel is not None and cancel.is_set():
+            raise StudyCancelled(f"cancelled before chunk {k}")
+        t0 = k * chunk
+        t1 = min(spec.steps, t0 + chunk)
+        c0 = time.monotonic()
+        state = engine.run_chunk(state, t0, t1)
+        chunk_s = time.monotonic() - c0
+        if checkpoint_path:
+            from freedm_tpu.runtime import checkpoint as ckpt
+
+            ckpt.save(checkpoint_path, {
+                "version": CKPT_VERSION,
+                "spec": spec.to_dict(),
+                "chunk_index": k + 1,
+                "state": engine.state_to_jsonable(state),
+            })
+        if on_chunk is not None:
+            on_chunk(k + 1, n_chunks, chunk_s, (t1 - t0) * spec.scenarios)
+        done_chunks_this_call += 1
+        if (
+            stop_after_chunks is not None
+            and done_chunks_this_call >= stop_after_chunks
+            and k + 1 < n_chunks
+        ):
+            partial = engine.summarize(
+                state, t1, wall_s=time.monotonic() - t_start
+            )
+            partial["completed"] = False
+            partial["chunks_done"] = k + 1
+            partial["chunks_total"] = n_chunks
+            partial["resumed_from_chunk"] = start_chunk
+            return partial
+    summary = engine.summarize(
+        state, spec.steps, wall_s=time.monotonic() - t_start
+    )
+    summary["completed"] = True
+    summary["chunks_done"] = n_chunks
+    summary["chunks_total"] = n_chunks
+    summary["resumed_from_chunk"] = start_chunk
+    return summary

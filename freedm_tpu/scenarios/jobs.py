@@ -1,0 +1,354 @@
+"""Async jobs API for QSTS studies.
+
+A QSTS study is minutes of device work, not the milliseconds the
+synchronous micro-batched queries (:mod:`freedm_tpu.serve`) answer in —
+so it gets the long-running-batch contract instead: ``POST /v1/qsts``
+validates and **returns immediately** with a ``job_id``;
+``GET /v1/jobs/<id>`` polls progress and, once completed, the summary;
+``POST /v1/jobs/<id>/cancel`` stops the study at its next chunk
+boundary (the chunk checkpoint stays on disk, so a cancelled or killed
+job resumes when an identical spec is resubmitted with the same
+``job_key``).
+
+Errors reuse the serving hierarchy (:mod:`freedm_tpu.serve.queue`):
+``invalid_request`` for a malformed spec, ``overloaded`` when the
+bounded pending queue is full, ``not_found`` for unknown job ids,
+``shutting_down`` after :meth:`JobManager.stop`.
+
+A bounded worker pool (default 1 — the solvers share one device, like
+the micro-batcher's single dispatch thread) drains the pending queue.
+Each run records the ``qsts.job`` span; the engine's per-chunk
+``qsts.chunk`` -> ``pf.solve`` spans parent to it through the tracer's
+thread-local stack.  Metrics: ``qsts_jobs_submitted_total``,
+``qsts_jobs_total{outcome}``, ``qsts_jobs_running``,
+``qsts_chunk_seconds``, ``qsts_scenario_steps_per_sec``,
+``qsts_resumes_total`` (:mod:`freedm_tpu.core.metrics`).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from freedm_tpu.core import metrics as obs
+from freedm_tpu.core import tracing
+from freedm_tpu.scenarios.engine import StudyCancelled, StudySpec, run_study
+from freedm_tpu.scenarios.profiles import PROFILE_KINDS
+from freedm_tpu.serve.queue import (
+    InvalidRequest,
+    NotFound,
+    Overloaded,
+    ShuttingDown,
+)
+
+#: Validation bounds: a loopback jobs API still refuses requests whose
+#: tensors could not fit a chip (S·nb bounds the per-timestep batch).
+MAX_SCENARIOS = 1024
+MAX_STEPS = 100_000
+MAX_CHUNK_STEPS = 2048
+MAX_LANE_CELLS = 1_000_000  # scenarios * n_bus ceiling
+
+_JOB_KEY_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
+
+_FIELDS = {
+    "case", "scenarios", "steps", "dt_minutes", "seed", "profile",
+    "chunk_steps", "warm_start", "max_iter", "job_key",
+}
+
+
+def parse_job_request(payload: dict, default_chunk_steps: int = 24):
+    """``(StudySpec, job_key)`` from a JSON payload, every field range-
+    checked with typed errors (mirrors ``serve.service.parse_request``)."""
+    if not isinstance(payload, dict):
+        raise InvalidRequest("request body must be a JSON object")
+    unknown = set(payload) - _FIELDS
+    if unknown:
+        raise InvalidRequest(f"unknown field(s) {sorted(unknown)} for qsts")
+    if "case" not in payload:
+        raise InvalidRequest("missing required field 'case'")
+    case = payload["case"]
+    if not isinstance(case, str) or not case:
+        raise InvalidRequest("'case' must be a non-empty string")
+
+    def _int(name, default, lo, hi):
+        v = payload.get(name, default)
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise InvalidRequest(f"{name!r} must be an integer")
+        if not lo <= v <= hi:
+            raise InvalidRequest(f"{name!r} must be in [{lo}, {hi}], got {v}")
+        return v
+
+    scenarios = _int("scenarios", 16, 1, MAX_SCENARIOS)
+    steps = _int("steps", 96, 1, MAX_STEPS)
+    chunk_steps = _int("chunk_steps", int(default_chunk_steps), 1,
+                       MAX_CHUNK_STEPS)
+    seed = _int("seed", 0, 0, 2**31 - 1)
+    max_iter = _int("max_iter", 12, 1, 64)
+    dt = payload.get("dt_minutes", 15.0)
+    if isinstance(dt, bool) or not isinstance(dt, (int, float)) \
+            or not math.isfinite(dt) or not 0.1 <= dt <= 1440.0:
+        raise InvalidRequest("'dt_minutes' must be in [0.1, 1440]")
+    profile = payload.get("profile", "residential")
+    if profile not in PROFILE_KINDS:
+        raise InvalidRequest(
+            f"unknown profile {profile!r} (have: {', '.join(PROFILE_KINDS)})"
+        )
+    warm = payload.get("warm_start", True)
+    if not isinstance(warm, bool):
+        raise InvalidRequest("'warm_start' must be a boolean")
+    job_key = payload.get("job_key")
+    if job_key is not None and (
+        not isinstance(job_key, str) or not _JOB_KEY_RE.match(job_key)
+    ):
+        raise InvalidRequest(
+            "'job_key' must match [A-Za-z0-9_.-]{1,64} (it names the "
+            "checkpoint file)"
+        )
+    spec = StudySpec(
+        case=case, scenarios=scenarios, steps=steps, dt_minutes=float(dt),
+        seed=seed, profile=profile, chunk_steps=chunk_steps,
+        warm_start=warm, max_iter=max_iter,
+    )
+    # Resolve the case NOW (typed error, and the lane-cell bound needs
+    # its size); the engine built later resolves it again cheaply.
+    from freedm_tpu.scenarios.engine import _resolve_case
+
+    kind, case_obj = _resolve_case(case)
+    n = case_obj.n_bus if kind == "bus" else case_obj.n_branches
+    if scenarios * n > MAX_LANE_CELLS:
+        raise InvalidRequest(
+            f"scenarios x buses = {scenarios * n} exceeds the "
+            f"{MAX_LANE_CELLS} lane-cell ceiling; lower 'scenarios'"
+        )
+    return spec, job_key
+
+
+@dataclass
+class JobRecord:
+    """One submitted study and its lifecycle."""
+
+    id: str
+    spec: StudySpec
+    job_key: Optional[str]
+    state: str = "queued"  # queued|running|completed|failed|cancelled
+    submitted_ts: float = field(default_factory=time.time)
+    started_ts: Optional[float] = None
+    finished_ts: Optional[float] = None
+    chunks_done: int = 0
+    chunks_total: int = 0
+    resumed_from_chunk: int = 0
+    summary: Optional[dict] = None
+    error: Optional[str] = None
+    cancel: threading.Event = field(default_factory=threading.Event)
+
+    def to_dict(self) -> dict:
+        out = {
+            "job_id": self.id,
+            "state": self.state,
+            "spec": self.spec.to_dict(),
+            "submitted_ts": round(self.submitted_ts, 3),
+            "chunks_done": self.chunks_done,
+            "chunks_total": self.chunks_total,
+            "resumed_from_chunk": self.resumed_from_chunk,
+        }
+        if self.job_key is not None:
+            out["job_key"] = self.job_key
+        if self.started_ts is not None:
+            out["started_ts"] = round(self.started_ts, 3)
+        if self.finished_ts is not None:
+            out["finished_ts"] = round(self.finished_ts, 3)
+        if self.summary is not None:
+            out["summary"] = self.summary
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+
+class JobManager:
+    """Bounded background execution of QSTS studies.
+
+    ``submit`` -> job dict (typed errors synchronously); ``get``/
+    ``cancel`` by job id.  Finished jobs stay pollable until the table
+    (``MAX_TABLE``) evicts the oldest finished entries.
+    """
+
+    MAX_TABLE = 256
+
+    def __init__(self, workers: int = 1, max_pending: int = 16,
+                 checkpoint_dir: Optional[str] = None,
+                 default_chunk_steps: int = 24):
+        self.workers = max(int(workers), 1)
+        self.max_pending = max(int(max_pending), 1)
+        self.checkpoint_dir = checkpoint_dir
+        self.default_chunk_steps = int(default_chunk_steps)
+        self._cond = threading.Condition()
+        self._pending: deque = deque()
+        self._jobs: "OrderedDict[str, JobRecord]" = OrderedDict()
+        self._closed = False
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "JobManager":
+        if not self._threads:
+            self._threads = [
+                threading.Thread(
+                    target=self._run, name=f"qsts-worker-{i}", daemon=True
+                )
+                for i in range(self.workers)
+            ]
+            for t in self._threads:
+                t.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._cond:
+            self._closed = True
+            for rec in self._jobs.values():
+                rec.cancel.set()
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+    # -- submission / polling ------------------------------------------------
+    def submit(self, payload: dict) -> dict:
+        spec, job_key = parse_job_request(payload, self.default_chunk_steps)
+        rec = JobRecord(id=os.urandom(8).hex(), spec=spec, job_key=job_key)
+        rec.chunks_total = math.ceil(spec.steps / spec.chunk_steps)
+        with self._cond:
+            if self._closed:
+                raise ShuttingDown("jobs API is stopping")
+            if len(self._pending) >= self.max_pending:
+                raise Overloaded(
+                    f"qsts queue at depth ({len(self._pending)}/"
+                    f"{self.max_pending} jobs); retry with backoff"
+                )
+            while len(self._jobs) >= self.MAX_TABLE:
+                evicted = next(
+                    (k for k, r in self._jobs.items()
+                     if r.state in ("completed", "failed", "cancelled")),
+                    None,
+                )
+                if evicted is None:
+                    raise Overloaded("job table full of live jobs")
+                del self._jobs[evicted]
+            self._jobs[rec.id] = rec
+            self._pending.append(rec)
+            # Snapshot under the lock: the response reflects admission
+            # ("queued"), not a race with a worker that already started.
+            out = rec.to_dict()
+            self._cond.notify()
+        obs.QSTS_SUBMITTED.inc()
+        obs.EVENTS.emit("qsts.submitted", job_id=rec.id, case=spec.case,
+                        scenarios=spec.scenarios, steps=spec.steps)
+        return out
+
+    def get(self, job_id: str) -> dict:
+        with self._cond:
+            rec = self._jobs.get(job_id)
+        if rec is None:
+            raise NotFound(f"no such job: {job_id!r}")
+        return rec.to_dict()
+
+    def cancel(self, job_id: str) -> dict:
+        with self._cond:
+            rec = self._jobs.get(job_id)
+            if rec is None:
+                raise NotFound(f"no such job: {job_id!r}")
+            rec.cancel.set()
+            if rec.state == "queued":
+                # Never started: settle it here (the worker skips it).
+                rec.state = "cancelled"
+                rec.finished_ts = time.time()
+                obs.QSTS_JOBS.labels("cancelled").inc()
+        return rec.to_dict()
+
+    def stats(self) -> dict:
+        with self._cond:
+            states: Dict[str, int] = {}
+            for rec in self._jobs.values():
+                states[rec.state] = states.get(rec.state, 0) + 1
+            return {
+                "jobs": len(self._jobs),
+                "pending": len(self._pending),
+                "by_state": states,
+                "workers": self.workers,
+            }
+
+    # -- worker --------------------------------------------------------------
+    def _checkpoint_path(self, rec: JobRecord) -> Optional[str]:
+        if rec.job_key is None or not self.checkpoint_dir:
+            return None
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        return os.path.join(self.checkpoint_dir, f"qsts_{rec.job_key}.json")
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait(0.5)
+                if self._closed and not self._pending:
+                    return
+                rec = self._pending.popleft() if self._pending else None
+                if rec is None:
+                    continue
+                if rec.state != "queued":  # cancelled while queued
+                    continue
+                rec.state = "running"
+                rec.started_ts = time.time()
+            self._execute(rec)
+
+    def _execute(self, rec: JobRecord) -> None:
+        spec = rec.spec
+        obs.QSTS_RUNNING.inc()
+        span = tracing.TRACER.start(
+            "qsts.job", kind="qsts",
+            tags={"job_id": rec.id, "case": spec.case,
+                  "scenarios": spec.scenarios, "steps": spec.steps},
+        )
+
+        def on_chunk(done, total, chunk_s, lane_steps):
+            rec.chunks_done = done
+            rec.chunks_total = total
+            obs.QSTS_CHUNK_SECONDS.observe(chunk_s)
+            if chunk_s > 0:
+                obs.QSTS_SCENARIO_RATE.set(lane_steps / chunk_s)
+
+        ckpt_path = self._checkpoint_path(rec)
+        try:
+            with span.activate():
+                summary = run_study(
+                    spec, checkpoint_path=ckpt_path, resume=True,
+                    cancel=rec.cancel, on_chunk=on_chunk,
+                )
+            rec.summary = summary
+            rec.resumed_from_chunk = summary.get("resumed_from_chunk", 0)
+            if rec.resumed_from_chunk:
+                obs.QSTS_RESUMES.inc()
+            rec.state = "completed"
+            span.tag(outcome="completed", chunks=rec.chunks_done)
+            obs.QSTS_JOBS.labels("completed").inc()
+            obs.EVENTS.emit("qsts.completed", job_id=rec.id,
+                            chunks=rec.chunks_done,
+                            resumed_from=rec.resumed_from_chunk)
+        except StudyCancelled:
+            rec.state = "cancelled"
+            span.tag(outcome="cancelled")
+            obs.QSTS_JOBS.labels("cancelled").inc()
+            obs.EVENTS.emit("qsts.cancelled", job_id=rec.id,
+                            chunks=rec.chunks_done)
+        except Exception as e:  # noqa: BLE001 — pollers must see failures
+            rec.state = "failed"
+            rec.error = repr(e)
+            span.tag(outcome="failed", error=repr(e))
+            obs.QSTS_JOBS.labels("failed").inc()
+            obs.EVENTS.emit("qsts.failed", job_id=rec.id, error=repr(e))
+        finally:
+            rec.finished_ts = time.time()
+            span.end()
+            obs.QSTS_RUNNING.dec()

@@ -11,6 +11,7 @@ from freedm_tpu.serve.queue import (  # noqa: F401
     AdmissionQueue,
     DeadlineExceeded,
     InvalidRequest,
+    NotFound,
     Overloaded,
     ServeError,
     ShuttingDown,

@@ -1,4 +1,4 @@
-"""Zero-dependency JSON front end for the query service.
+"""Zero-dependency JSON front end for the query service + QSTS jobs.
 
 Same machinery as the metrics exposition endpoint
 (:class:`freedm_tpu.core.metrics.MetricsServer`): stdlib
@@ -13,14 +13,27 @@ Routes:
   matching the workload's request record
   (:mod:`freedm_tpu.serve.service`); 200 with the typed response dict
   on success.
+- ``POST /v1/qsts`` — submit a QSTS study to the async jobs layer
+  (:mod:`freedm_tpu.scenarios.jobs`); 202 with ``{"job_id": ...}``.
+- ``GET /v1/jobs/<id>`` — poll a job (progress, then the summary);
+  ``POST /v1/jobs/<id>/cancel`` — stop it at the next chunk boundary.
 - ``GET /healthz`` — liveness + the workload/case table.
 - ``GET /stats`` — queue depth, bucket table, serve metric snapshot.
 
 Errors are *typed*, never free-text-only: the body is always
 ``{"error": {"type": <ServeError.code>, "detail": ...}}`` with the
-matching HTTP status (400 invalid_request, 429 overloaded, 503
-shutting_down, 504 deadline_exceeded, 500 internal).  Clients switch on
-``error.type``; 429/503 mean back off and retry, 400/504 mean don't.
+matching HTTP status (400 invalid_request, 404 not_found, 429
+overloaded, 503 shutting_down, 504 deadline_exceeded, 500 internal).
+Clients switch on ``error.type``; 429/503 mean back off and retry,
+400/404/504 mean don't.
+
+Keep-alive discipline: handlers speak HTTP/1.1 persistent connections,
+so every error path must leave the socket **positionally clean** — the
+declared request body is read (drained) before any routing or
+validation can fail, and a body the server refuses to read (oversized,
+bogus ``Content-Length``) answers with ``Connection: close`` so the
+unread bytes can never be parsed as the next pipelined request.
+``tests/test_serve.py`` pins this with two requests on one socket.
 """
 
 from __future__ import annotations
@@ -30,22 +43,24 @@ from http.server import BaseHTTPRequestHandler
 from urllib.parse import urlparse
 
 from freedm_tpu.core.metrics import BackgroundHttpServer
-from freedm_tpu.serve.queue import InvalidRequest, ServeError
+from freedm_tpu.serve.queue import InvalidRequest, NotFound, ServeError
 from freedm_tpu.serve.service import BUS_CASES, FEEDER_CASES, WORKLOADS, Service
 
-#: Request bodies past this are rejected before parsing (a 256-outage
-#: N-1 request is ~2 KB; nothing legitimate approaches a megabyte).
+#: Request bodies past this are refused unread (a 256-outage N-1
+#: request is ~2 KB; nothing legitimate approaches a megabyte).
 MAX_BODY_BYTES = 4_000_000
 
 
 class ServeServer(BackgroundHttpServer):
-    """``--serve-port``: the JSON query endpoint."""
+    """``--serve-port``: the JSON query endpoint (+ QSTS jobs when a
+    :class:`~freedm_tpu.scenarios.jobs.JobManager` is attached)."""
 
     def __init__(self, service: Service, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", jobs=None):
         # Loopback by default, like the metrics server: the service has
         # no auth; widening the bind is an explicit caller decision.
         svc = service
+        jm = jobs
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -58,6 +73,10 @@ class ServeServer(BackgroundHttpServer):
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                if self.close_connection:
+                    # An unread body is still on the socket: tell the
+                    # client this connection is done.
+                    self.send_header("Connection", "close")
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -65,46 +84,94 @@ class ServeServer(BackgroundHttpServer):
                 self._reply(err.http_status,
                             {"error": {"type": err.code, "detail": str(err)}})
 
+            def _jobs(self):
+                if jm is None:
+                    raise NotFound(
+                        "QSTS jobs are not enabled on this server"
+                    )
+                return jm
+
             def do_GET(self):
                 path = urlparse(self.path).path
-                if path == "/healthz":
-                    self._reply(200, {
-                        "ok": True,
-                        "workloads": list(WORKLOADS),
-                        "bus_cases": list(BUS_CASES),
-                        "feeder_cases": list(FEEDER_CASES),
-                    })
-                elif path == "/stats":
-                    self._reply(200, svc.stats())
-                elif path == "/":
-                    self._reply(200, {
-                        "service": "freedm_tpu serve",
-                        "post": [f"/v1/{w}" for w in WORKLOADS],
-                        "get": ["/healthz", "/stats"],
-                    })
-                else:
-                    self._reply(404, {"error": {"type": "not_found",
-                                                "detail": path}})
+                try:
+                    # GETs can legally carry a body (some proxies do):
+                    # drain it like POST does, or the leftover bytes
+                    # corrupt the next pipelined request.
+                    self._read_body()
+                    if path == "/healthz":
+                        self._reply(200, {
+                            "ok": True,
+                            "workloads": list(WORKLOADS),
+                            "bus_cases": list(BUS_CASES),
+                            "feeder_cases": list(FEEDER_CASES),
+                            "qsts": jm is not None,
+                        })
+                    elif path == "/stats":
+                        stats = svc.stats()
+                        if jm is not None:
+                            stats["qsts"] = jm.stats()
+                        self._reply(200, stats)
+                    elif path.startswith("/v1/jobs/"):
+                        job_id = path[len("/v1/jobs/"):]
+                        self._reply(200, self._jobs().get(job_id))
+                    elif path == "/":
+                        self._reply(200, {
+                            "service": "freedm_tpu serve",
+                            "post": [f"/v1/{w}" for w in WORKLOADS]
+                            + ["/v1/qsts", "/v1/jobs/<id>/cancel"],
+                            "get": ["/healthz", "/stats", "/v1/jobs/<id>"],
+                        })
+                    else:
+                        self._reply(404, {"error": {"type": "not_found",
+                                                    "detail": path}})
+                except ServeError as e:
+                    self._error(e)
+                except Exception as e:  # noqa: BLE001 — always answer typed
+                    self._reply(500, {"error": {"type": "internal",
+                                                "detail": repr(e)}})
+
+            def _read_body(self) -> bytes:
+                """Read the declared request body, or refuse it with the
+                connection marked for close — either way the socket is
+                left clean for (or closed against) the next pipelined
+                request."""
+                raw = self.headers.get("Content-Length") or "0"
+                try:
+                    length = int(raw)
+                except ValueError:
+                    length = -1
+                if length < 0 or length > MAX_BODY_BYTES:
+                    self.close_connection = True
+                    raise InvalidRequest(
+                        f"request body over {MAX_BODY_BYTES} bytes or "
+                        f"Content-Length unparseable ({raw!r})"
+                    )
+                return self.rfile.read(length) if length else b""
 
             def do_POST(self):
                 path = urlparse(self.path).path
-                if not path.startswith("/v1/"):
-                    self._reply(404, {"error": {"type": "not_found",
-                                                "detail": path}})
-                    return
-                workload = path[len("/v1/"):]
                 try:
-                    length = int(self.headers.get("Content-Length") or 0)
-                    if length <= 0:
+                    # Drain FIRST: everything after this point can fail
+                    # without corrupting the persistent connection.
+                    body = self._read_body()
+                    if path.startswith("/v1/jobs/") and path.endswith("/cancel"):
+                        job_id = path[len("/v1/jobs/"):-len("/cancel")]
+                        self._reply(200, self._jobs().cancel(job_id))
+                        return
+                    if not path.startswith("/v1/"):
+                        self._reply(404, {"error": {"type": "not_found",
+                                                    "detail": path}})
+                        return
+                    if not body:
                         raise InvalidRequest("missing JSON request body")
-                    if length > MAX_BODY_BYTES:
-                        raise InvalidRequest(
-                            f"request body over {MAX_BODY_BYTES} bytes"
-                        )
                     try:
-                        payload = json.loads(self.rfile.read(length))
+                        payload = json.loads(body)
                     except ValueError as e:
                         raise InvalidRequest(f"malformed JSON: {e}") from None
+                    if path == "/v1/qsts":
+                        self._reply(202, self._jobs().submit(payload))
+                        return
+                    workload = path[len("/v1/"):]
                     response = svc.request(workload, payload)
                     self._reply(200, response.to_dict())
                 except ServeError as e:

@@ -78,6 +78,14 @@ class ShuttingDown(ServeError):
     http_status = 503
 
 
+class NotFound(ServeError):
+    """The named resource (a job id, a disabled subsystem) does not
+    exist on this server."""
+
+    code = "not_found"
+    http_status = 404
+
+
 class Ticket:
     """One admitted request, queued for a batch slot."""
 

@@ -72,12 +72,18 @@ class PowerFlowRequest:
 
     ``p_inj``/``q_inj`` are full per-bus vectors in system pu (length
     ``n_bus``); omitted, the case's stored injections scaled by
-    ``scale`` are used.
+    ``scale`` are used.  ``v0``/``theta0`` optionally warm-start the
+    Newton iteration from a previous solution (same ``[n]`` validation)
+    — a repeated what-if client gets the same iteration savings the
+    QSTS engine's step-to-step carry does; omitted, the flat start is
+    used.
     """
 
     case: str
     p_inj: Optional[Sequence[float]] = None
     q_inj: Optional[Sequence[float]] = None
+    v0: Optional[Sequence[float]] = None
+    theta0: Optional[Sequence[float]] = None
     scale: float = 1.0
     # Full [n] voltage/angle vectors in the response.  Off by default:
     # summary stats answer most what-ifs, and building per-bus lists is
@@ -289,15 +295,30 @@ class PowerFlowEngine(_Engine):
         super().__init__(case)
         import jax
 
+        from freedm_tpu.grid.bus import PQ
         from freedm_tpu.pf.newton import make_newton_solver
 
         sys_ = _resolve_bus_case(case)
         self.n_bus = sys_.n_bus
         self._p0 = np.asarray(sys_.p_inj, np.float64)
         self._q0 = np.asarray(sys_.q_inj, np.float64)
-        _, solve_fixed = make_newton_solver(sys_, max_iter=max_iter)
+        # Flat start (the solver's own default): PQ magnitudes at 1.0,
+        # pinned buses at their setpoint, zero angles — what a request
+        # without v0/theta0 runs from.
+        bt = np.asarray(sys_.bus_type)
+        self._v0_flat = np.where(
+            bt == PQ, 1.0, np.asarray(sys_.v_set, np.float64)
+        )
+        self._theta0_flat = np.zeros(self.n_bus)
+        # The while-loop solve (not the fixed-iteration scan): per-lane
+        # iteration counts are real under vmap (converged lanes stop
+        # updating), so the response's `iterations` and the pf metrics
+        # actually show what a warm start saves.
+        solve, _ = make_newton_solver(sys_, max_iter=max_iter)
         self._batched = jax.jit(
-            jax.vmap(lambda p, q: solve_fixed(p_inj=p, q_inj=q))
+            jax.vmap(lambda p, q, v0, th0: solve(
+                p_inj=p, q_inj=q, v0=v0, theta0=th0
+            ))
         )
 
     def validate(self, req: PowerFlowRequest):
@@ -313,12 +334,30 @@ class PowerFlowEngine(_Engine):
             if req.q_inj is not None
             else self._q0 * req.scale
         )
-        return {"p": p, "q": q}
+        if req.v0 is not None:
+            v0 = _as_vector(req.v0, self.n_bus, "v0")
+            if np.any(v0 < 0.1) or np.any(v0 > 2.0):
+                raise InvalidRequest(
+                    "v0 magnitudes must be in [0.1, 2.0] pu"
+                )
+        else:
+            v0 = self._v0_flat
+        if req.theta0 is not None:
+            th0 = _as_vector(req.theta0, self.n_bus, "theta0")
+            if np.any(np.abs(th0) > 2.0 * np.pi):
+                raise InvalidRequest("theta0 angles must be within ±2π rad")
+        else:
+            th0 = self._theta0_flat
+        if req.v0 is not None or req.theta0 is not None:
+            obs.SERVE_WARM_START.inc()
+        return {"p": p, "q": q, "v0": v0, "th0": th0}
 
     def assemble(self, group: List[Ticket], bucket: int):
         p = _pad_rows(np.stack([t.prepared["p"] for t in group]), bucket)
         q = _pad_rows(np.stack([t.prepared["q"] for t in group]), bucket)
-        return p, q
+        v0 = _pad_rows(np.stack([t.prepared["v0"] for t in group]), bucket)
+        th0 = _pad_rows(np.stack([t.prepared["th0"] for t in group]), bucket)
+        return p, q, v0, th0
 
     def solve(self, batch):
         import jax
@@ -335,6 +374,11 @@ class PowerFlowEngine(_Engine):
         its = np.asarray(r.iterations)
         conv = np.asarray(r.converged)
         mism = np.asarray(r.mismatch)
+        # The result is host-side here anyway — record the served lanes'
+        # iteration counts on the existing pf metrics, so a scrape shows
+        # the iteration savings warm-started clients are getting.
+        obs.PF_ITERATIONS.labels("newton").observe(its[: len(group)])
+        obs.PF_RESIDUAL.labels("newton").set(float(mism[: len(group)].max()))
         p_bal = p.sum(axis=1)
         q_bal = q.sum(axis=1)
         v_min = v.min(axis=1)

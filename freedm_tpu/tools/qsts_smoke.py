@@ -1,0 +1,124 @@
+"""CI smoke for the QSTS jobs stack: submit, poll, verify the summary.
+
+Starts a real :class:`~freedm_tpu.serve.ServeServer` with a
+:class:`~freedm_tpu.scenarios.jobs.JobManager` on an ephemeral port,
+submits a small-S, T=24 study on the 9-bus reference feeder through
+``POST /v1/qsts``, polls ``GET /v1/jobs/<id>`` to completion, and
+sanity-asserts the summary (violation minutes finite, energy balance
+stamped, every lane-step converged).  Typed-error paths (bad spec,
+unknown job id) are exercised too.  One command, exit code 0 iff
+healthy:
+
+    python -m freedm_tpu.tools.qsts_smoke
+
+Used by ``.github/workflows/ci.yml``; also a handy local sanity check
+after touching the scenarios path.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Tuple
+
+POLL_TIMEOUT_S = 300.0
+
+
+def _post(port: int, path: str, payload: dict) -> Tuple[int, dict]:
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+def _get(port: int, path: str) -> Tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from freedm_tpu.scenarios.jobs import JobManager
+    from freedm_tpu.serve import ServeConfig, ServeServer, Service
+
+    svc = Service(ServeConfig(max_batch=4, buckets=(1, 4)))
+    jm = JobManager(workers=1).start()
+    srv = ServeServer(svc, port=0, jobs=jm).start()
+    print(f"[qsts-smoke] server on port {srv.port}", flush=True)
+    failures: List[str] = []
+
+    def ok(name: str, cond: bool, detail: str = "") -> None:
+        print(f"[qsts-smoke] {'ok  ' if cond else 'FAIL'} {name}  {detail}",
+              flush=True)
+        if not cond:
+            failures.append(name)
+
+    try:
+        code, d = _post(srv.port, "/v1/qsts", {
+            "case": "vvc_9bus", "scenarios": 4, "steps": 24,
+            "dt_minutes": 60.0, "chunk_steps": 8, "seed": 3,
+        })
+        ok("submit_202", code == 202 and "job_id" in d, f"code={code} {d}")
+        job_id = d.get("job_id", "")
+        deadline = time.monotonic() + POLL_TIMEOUT_S
+        j = {}
+        while time.monotonic() < deadline:
+            code, j = _get(srv.port, f"/v1/jobs/{job_id}")
+            if code != 200 or j.get("state") in ("completed", "failed",
+                                                 "cancelled"):
+                break
+            time.sleep(0.5)
+        ok("job_completed", j.get("state") == "completed",
+           f"state={j.get('state')} error={j.get('error')}")
+        s = j.get("summary") or {}
+        ok("violation_minutes_finite",
+           math.isfinite(s.get("violation_bus_minutes_mean", math.nan)),
+           f"viol={s.get('violation_bus_minutes_mean')}")
+        ok("energy_balance_stamped", s.get("energy_balance_ok") is True,
+           f"loss_kwh_mean={s.get('energy_loss_kwh_mean')}")
+        ok("all_converged", s.get("lane_steps_not_converged") == 0,
+           f"nonconv={s.get('lane_steps_not_converged')}")
+        ok("progress_counted",
+           j.get("chunks_done") == j.get("chunks_total") == 3,
+           f"chunks={j.get('chunks_done')}/{j.get('chunks_total')}")
+
+        code, d = _post(srv.port, "/v1/qsts", {"case": "vvc_9bus",
+                                               "scenarios": 10**9})
+        ok("typed_invalid_spec",
+           code == 400 and d["error"]["type"] == "invalid_request",
+           f"code={code}")
+        code, d = _get(srv.port, "/v1/jobs/deadbeef")
+        ok("typed_job_not_found",
+           code == 404 and d["error"]["type"] == "not_found",
+           f"code={code}")
+        code, d = _get(srv.port, "/stats")
+        ok("stats_counts_jobs",
+           code == 200 and d.get("qsts", {}).get("jobs", 0) >= 1,
+           f"qsts={d.get('qsts')}")
+    finally:
+        srv.stop()
+        jm.stop()
+        svc.stop()
+    print(json.dumps({"qsts_smoke_pass": not failures,
+                      "failed": failures}), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,6 +79,8 @@ SCRAPE_KEYS = (
     "broker_rounds_total",
     "federation_migrations_total",
     "serve_shed_total",
+    "qsts_jobs_submitted_total",
+    "qsts_resumes_total",
 )
 
 
@@ -329,6 +331,116 @@ class ServeLoader:
         }
 
 
+class QstsProbe:
+    """One QSTS job driven across the kill/restart schedule.
+
+    The study is submitted (with a stable ``job_key``) to the slice the
+    schedule is about to kill; after the slice restarts, the SAME spec
+    is resubmitted and the server resumes it from its chunk-boundary
+    checkpoint (``qsts-checkpoint-dir`` in the slice config).  At the
+    end the finished summary is compared against an uninterrupted
+    reference run computed in this process — they must match EXACTLY
+    (timing keys aside), which is the QSTS resume-determinism contract
+    (deterministic profiles + exact chunk-state roundtrip).
+    """
+
+    #: Long enough to straddle the kill (16 chunks), small enough for a
+    #: CPU slice: 4 scenarios x 4 days of 15-min steps on case14.
+    SPEC = {
+        "case": "case14", "scenarios": 4, "steps": 384,
+        "dt_minutes": 15.0, "chunk_steps": 24, "seed": 11,
+        "job_key": "soakprobe",
+    }
+
+    def __init__(self, port: int):
+        self.port = int(port)
+        self.job_id: Optional[str] = None
+        self.submitted = False
+        self.resubmitted = False
+        self.chunks_before_kill = 0
+
+    def _post(self, path: str, payload: dict, timeout_s: float = 60.0):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return json.loads(r.read())
+
+    def submit(self, timeout_s: float = 120.0) -> bool:
+        """Submit (or resubmit after a restart); tolerant of a slice
+        that is still compiling — the caller records the outcome."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                d = self._post("/v1/qsts", self.SPEC)
+                self.job_id = d["job_id"]
+                self.resubmitted = self.submitted
+                self.submitted = True
+                return True
+            except Exception:
+                time.sleep(2.0)
+        return False
+
+    def _poll(self) -> Dict:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{self.port}/v1/jobs/{self.job_id}",
+            timeout=10,
+        ) as r:
+            return json.loads(r.read())
+
+    def wait_chunks(self, n: int, timeout_s: float) -> bool:
+        """Block until the job has completed >= n chunks (i.e. a chunk
+        checkpoint is on disk) — the kill must interrupt a study that
+        has real state to resume, or the resume path isn't exercised."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                j = self._poll()
+                self.chunks_before_kill = int(j.get("chunks_done", 0))
+                if self.chunks_before_kill >= n:
+                    return True
+                if j.get("state") in ("completed", "failed", "cancelled"):
+                    return self.chunks_before_kill >= n
+            except Exception:
+                pass
+            time.sleep(1.0)
+        return False
+
+    def wait(self, timeout_s: float) -> Dict:
+        """Poll the job to a terminal state; {} if unreachable."""
+        deadline = time.monotonic() + timeout_s
+        last: Dict = {}
+        while time.monotonic() < deadline:
+            try:
+                last = self._poll()
+                if last.get("state") in ("completed", "failed", "cancelled"):
+                    return last
+            except Exception:
+                pass
+            time.sleep(2.0)
+        return last
+
+    def reference_summary(self) -> Dict:
+        """The uninterrupted run, computed in THIS process (same jax
+        platform/dtype as the slices: CPU default precision)."""
+        from freedm_tpu.scenarios.engine import StudySpec, run_study
+
+        spec = {k: v for k, v in self.SPEC.items() if k != "job_key"}
+        return run_study(StudySpec(**spec))
+
+    @staticmethod
+    def strip_timing(summary: Dict) -> Dict:
+        from freedm_tpu.scenarios.engine import strip_timing
+
+        return strip_timing(summary)
+
+
 def wait_for(procs: List[Proc], cond, timeout_s: float) -> bool:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
@@ -456,6 +568,7 @@ def write_configs(
         # broker round loop coexist through kills/rejoins.
         serve_line = (
             f"serve-port = {spec.serve_port}\n"
+            f"qsts-checkpoint-dir = {workdir}/qsts_{spec.port}\n"
             if spec.serve_port is not None
             else ""
         )
@@ -487,6 +600,7 @@ def run_soak(
     out: Optional[str] = None,
     vvc: bool = True,
     serve_load: bool = True,
+    qsts_probe: bool = False,
 ) -> Dict:
     import tempfile
 
@@ -598,6 +712,23 @@ def run_soak(
 
         # -- fault schedule --------------------------------------------------
         member = next(p for p in procs if p.spec.uuid != leader_uuid)
+        # QSTS probe: a long-running scenario job on the very slice the
+        # schedule is about to kill (resubmitted after its restart; the
+        # final summary must match an uninterrupted reference exactly).
+        probe: Optional[QstsProbe] = None
+        if qsts_probe and member.spec.serve_port is not None:
+            probe = QstsProbe(member.spec.serve_port)
+            check.record("qsts_probe_submitted", probe.submit(),
+                         f"target={member.spec.uuid}")
+            if probe.submitted:
+                # The kill must land MID-STUDY: wait for >=1 completed
+                # chunk so a checkpoint exists and the resubmission
+                # actually exercises cross-process resume.
+                check.record(
+                    "qsts_probe_checkpointed_before_kill",
+                    probe.wait_chunks(1, timeout_s=form_timeout),
+                    f"chunks_done={probe.chunks_before_kill}",
+                )
         member.kill()
         survivors = [p for p in procs if p.alive()]
         ok = wait_for(survivors, lambda: all(
@@ -611,6 +742,13 @@ def run_soak(
         ok = wait_for(procs, members_everywhere(n_slices), form_timeout)
         check.record("member_rejoin_remerges", ok,
                      f"members={[p.last().get('fed_members') for p in procs]}")
+
+        if probe is not None and probe.submitted:
+            # Resubmit the identical spec to the restarted slice: its
+            # jobs layer finds the chunk checkpoint and resumes.
+            check.record("qsts_probe_resubmitted",
+                         probe.submit(timeout_s=form_timeout),
+                         "same job_key after restart")
 
         # Kill the LEADER: re-election among survivors + slave VVC
         # fallback (members keep volt-var alive without their master).
@@ -651,6 +789,34 @@ def run_soak(
 
         crashed = [p.spec.uuid for p in procs if not p.alive()]
         check.record("no_unexpected_crashes", not crashed, f"crashed={crashed}")
+
+        if probe is not None and probe.submitted:
+            job = probe.wait(timeout_s=max(2.0 * form_timeout, 300.0))
+            completed = job.get("state") == "completed"
+            resumed_from = (job.get("summary") or {}).get(
+                "resumed_from_chunk", 0
+            )
+            check.record(
+                "qsts_probe_completes", completed,
+                f"state={job.get('state')} resumed_from={resumed_from}",
+            )
+            if completed:
+                if probe.chunks_before_kill >= 1:
+                    # A checkpoint existed pre-kill: the finished job
+                    # must have RESUMED, not silently restarted.
+                    check.record(
+                        "qsts_probe_resumed_mid_study", resumed_from >= 1,
+                        f"resumed_from_chunk={resumed_from} after "
+                        f"{probe.chunks_before_kill} pre-kill chunks",
+                    )
+                ref = probe.reference_summary()
+                got = QstsProbe.strip_timing(job["summary"])
+                want = QstsProbe.strip_timing(ref)
+                check.record(
+                    "qsts_probe_matches_reference", got == want,
+                    f"killed-and-resumed summary vs uninterrupted: "
+                    f"{'exact' if got == want else f'{got} != {want}'}",
+                )
 
         # Per-slice transport/solver counters, scraped from each live
         # slice's metrics endpoint before teardown — the SOAK trajectory's
@@ -742,11 +908,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="run without the VVC module (debug)")
     ap.add_argument("--no-serve-load", action="store_true",
                     help="skip the background what-if query load")
+    ap.add_argument("--no-qsts-probe", action="store_true",
+                    help="skip the QSTS kill/resume determinism probe")
     args = ap.parse_args(argv)
     artifact = run_soak(
         n_slices=args.slices, duration_s=args.duration, loss_pct=args.loss,
         workdir=args.workdir, out=args.out, vvc=not args.no_vvc,
         serve_load=not args.no_serve_load,
+        qsts_probe=not args.no_qsts_probe,
     )
     return 0 if artifact["pass"] else 1
 

@@ -352,6 +352,96 @@ def test_http_roundtrip_and_typed_errors(svc):
         srv.stop()
 
 
+def _read_http_response(sock) -> bytes:
+    """One full HTTP response (headers + Content-Length body) off a
+    persistent connection, leaving any pipelined follow-up unread."""
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        assert chunk, f"connection closed early; got {buf!r}"
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    length = 0
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":")[1])
+    while len(rest) < length:
+        chunk = sock.recv(65536)
+        assert chunk, "connection closed mid-body"
+        rest += chunk
+    return head + b"\r\n\r\n" + rest[:length]
+
+
+def test_http_keepalive_survives_rejected_first_request(svc):
+    """Regression (ISSUE 4 satellite): a POST rejected BEFORE its body
+    was consumed used to leave the body bytes on the persistent
+    connection, so the next pipelined request parsed garbage.  Two
+    requests on one socket: the first rejected (404 route, with a
+    body), the second a valid pf query — both must answer cleanly."""
+    import socket
+
+    srv = ServeServer(svc, port=0).start()
+    try:
+        def raw(path, payload):
+            body = json.dumps(payload).encode()
+            return (
+                f"POST {path} HTTP/1.1\r\nHost: t\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+            ).encode() + body
+
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=120) as s:
+            # Pipelined: both requests hit the socket before the first
+            # response — the drained body is what keeps request #2
+            # parseable.
+            s.sendall(raw("/v1/zap", {"case": "case14"}))
+            s.sendall(raw("/v1/pf", {"case": "case14"}))
+            first = _read_http_response(s)
+            second = _read_http_response(s)
+        assert first.startswith(b"HTTP/1.1 400")
+        assert b"invalid_request" in first
+        assert second.startswith(b"HTTP/1.1 200")
+        assert b'"converged": true' in second
+
+        # A body the server refuses to read cannot be drained: the
+        # response must close the connection instead.
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=30) as s:
+            s.sendall(b"POST /v1/pf HTTP/1.1\r\nHost: t\r\n"
+                      b"Content-Length: 99999999\r\n\r\n")
+            resp = _read_http_response(s)
+        assert resp.startswith(b"HTTP/1.1 400")
+        assert b"Connection: close" in resp
+    finally:
+        srv.stop()
+
+
+def test_pf_warm_start_fields_cut_iterations(svc):
+    """ISSUE 4 satellite: v0/theta0 on PowerFlowRequest, validated like
+    the other [n] vectors, warm-start the Newton solve — a repeat
+    client's second query converges in fewer iterations."""
+    cold = svc.request("pf", {"case": "case14", "scale": 1.0,
+                              "return_state": True})
+    assert cold.converged and cold.iterations >= 1
+    warm = svc.request("pf", {"case": "case14", "scale": 1.0,
+                              "v0": cold.v, "theta0": cold.theta})
+    assert warm.converged and warm.residual_pu < 1e-6
+    assert warm.iterations < cold.iterations
+    before = M.REGISTRY.get("serve_warm_start_total").value
+    svc.request("pf", {"case": "case14", "v0": cold.v})
+    assert M.REGISTRY.get("serve_warm_start_total").value == before + 1
+    # Validation mirrors p_inj/q_inj: wrong length, non-finite, and
+    # out-of-range magnitudes are typed 400s.
+    with pytest.raises(InvalidRequest):
+        svc.request("pf", {"case": "case14", "v0": [1.0, 1.0]})
+    with pytest.raises(InvalidRequest):
+        svc.request("pf", {"case": "case14", "v0": [0.0] * 14})
+    with pytest.raises(InvalidRequest):
+        svc.request("pf", {"case": "case14",
+                           "theta0": [float("nan")] * 14})
+
+
 def test_http_overload_sheds_with_429():
     # A service whose batcher never runs: the queue fills and stays full,
     # so admission control is exercised deterministically.
