@@ -679,22 +679,35 @@ def bench_serve(duration_s: float = 1.5) -> dict:
     return out
 
 
+def bench_quick() -> dict:
+    """The cheap subset the CI perf gate runs twice per build
+    (``tools/perf_gate.py``): small cases, short compiles, enough reps
+    for the rolling-median baseline to be meaningful on a loaded
+    2-vCPU runner."""
+    return {
+        "n1_case30_real_smw_ms": round(bench_n1_case30_smw(), 2),
+        "n1_118way_smw_screen_ms": round(bench_n1_118_smw(), 2),
+        "lb_256node_rounds_per_sec": round(bench_lb_256(), 1),
+    }
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="freedm_tpu headline benchmarks")
     ap.add_argument(
         "--sections", default="solvers,serve,qsts",
-        help="comma list of sections to run: solvers, serve, qsts "
-             "(default all)",
+        help="comma list of sections to run: solvers, serve, qsts, quick "
+             "(default solvers,serve,qsts; quick is the CI perf-gate "
+             "subset)",
     )
     ap.add_argument("--serve-duration", type=float, default=1.5, metavar="S",
                     help="seconds per serving measurement window")
     args = ap.parse_args(argv)
     sections = {s.strip() for s in args.sections.split(",") if s.strip()}
-    unknown = sections - {"solvers", "serve", "qsts"}
+    unknown = sections - {"solvers", "serve", "qsts", "quick"}
     if unknown or not sections:
         raise SystemExit(
-            f"--sections needs a non-empty subset of solvers,serve,qsts; "
-            f"got {args.sections!r}"
+            f"--sections needs a non-empty subset of solvers,serve,qsts,"
+            f"quick; got {args.sections!r}"
         )
 
     obj: dict = {}
@@ -702,6 +715,16 @@ def main(argv=None) -> None:
         obj["serve"] = bench_serve(duration_s=args.serve_duration)
     if "qsts" in sections:
         obj["qsts"] = bench_qsts()
+    # quick is a strict subset of the solvers section's extra metrics:
+    # when solvers also runs, its full-measurement rows supersede quick
+    # (same keys, longer reps), so quick only runs standalone.
+    if "quick" in sections and "solvers" not in sections:
+        quick = bench_quick()
+        obj["extra"] = quick
+        obj["metric"] = "n1_case30_real_smw_ms"
+        obj["value"] = quick["n1_case30_real_smw_ms"]
+        obj["unit"] = "ms"
+        obj["vs_baseline"] = None
     if "solvers" in sections:
         _solver_sections(obj)
     if "metric" not in obj and "serve" in obj:

@@ -67,6 +67,7 @@ class Runtime:
     serve_service: Optional[object] = None  # serve.Service (--serve-port)
     serve_server: Optional[object] = None  # serve.ServeServer (--serve-port)
     qsts_jobs: Optional[object] = None  # scenarios.JobManager (--serve-port)
+    slo_monitor: Optional[object] = None  # slo.SloMonitor (--slo-enabled)
 
     def start(self) -> "Runtime":
         if self.endpoint is not None:
@@ -78,6 +79,15 @@ class Runtime:
     def stop(self) -> None:
         for f in self.factories.values():
             f.stop()
+        if self.slo_monitor is not None:
+            self.slo_monitor.stop()
+            from freedm_tpu.core import slo as slo_mod
+
+            # Un-publish so a later runtime (or a bare metrics server)
+            # doesn't serve this stopped monitor's frozen verdicts at
+            # /slo.
+            if slo_mod.MONITOR is self.slo_monitor:
+                slo_mod.install(None)
         if self.endpoint is not None:
             self.endpoint.stop()
         if self.serve_server is not None:
@@ -131,6 +141,35 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--trace-log", default=None, metavar="PATH",
                     help="enable causal tracing and append finished spans "
                          "to PATH (JSONL; also served at /trace)")
+    ap.add_argument("--profile-metrics", action="store_true", default=None,
+                    help="enable the profiling registry: per-(workload, "
+                         "shape-bucket) jit compile accounting, device-"
+                         "memory peaks, host hot-path timers (profile_* "
+                         "metrics + the /profile route)")
+    ap.add_argument("--slo-enabled", action="store_true", default=None,
+                    help="enable the in-process SLO monitor (burn-rate "
+                         "windows over the metrics registry; breaches "
+                         "journaled as slo.breach/slo.recovered; /slo route)")
+    ap.add_argument("--slo-fast-window-s", type=float, default=None,
+                    metavar="S", help="fast burn window (default 30)")
+    ap.add_argument("--slo-slow-window-s", type=float, default=None,
+                    metavar="S", help="slow burn window (default 300)")
+    ap.add_argument("--slo-serve-availability", type=float, default=None,
+                    metavar="R", help="serving availability objective "
+                                      "(default 0.99)")
+    ap.add_argument("--slo-serve-p99-ms", type=float, default=None,
+                    metavar="MS", help="serving p99 latency objective "
+                                       "(default 250)")
+    ap.add_argument("--slo-overrun-rate", type=float, default=None,
+                    metavar="R", help="broker phase overruns per round "
+                                      "objective (default 0.05)")
+    ap.add_argument("--slo-qsts-floor", type=float, default=None,
+                    metavar="RATE", help="QSTS scenario-steps/s floor while "
+                                         "a job runs (0 = disabled)")
+    ap.add_argument("--slo-watchdog-s", type=float, default=None,
+                    metavar="S", help="stall watchdog: busy with no progress "
+                                      "for S seconds journals watchdog.stall "
+                                      "(default 20)")
     ap.add_argument("--serve-port", type=int, default=None, metavar="PORT",
                     help="serve the JSON what-if query API (pf/N-1/VVC) on "
                          "PORT (0 = ephemeral; unset = disabled)")
@@ -187,7 +226,15 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("checkpoint", "checkpoint"), ("checkpoint_every", "checkpoint_every"),
         ("resume", "resume"),
         ("metrics_port", "metrics_port"), ("events_log", "events_log"),
-        ("trace_log", "trace_log"),
+        ("trace_log", "trace_log"), ("profile_metrics", "profile_metrics"),
+        ("slo_enabled", "slo_enabled"),
+        ("slo_fast_window_s", "slo_fast_window_s"),
+        ("slo_slow_window_s", "slo_slow_window_s"),
+        ("slo_serve_availability", "slo_serve_availability"),
+        ("slo_serve_p99_ms", "slo_serve_p99_ms"),
+        ("slo_overrun_rate", "slo_overrun_rate"),
+        ("slo_qsts_floor", "slo_qsts_floor"),
+        ("slo_watchdog_s", "slo_watchdog_s"),
         ("serve_port", "serve_port"), ("serve_max_batch", "serve_max_batch"),
         ("serve_max_wait_ms", "serve_max_wait_ms"),
         ("serve_queue_depth", "serve_queue_depth"),
@@ -236,6 +283,13 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         # Node identity even while disabled: a later programmatic enable
         # (tests, embedders) stamps spans with the right node.
         tracing.TRACER.configure(node=cfg.uuid)
+
+    if cfg.profile_metrics:
+        # Like tracing: on before any solver exists, so the first-round
+        # compile hits land on the compile account.
+        from freedm_tpu.core import profiling
+
+        profiling.PROFILER.configure(enabled=True)
 
     # Config sanity BEFORE any resource is bound: --mesh-devices and
     # --federate are different deployment shapes, and rejecting them
@@ -469,10 +523,36 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             f"serve: http://127.0.0.1:{serve_server.port}/v1/pf "
             f"(n1: /v1/n1, vvc: /v1/vvc, qsts: /v1/qsts, health: /healthz)"
         )
+    slo_monitor = None
+    if cfg.slo_enabled:
+        # The judgment layer over the registry: objectives evaluated on
+        # fast+slow burn windows, breaches journaled, /slo on the
+        # metrics server, and a stall watchdog over the serve dispatch
+        # thread and the QSTS workers.
+        from freedm_tpu.core import slo as slo_mod
+
+        slo_monitor = slo_mod.SloMonitor(slo_mod.SloConfig(
+            fast_window_s=cfg.slo_fast_window_s,
+            slow_window_s=cfg.slo_slow_window_s,
+            serve_availability=cfg.slo_serve_availability,
+            serve_p99_ms=cfg.slo_serve_p99_ms,
+            broker_overrun_rate=cfg.slo_overrun_rate,
+            qsts_floor_steps_per_sec=cfg.slo_qsts_floor,
+            watchdog_s=cfg.slo_watchdog_s,
+        ))
+        if serve_service is not None:
+            b = serve_service.batcher
+            slo_monitor.watch("serve.batcher", b.busy, b.progress_age)
+        if qsts_jobs is not None:
+            slo_monitor.watch(
+                "qsts.worker", qsts_jobs.busy, qsts_jobs.progress_age
+            )
+        slo_mod.install(slo_monitor)
+        slo_monitor.start()
     return Runtime(
         cfg, timings, broker, fleet, factories, vvc, endpoint, federation,
         telemetry, mesh_mod, metrics_server, serve_service, serve_server,
-        qsts_jobs,
+        qsts_jobs, slo_monitor,
     )
 
 

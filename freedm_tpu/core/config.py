@@ -272,6 +272,26 @@ class GlobalConfig:
     qsts_max_jobs: int = 16
     qsts_chunk_steps: int = 24
     qsts_checkpoint_dir: Optional[str] = None
+    # Profiling registry (freedm_tpu.core.profiling): per-(workload,
+    # shape-bucket) jit compile accounting, device-memory peaks, and
+    # host hot-path timers, exported as profile_* metrics and the
+    # metrics server's /profile route.  Disabled by default at
+    # one-attribute-check cost, like tracing.
+    profile_metrics: bool = False
+    # SLO monitor (freedm_tpu.core.slo): rolling-window objectives over
+    # the metrics registry (serve availability + p99, broker
+    # phase-overrun rate, QSTS chunk-throughput floor) with fast+slow
+    # burn windows, slo.breach/slo.recovered journal events, an /slo
+    # route on the metrics server, and a stall watchdog over the serve
+    # dispatcher and QSTS workers.
+    slo_enabled: bool = False
+    slo_fast_window_s: float = 30.0
+    slo_slow_window_s: float = 300.0
+    slo_serve_availability: float = 0.99
+    slo_serve_p99_ms: float = 250.0
+    slo_overrun_rate: float = 0.05
+    slo_qsts_floor: float = 0.0
+    slo_watchdog_s: float = 20.0
 
     @property
     def uuid(self) -> str:

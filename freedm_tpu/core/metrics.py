@@ -72,6 +72,15 @@ def estimate_quantiles(bounds, counts, qs: Sequence[float] = (0.5, 0.95, 0.99)):
     Returns a list of floats (one per ``q``), or ``None`` for an empty
     histogram.
 
+    Interpolation is anchored at the bucket's sample ranks: the k
+    observations of a bucket ``(lo, hi]`` sit at
+    ``lo + (hi - lo) * j/k`` for ranks ``j = 1..k``, so an estimate can
+    never fall below the bucket's first-rank position.  In particular a
+    single-sample bucket reports its upper bound exactly — an
+    observation sitting ON a bucket edge (iteration counts, one compile
+    hit) used to smear to the bucket midpoint, which made integer-count
+    histograms report impossible values like "p99 = 1.5 iterations".
+
     This is what lets ``snapshot()`` and ``tools/trace_report.py``
     report ack-RTT / phase-duration p50/p95/p99 without external
     tooling.
@@ -93,7 +102,12 @@ def estimate_quantiles(bounds, counts, qs: Sequence[float] = (0.5, 0.95, 0.99)):
         hi = float(bounds[idx])
         prev = 0.0 if idx == 0 else float(cum[idx - 1])
         in_bucket = float(cum[idx]) - prev
-        frac = (target - prev) / in_bucket if in_bucket > 0 else 1.0
+        if in_bucket > 0:
+            # Rank-anchored: clamp the fractional in-bucket rank to the
+            # first sample's position (j >= 1).
+            frac = min(max(target - prev, 1.0), in_bucket) / in_bucket
+        else:
+            frac = 1.0
         out.append(lo + (hi - lo) * frac)
     return out
 
@@ -114,6 +128,10 @@ class _CounterChild(_Child):
         super().__init__(lock)
         self._value = 0.0
 
+    def _zero(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
     def inc(self, amount: float = 1.0) -> None:
         if amount < 0:
             raise ValueError("counters only go up")
@@ -132,6 +150,10 @@ class _GaugeChild(_Child):
     def __init__(self, lock):
         super().__init__(lock)
         self._value = 0.0
+
+    def _zero(self) -> None:
+        with self._lock:
+            self._value = 0.0
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -159,6 +181,11 @@ class _HistogramChild(_Child):
         # One slot per finite bucket + the +Inf overflow slot.
         self._counts = np.zeros(len(bounds) + 1, np.int64)
         self._sum = 0.0
+
+    def _zero(self) -> None:
+        with self._lock:
+            self._counts[:] = 0
+            self._sum = 0.0
 
     def observe(self, value) -> None:
         """Record one value or an array of values (no device syncs: the
@@ -343,6 +370,18 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def reset_for_tests(self) -> None:
+        """Zero every metric's recorded values WITHOUT dropping
+        registrations or labelled series (module constants keep their
+        bound children) — the process-wide registry is shared state,
+        and tests that assert on absolute counter values need a clean
+        slate without re-importing the catalogue."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            for _, child in m.children():
+                child._zero()  # type: ignore[attr-defined]
+
     def _items(self) -> List[_Metric]:
         with self._lock:
             return [self._metrics[k] for k in sorted(self._metrics)]
@@ -448,6 +487,11 @@ class JsonlEventJournal:
                 self._written += len(line) + 1
         return rec
 
+    def clear(self) -> None:
+        """Drop the in-memory ring (tests); an attached file is kept."""
+        with self._lock:
+            self._ring.clear()
+
     def tail(self, n: int = 100) -> List[dict]:
         if int(n) <= 0:
             return []
@@ -495,6 +539,12 @@ class MetricsServer(BackgroundHttpServer):
     ``GET /trace?n=K[&trace_id=T]`` — the tracing flight recorder's
     newest K records as JSONL (``freedm_tpu.core.tracing``; empty until
     tracing is enabled);
+    ``GET /profile`` — the profiling registry's compile/memory/host
+    accounts as JSON (``freedm_tpu.core.profiling``; empty until
+    profiling is enabled);
+    ``GET /slo`` — the installed SLO monitor's objective verdicts as
+    JSON (``freedm_tpu.core.slo``; ``{"enabled": false}`` until one is
+    installed);
     anything else — a one-line index.  Runs ``http.server`` on a daemon
     thread; ``port=0`` binds an ephemeral port (read it back from
     ``.port``).
@@ -546,10 +596,31 @@ class MetricsServer(BackgroundHttpServer):
                     )
                     self._reply(200, body + ("\n" if body else ""),
                                 "application/x-ndjson")
+                elif url.path == "/profile":
+                    from freedm_tpu.core import profiling as _profiling
+
+                    self._reply(
+                        200,
+                        json.dumps(_profiling.PROFILER.snapshot(),
+                                   default=str) + "\n",
+                        "application/json",
+                    )
+                elif url.path == "/slo":
+                    from freedm_tpu.core import slo as _slo
+
+                    mon = _slo.MONITOR
+                    body = json.dumps(
+                        mon.status() if mon is not None
+                        else {"enabled": False},
+                        default=str,
+                    )
+                    self._reply(200, body + "\n", "application/json")
                 elif url.path == "/":
-                    self._reply(200,
-                                "freedm_tpu metrics: /metrics /events /trace\n",
-                                "text/plain; charset=utf-8")
+                    self._reply(
+                        200,
+                        "freedm_tpu metrics: /metrics /events /trace "
+                        "/profile /slo\n",
+                        "text/plain; charset=utf-8")
                 else:
                     self._reply(404, "not found\n", "text/plain; charset=utf-8")
 
@@ -672,6 +743,11 @@ SERVE_SOLVE_LATENCY = REGISTRY.histogram(
 SERVE_WARM_START = REGISTRY.counter(
     "serve_warm_start_total",
     "pf requests that supplied a v0/theta0 warm start")
+SERVE_REQUEST_LATENCY = REGISTRY.histogram(
+    "serve_request_seconds",
+    "Admission to completion per settled request (ok or failed) — the "
+    "user-perceived latency the serve_p99 SLO is judged on",
+    buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0, 60.0))
 
 # -- QSTS scenario engine (freedm_tpu.scenarios) ----------------------------
 QSTS_SUBMITTED = REGISTRY.counter(
@@ -708,3 +784,11 @@ def observe_pf_result(solver: str, result) -> None:
     its = np.ravel(np.asarray(result.iterations))
     PF_ITERATIONS.labels(solver).observe(its)
     PF_RESIDUAL.labels(solver).set(float(np.max(np.asarray(result.mismatch))))
+
+
+def reset_for_tests() -> None:
+    """Zero the process-wide registry and drop the journal ring — the
+    one-call clean slate for tests that assert absolute values against
+    the shared module-level instances."""
+    REGISTRY.reset_for_tests()
+    EVENTS.clear()

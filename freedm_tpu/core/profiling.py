@@ -1,0 +1,220 @@
+"""Compile/memory/host-path profiling registry.
+
+PR 1's metrics say *how much*, PR 2's traces say *why* for a single
+round or request — this module answers the fleet-operator question in
+between: **where does the machine time actually go**, per workload and
+per compiled shape.  Podracer-style TPU architectures (PAPERS:
+arxiv 2104.06272) close their performance loop with exactly this kind
+of continuous profiling: recompile storms, device-memory growth, and
+host-side gaps between device launches are the three silent ways a
+jax_graft system loses its hardware, and none of them shows up in a
+per-request latency histogram until it is already a p99 incident.
+
+Three accounts, all keyed so a scrape can attribute blame:
+
+- **Compile account** — per ``(workload, shape bucket)`` jit compile
+  count and wall time, fed by the same first-dispatch sites that tag
+  ``jit_compile`` spans (``pf/newton.py``/``fdlf``/``krylov``/``ladder``
+  via :func:`~freedm_tpu.core.tracing.traced_solver`,
+  ``serve/batcher.py`` per shape bucket, ``scenarios/engine.py`` per
+  chunk shape).  A recompile storm is attributable to the tenant and
+  shape that caused it without reading traces.
+- **Device-memory account** — live buffer bytes sampled per workload
+  (``jax.live_arrays()``; works on every backend) with the peak
+  tracked, so an engine-cache or scenario-batch memory leak is visible
+  while it grows.
+- **Host-path account** — wall-time histograms for the host-side hot
+  paths that sit *between* device launches: the serve dispatcher's
+  per-batch host overhead and the QSTS host gap between device chunks.
+
+Everything is exported twice: as ``profile_*`` metrics on the process
+registry (:mod:`freedm_tpu.core.metrics`, scrapeable at ``/metrics``)
+and as a structured JSON snapshot served at the metrics server's
+``/profile`` route.
+
+**Disabled by default** at one-attribute-check cost, exactly like the
+tracer: every instrumented site guards on ``PROFILER.enabled`` before
+doing any work, so the steady-state hot paths pay nothing until
+``--profile-metrics`` (or a programmatic ``configure``) turns the
+registry on.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from freedm_tpu.core import metrics as obs
+
+# -- profile_* metric catalogue (zero-valued until something happens) -------
+PROFILE_COMPILES = obs.REGISTRY.counter(
+    "profile_jit_compiles_total",
+    "jit program compiles by (workload, shape bucket) — profiling "
+    "registry account of every jit_compile span-tag site",
+    labels=("workload", "bucket"))
+PROFILE_COMPILE_SECONDS = obs.REGISTRY.counter(
+    "profile_jit_compile_seconds_total",
+    "Wall seconds spent in synchronous jit trace+compile, by "
+    "(workload, shape bucket)",
+    labels=("workload", "bucket"))
+PROFILE_DEVICE_LIVE = obs.REGISTRY.gauge(
+    "profile_device_live_bytes",
+    "Live device buffer bytes at the workload's last sample point",
+    labels=("workload",))
+PROFILE_DEVICE_PEAK = obs.REGISTRY.gauge(
+    "profile_device_peak_bytes",
+    "Peak of profile_device_live_bytes since enable, per workload",
+    labels=("workload",))
+PROFILE_HOST_SECONDS = obs.REGISTRY.histogram(
+    "profile_host_seconds",
+    "Host-side hot-path wall time between device work (serve.dispatch "
+    "overhead per batch, qsts.chunk_gap between device chunks)",
+    buckets=(0.0001, 0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 30.0),
+    labels=("path",))
+
+
+def _live_device_bytes() -> Optional[int]:
+    """Sum of live jax array buffer bytes, or None when jax (or the
+    introspection API) is unavailable — profiling must never be the
+    thing that makes a transport-only process import jax."""
+    try:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:  # never force the import
+            return None
+        return sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
+    except Exception:
+        return None
+
+
+class ProfilingRegistry:
+    """Process-wide profiling account (:data:`PROFILER`).
+
+    Thread-safe; ``enabled`` is the single hot-path guard (instrumented
+    sites check it before calling in, and every record method re-checks
+    defensively).  ``configure``/``reset`` mirror the tracer's API.
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.RLock()
+        # (workload, bucket) -> [count, total_s, max_s, last_s]
+        self._compiles: Dict[tuple, list] = {}
+        # workload -> [live_bytes, peak_bytes, samples]
+        self._memory: Dict[str, list] = {}
+        # path -> [count, total_s, max_s]
+        self._host: Dict[str, list] = {}
+
+    # -- configuration -------------------------------------------------------
+    def configure(self, enabled: Optional[bool] = None) -> "ProfilingRegistry":
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+        return self
+
+    def reset(self) -> None:
+        """Back to the disabled boot state (tests); the exported
+        ``profile_*`` metric series keep their registrations but are
+        zeroed via the registry's own reset in test setups."""
+        with self._lock:
+            self.enabled = False
+            self._compiles.clear()
+            self._memory.clear()
+            self._host.clear()
+
+    # -- compile account -----------------------------------------------------
+    def record_compile(self, workload: str, bucket, seconds: float) -> None:
+        """One synchronous jit trace+compile of ``workload`` at shape
+        ``bucket`` took ``seconds`` of wall time.  Repeated calls with
+        the same key accumulate onto ONE entry — the per-shape compile
+        count is the recompile-storm signal."""
+        if not self.enabled:
+            return
+        key = (str(workload), str(bucket))
+        s = float(seconds)
+        with self._lock:
+            ent = self._compiles.get(key)
+            if ent is None:
+                ent = self._compiles[key] = [0, 0.0, 0.0, 0.0]
+            ent[0] += 1
+            ent[1] += s
+            ent[2] = max(ent[2], s)
+            ent[3] = s
+        PROFILE_COMPILES.labels(*key).inc()
+        PROFILE_COMPILE_SECONDS.labels(*key).inc(s)
+
+    # -- device-memory account -----------------------------------------------
+    def sample_memory(self, workload: str) -> Optional[int]:
+        """Sample live device buffer bytes on behalf of ``workload``;
+        tracks the peak.  Returns the sampled bytes (None when disabled
+        or jax is not loaded)."""
+        if not self.enabled:
+            return None
+        live = _live_device_bytes()
+        if live is None:
+            return None
+        w = str(workload)
+        with self._lock:
+            ent = self._memory.get(w)
+            if ent is None:
+                ent = self._memory[w] = [0, 0, 0]
+            ent[0] = live
+            ent[1] = max(ent[1], live)
+            ent[2] += 1
+            peak = ent[1]
+        PROFILE_DEVICE_LIVE.labels(w).set(live)
+        PROFILE_DEVICE_PEAK.labels(w).set(peak)
+        return live
+
+    # -- host-path account ---------------------------------------------------
+    def record_host(self, path: str, seconds: float) -> None:
+        """Wall time of one pass through a host-side hot path (the
+        serve dispatcher's non-solve overhead, the QSTS inter-chunk
+        host gap, ...)."""
+        if not self.enabled:
+            return
+        p = str(path)
+        s = max(float(seconds), 0.0)
+        with self._lock:
+            ent = self._host.get(p)
+            if ent is None:
+                ent = self._host[p] = [0, 0.0, 0.0]
+            ent[0] += 1
+            ent[1] += s
+            ent[2] = max(ent[2], s)
+        PROFILE_HOST_SECONDS.labels(p).observe(s)
+
+    # -- exposition (the /profile route, tests) ------------------------------
+    def snapshot(self) -> dict:
+        """JSON-shaped dump: the ``/profile`` payload."""
+        with self._lock:
+            compiles: Dict[str, dict] = {}
+            for (w, b), (n, tot, mx, last) in sorted(self._compiles.items()):
+                compiles.setdefault(w, {})[b] = {
+                    "count": n,
+                    "total_s": round(tot, 6),
+                    "max_s": round(mx, 6),
+                    "last_s": round(last, 6),
+                }
+            memory = {
+                w: {"live_bytes": ent[0], "peak_bytes": ent[1],
+                    "samples": ent[2]}
+                for w, ent in sorted(self._memory.items())
+            }
+            host = {
+                p: {"count": ent[0], "total_s": round(ent[1], 6),
+                    "max_s": round(ent[2], 6),
+                    "mean_s": round(ent[1] / ent[0], 6) if ent[0] else 0.0}
+                for p, ent in sorted(self._host.items())
+            }
+            return {
+                "enabled": self.enabled,
+                "compiles": compiles,
+                "memory": memory,
+                "host": host,
+            }
+
+
+#: The process-wide profiling registry every layer instruments against.
+PROFILER = ProfilingRegistry()

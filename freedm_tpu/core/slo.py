@@ -1,0 +1,481 @@
+"""Rolling-window SLO evaluation + stall watchdog over the metrics registry.
+
+The registry (PR 1) and the flight recorder (PR 2) are raw telemetry:
+nothing in the process says "this slice is out of objective" until a
+human reads a dashboard.  This module is the judgment layer — the
+burn-rate alerting discipline of the SRE workbook, evaluated in-process
+against :data:`~freedm_tpu.core.metrics.REGISTRY`:
+
+- **Objectives** (all configurable via ``--slo-*``):
+
+  =====================  =====================================================
+  ``serve_availability``  fraction of settled serving requests that were
+                          ``ok`` vs server-fault outcomes (``deadline``,
+                          ``error``, ``shutdown``).  Client faults
+                          (``invalid``) and deliberate shed (``overloaded``)
+                          do not burn budget.
+  ``serve_p99``           p99 of ``serve_request_seconds`` (admission →
+                          completion, per request) against a millisecond
+                          target.
+  ``broker_overruns``     phase overruns per completed round against a rate
+                          target.
+  ``qsts_throughput``     ``qsts_scenario_steps_per_sec`` floor, evaluated
+                          only while a job is running (0 disables).
+  =====================  =====================================================
+
+- **Fast+slow burn windows** — each ratio objective is evaluated over a
+  fast window (default 30 s; catches) and a slow window (default 300 s;
+  confirms).  A breach requires the fast-window burn rate to cross the
+  trip multiplier AND the slow window to be burning at >= 1x budget —
+  a single bad scrape interval cannot page.  Recovery requires only a
+  clean fast window, so a resolved incident closes promptly.  Breaches
+  and recoveries are journaled as ``slo.breach`` / ``slo.recovered``
+  events and counted on ``slo_breaches_total{slo=...}``.
+
+- **Watchdog** — registered progress sources (the ``MicroBatcher``
+  dispatch thread, ``JobManager`` workers) are checked for liveness:
+  busy with no progress beat for longer than ``--slo-watchdog-s``
+  journals ``watchdog.stall`` (once per episode) and counts
+  ``watchdog_stalls_total{target=...}``; progress resuming journals
+  ``watchdog.recovered``.
+
+The current verdict is served as JSON at the metrics server's ``/slo``
+route.  ``tools/soak.py`` asserts breach/recover pairs from the event
+journal under its fault schedule — the compile storm of a restarted
+slice reliably trips ``broker_overruns`` and then recovers once the
+kernels are warm.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from freedm_tpu.core import metrics as obs
+
+# -- slo_* metric catalogue --------------------------------------------------
+SLO_STATUS = obs.REGISTRY.gauge(
+    "slo_status", "1 while the objective is breached, else 0",
+    labels=("slo",))
+SLO_BREACHES = obs.REGISTRY.counter(
+    "slo_breaches_total", "Objective breach episodes since start",
+    labels=("slo",))
+SLO_BURN = obs.REGISTRY.gauge(
+    "slo_burn_rate",
+    "Error-budget burn multiple per objective and window "
+    "(1.0 = burning exactly the budget)",
+    labels=("slo", "window"))
+WATCHDOG_STALLS = obs.REGISTRY.counter(
+    "watchdog_stalls_total",
+    "Stall episodes detected on registered progress sources",
+    labels=("target",))
+
+#: Server-fault serving outcomes — the ones that burn availability
+#: budget.  The serve layer's outcome vocabulary is split between
+#: literal labels (``deadline``/``shutdown`` on the submit/expire
+#: paths) and ``ServeError.code`` strings (``internal``/
+#: ``deadline_exceeded``/``shutting_down`` on the completion path), so
+#: both spellings are counted.  ``invalid``/``invalid_request`` are
+#: the client's fault; ``overloaded`` is deliberate shed (the
+#: admission queue doing its job).
+_BAD_OUTCOMES = ("deadline", "deadline_exceeded", "error", "internal",
+                 "shutdown", "shutting_down")
+
+
+@dataclass(frozen=True)
+class SloConfig:
+    """Objective targets + window geometry (CLI: ``--slo-*``)."""
+
+    fast_window_s: float = 30.0
+    slow_window_s: float = 300.0
+    interval_s: float = 2.0
+    #: Fast-window burn multiple that trips a breach (the slow window
+    #: must simultaneously burn >= 1x budget).
+    burn_trip: float = 2.0
+    serve_availability: float = 0.99
+    serve_p99_ms: float = 250.0
+    broker_overrun_rate: float = 0.05
+    qsts_floor_steps_per_sec: float = 0.0
+    watchdog_s: float = 20.0
+
+
+def _counter_sum(name: str) -> float:
+    """Sum of all labelled children of a counter/gauge (0 if absent)."""
+    m = obs.REGISTRY.get(name)
+    if m is None:
+        return 0.0
+    return float(sum(child.value for _, child in m.children()))
+
+
+def _outcome_sum(outcomes) -> float:
+    m = obs.REGISTRY.get("serve_requests_total")
+    if m is None:
+        return 0.0
+    return float(sum(
+        child.value for key, child in m.children() if key[1] in outcomes
+    ))
+
+
+def _latency_counts() -> Tuple[tuple, np.ndarray]:
+    """(bounds, per-bucket counts incl. overflow) of the request-latency
+    histogram — the raw material for windowed p99 deltas."""
+    m = obs.REGISTRY.get("serve_request_seconds")
+    if m is None:
+        return (), np.zeros(1)
+    bounds = tuple(float(b) for b in m._bounds)
+    counts = np.zeros(len(bounds) + 1, np.float64)
+    for _, child in m.children():
+        cum = child.buckets()  # upper-bound -> cumulative count
+        vals = np.asarray(list(cum.values()), np.float64)
+        counts += np.diff(np.concatenate([[0.0], vals]))
+    return bounds, counts
+
+
+def _gauge(name: str) -> float:
+    m = obs.REGISTRY.get(name)
+    return float(m.value) if m is not None else 0.0
+
+
+class _Sample:
+    """One scrape of the raw cumulative values the objectives need."""
+
+    __slots__ = ("ts", "ok", "bad", "lat_counts", "overruns", "rounds",
+                 "qsts_rate", "qsts_running")
+
+    def __init__(self, ts: float):
+        self.ts = ts
+        self.ok = _outcome_sum(("ok",))
+        self.bad = _outcome_sum(_BAD_OUTCOMES)
+        _, self.lat_counts = _latency_counts()
+        self.overruns = _counter_sum("broker_phase_overruns_total")
+        self.rounds = _counter_sum("broker_rounds_total")
+        self.qsts_rate = _gauge("qsts_scenario_steps_per_sec")
+        self.qsts_running = _gauge("qsts_jobs_running")
+
+
+class SloMonitor:
+    """Periodic evaluator: sample the registry, judge each objective
+    over the fast/slow windows, journal transitions, feed ``/slo``.
+
+    ``tick()`` is the whole evaluation step and is public so tests can
+    drive it with a synthetic clock; :meth:`start` runs it on a daemon
+    thread every ``interval_s``.
+    """
+
+    def __init__(self, config: SloConfig = SloConfig(),
+                 journal: Optional[obs.JsonlEventJournal] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config
+        self.journal = journal if journal is not None else obs.EVENTS
+        self.clock = clock
+        self._lock = threading.RLock()
+        self._samples: deque = deque()
+        self._state: Dict[str, bool] = {}  # objective -> breached?
+        self._last: Dict[str, dict] = {}  # objective -> last verdict
+        self._watches: List[tuple] = []  # (name, busy_fn, age_fn)
+        self._stalled: Dict[str, bool] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "SloMonitor":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="slo-monitor", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the monitor must not die
+                pass
+
+    # -- watchdog registration ----------------------------------------------
+    def watch(self, name: str, busy_fn: Callable[[], bool],
+              age_fn: Callable[[], float]) -> None:
+        """Register a progress source: ``busy_fn`` says whether the
+        target has work it should be making progress on; ``age_fn``
+        returns seconds since its last progress beat."""
+        with self._lock:
+            self._watches.append((str(name), busy_fn, age_fn))
+            self._stalled.setdefault(str(name), False)
+
+    # -- evaluation ----------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """One evaluation step; returns the per-objective verdicts."""
+        t = self.clock() if now is None else float(now)
+        cfg = self.config
+        with self._lock:
+            self._samples.append(_Sample(t))
+            horizon = t - cfg.slow_window_s - 2 * cfg.interval_s
+            while len(self._samples) > 2 and self._samples[1].ts <= horizon:
+                self._samples.popleft()
+            samples = list(self._samples)
+        verdicts: Dict[str, dict] = {}
+        for name, judge in (
+            ("serve_availability", self._judge_availability),
+            ("serve_p99", self._judge_p99),
+            ("broker_overruns", self._judge_overruns),
+            ("qsts_throughput", self._judge_qsts),
+        ):
+            v = judge(samples, t)
+            if v is not None:
+                verdicts[name] = v
+                self._transition(name, v)
+        self._tick_watchdog(t)
+        with self._lock:
+            self._last = verdicts
+        return verdicts
+
+    def _window(self, samples: List[_Sample], now: float,
+                span_s: float) -> Optional[Tuple[_Sample, _Sample]]:
+        """(oldest sample >= span old, newest); None until the window
+        has real width."""
+        newest = samples[-1]
+        base = None
+        for s in samples:
+            if now - s.ts >= span_s:
+                base = s
+            else:
+                break
+        if base is None:
+            base = samples[0]
+        if newest.ts - base.ts <= 0:
+            return None
+        return base, newest
+
+    # Each judge returns {"value", "target", "burn_fast", "burn_slow"}
+    # (or None while the windows are empty of signal).
+
+    def _burn_verdict(self, name: str, value, target, burn_fast,
+                      burn_slow) -> dict:
+        cfg = self.config
+        breached = self._state.get(name, False)
+        if burn_fast is not None and burn_slow is not None and \
+                burn_fast >= cfg.burn_trip and burn_slow >= 1.0:
+            breached = True
+        elif burn_fast is not None and burn_fast < 1.0:
+            breached = False
+        if burn_fast is not None:
+            SLO_BURN.labels(name, "fast").set(burn_fast)
+        if burn_slow is not None:
+            SLO_BURN.labels(name, "slow").set(burn_slow)
+        return {
+            "value": value, "target": target, "breached": breached,
+            "burn_fast": burn_fast, "burn_slow": burn_slow,
+        }
+
+    def _judge_availability(self, samples, now) -> Optional[dict]:
+        cfg = self.config
+        budget = max(1.0 - cfg.serve_availability, 1e-9)
+
+        def burn(span):
+            win = self._window(samples, now, span)
+            if win is None:
+                return None, None
+            a, b = win
+            total = (b.ok - a.ok) + (b.bad - a.bad)
+            if total <= 0:
+                return None, None  # no traffic: no budget burned
+            bad_frac = (b.bad - a.bad) / total
+            return bad_frac / budget, 1.0 - bad_frac
+
+        burn_fast, avail = burn(cfg.fast_window_s)
+        burn_slow, _ = burn(cfg.slow_window_s)
+        if burn_fast is None and not self._state.get("serve_availability"):
+            return None
+        # No fast-window traffic while breached counts as recovered
+        # (nothing is failing because nothing is being refused).
+        if burn_fast is None:
+            burn_fast, avail = 0.0, 1.0
+        if burn_slow is None:
+            burn_slow = burn_fast
+        return self._burn_verdict(
+            "serve_availability", round(avail, 6), cfg.serve_availability,
+            round(burn_fast, 3), round(burn_slow, 3),
+        )
+
+    def _judge_p99(self, samples, now) -> Optional[dict]:
+        cfg = self.config
+        target_s = cfg.serve_p99_ms / 1e3
+        m = obs.REGISTRY.get("serve_request_seconds")
+        if m is None:
+            return None
+        bounds = tuple(float(b) for b in m._bounds)
+
+        def p99(span):
+            win = self._window(samples, now, span)
+            if win is None:
+                return None
+            a, b = win
+            delta = b.lat_counts - a.lat_counts
+            if delta.sum() <= 0:
+                return None
+            qs = obs.estimate_quantiles(bounds, delta, (0.99,))
+            return qs[0] if qs else None
+
+        fast = p99(cfg.fast_window_s)
+        slow = p99(cfg.slow_window_s)
+        if fast is None and not self._state.get("serve_p99"):
+            return None
+        burn_fast = None if fast is None else fast / target_s
+        burn_slow = None if slow is None else slow / target_s
+        if burn_fast is None:
+            burn_fast = 0.0
+        if burn_slow is None:
+            burn_slow = burn_fast
+        return self._burn_verdict(
+            "serve_p99",
+            None if fast is None else round(fast * 1e3, 3),
+            cfg.serve_p99_ms, round(burn_fast, 3), round(burn_slow, 3),
+        )
+
+    def _judge_overruns(self, samples, now) -> Optional[dict]:
+        cfg = self.config
+        target = max(cfg.broker_overrun_rate, 1e-9)
+
+        def rate(span):
+            win = self._window(samples, now, span)
+            if win is None:
+                return None
+            a, b = win
+            rounds = b.rounds - a.rounds
+            if rounds <= 0:
+                return None
+            return (b.overruns - a.overruns) / rounds
+
+        fast = rate(cfg.fast_window_s)
+        slow = rate(cfg.slow_window_s)
+        if fast is None and not self._state.get("broker_overruns"):
+            return None
+        burn_fast = 0.0 if fast is None else fast / target
+        burn_slow = burn_fast if slow is None else slow / target
+        return self._burn_verdict(
+            "broker_overruns",
+            None if fast is None else round(fast, 4),
+            cfg.broker_overrun_rate, round(burn_fast, 3),
+            round(burn_slow, 3),
+        )
+
+    def _judge_qsts(self, samples, now) -> Optional[dict]:
+        cfg = self.config
+        floor = cfg.qsts_floor_steps_per_sec
+        if floor <= 0:
+            return None
+
+        def worst(span):
+            """Slowest chunk rate observed while a job was running."""
+            win = self._window(samples, now, span)
+            if win is None:
+                return None
+            rates = [
+                s.qsts_rate for s in samples
+                if s.ts >= now - span and s.qsts_running > 0
+                and s.qsts_rate > 0
+            ]
+            return min(rates) if rates else None
+
+        fast = worst(cfg.fast_window_s)
+        slow = worst(cfg.slow_window_s)
+        if fast is None and not self._state.get("qsts_throughput"):
+            return None
+        # Burn = floor/rate: 1.0 at the floor, >1 below it.
+        burn_fast = 0.0 if fast is None else floor / max(fast, 1e-9)
+        burn_slow = burn_fast if slow is None else floor / max(slow, 1e-9)
+        return self._burn_verdict(
+            "qsts_throughput", fast, floor,
+            round(burn_fast, 3), round(burn_slow, 3),
+        )
+
+    # -- transitions ---------------------------------------------------------
+    def _transition(self, name: str, verdict: dict) -> None:
+        breached = bool(verdict["breached"])
+        was = self._state.get(name, False)
+        self._state[name] = breached
+        SLO_STATUS.labels(name).set(1.0 if breached else 0.0)
+        if breached and not was:
+            SLO_BREACHES.labels(name).inc()
+            self.journal.emit(
+                "slo.breach", slo=name, value=verdict["value"],
+                target=verdict["target"], burn_fast=verdict["burn_fast"],
+                burn_slow=verdict["burn_slow"],
+            )
+        elif was and not breached:
+            self.journal.emit(
+                "slo.recovered", slo=name, value=verdict["value"],
+                target=verdict["target"],
+            )
+
+    def _tick_watchdog(self, now: float) -> None:
+        cfg = self.config
+        with self._lock:
+            watches = list(self._watches)
+        for name, busy_fn, age_fn in watches:
+            try:
+                busy = bool(busy_fn())
+                age = float(age_fn())
+            except Exception:  # a stopped target must not kill the monitor
+                continue
+            stalled = busy and age > cfg.watchdog_s
+            was = self._stalled.get(name, False)
+            self._stalled[name] = stalled
+            if stalled and not was:
+                WATCHDOG_STALLS.labels(name).inc()
+                self.journal.emit(
+                    "watchdog.stall", target=name,
+                    age_s=round(age, 3), limit_s=cfg.watchdog_s,
+                )
+            elif was and not stalled:
+                self.journal.emit("watchdog.recovered", target=name)
+
+    # -- exposition (the /slo route) ----------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": True,
+                "config": {
+                    "fast_window_s": self.config.fast_window_s,
+                    "slow_window_s": self.config.slow_window_s,
+                    "burn_trip": self.config.burn_trip,
+                    "serve_availability": self.config.serve_availability,
+                    "serve_p99_ms": self.config.serve_p99_ms,
+                    "broker_overrun_rate": self.config.broker_overrun_rate,
+                    "qsts_floor_steps_per_sec":
+                        self.config.qsts_floor_steps_per_sec,
+                    "watchdog_s": self.config.watchdog_s,
+                },
+                "objectives": dict(self._last),
+                "breached": sorted(
+                    k for k, v in self._state.items() if v
+                ),
+                "watchdogs": {
+                    name: {"stalled": self._stalled.get(name, False)}
+                    for name, _, _ in self._watches
+                },
+            }
+
+
+#: The installed monitor (``--slo-enabled``), read by the metrics
+#: server's ``/slo`` route; None until :func:`install`.
+MONITOR: Optional[SloMonitor] = None
+
+
+def install(monitor: Optional[SloMonitor]) -> Optional[SloMonitor]:
+    """Publish ``monitor`` as the process-wide instance (None clears)."""
+    global MONITOR
+    MONITOR = monitor
+    return monitor

@@ -416,16 +416,25 @@ def _in_jax_trace() -> bool:
 def traced_solver(solver: str, fn):
     """Wrap a compiled power-flow solve so each call records a
     ``pf.solve`` span, tagging the first call ``jit_compile=True`` (the
-    synchronous trace+compile hit) vs steady-state ``False``.
+    synchronous trace+compile hit) vs steady-state ``False``, and —
+    when the profiling registry (``core.profiling``) is enabled — the
+    first call's wall time lands on the compile account keyed
+    ``(solver, "base")``.
 
     Steady-state spans measure the *dispatch* side of an async jax
     execution (no ``block_until_ready`` is inserted — tracing must not
     change the overlap the caller built); the first-call span is the
     honest compile wall time, because jax compiles synchronously.
     Calls made from inside a jax transformation (``vmap(solve)``)
-    record nothing.  Disabled tracing costs one attribute check.
+    record nothing.  Disabled tracing AND disabled profiling cost one
+    attribute check each.
     """
     import functools
+    import time as _time
+
+    # Late import keeps this module numpy-free for processes that never
+    # build a solver (profiling pulls in the metrics registry).
+    from freedm_tpu.core import profiling as _profiling
 
     seen = [False]
 
@@ -436,10 +445,26 @@ def traced_solver(solver: str, fn):
         # tracer enabled later must not mislabel a warm dispatch as it.
         first = not seen[0]
         seen[0] = True
-        if not TRACER.enabled or _in_jax_trace():
+        profiled = first and _profiling.PROFILER.enabled
+        if not TRACER.enabled:
+            if profiled and not _in_jax_trace():
+                t0 = _time.perf_counter()
+                out = fn(*a, **kw)
+                _profiling.PROFILER.record_compile(
+                    solver, "base", _time.perf_counter() - t0
+                )
+                return out
             return fn(*a, **kw)
+        if _in_jax_trace():
+            return fn(*a, **kw)
+        t0 = _time.perf_counter()
         with TRACER.start(f"pf.solve:{solver}", kind="solve",
                           tags={"solver": solver, "jit_compile": first}):
-            return fn(*a, **kw)
+            out = fn(*a, **kw)
+        if profiled:
+            _profiling.PROFILER.record_compile(
+                solver, "base", _time.perf_counter() - t0
+            )
+        return out
 
     return wrapper

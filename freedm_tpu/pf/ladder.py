@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from freedm_tpu.core import tracing
 from freedm_tpu.grid.feeder import Feeder
 from freedm_tpu.pf.sweeps import make_sweeps
 from freedm_tpu.utils import cplx
@@ -217,7 +218,15 @@ def make_ladder_solver(
     def solve_fixed(s_load_kva, v_source_pu=None) -> LadderResult:
         return _solve_fixed(cplx.as_c(s_load_kva, dtype=rdtype), v_source_pu)
 
-    return solve, solve_fixed
+    # Tracing/profiling (core.tracing, core.profiling): pf.solve spans
+    # with the first call tagged as the jit-compile hit, and the compile
+    # wall time on the profiling registry; both a no-op while disabled.
+    # Calls under vmap/jit (the serve VVC engine, QSTS feeder chunks)
+    # record nothing.
+    return (
+        tracing.traced_solver("ladder", solve),
+        tracing.traced_solver("ladder", solve_fixed),
+    )
 
 
 # ---------------------------------------------------------------------------

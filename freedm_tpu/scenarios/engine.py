@@ -49,6 +49,7 @@ from typing import Callable, Dict, NamedTuple, Optional
 
 import numpy as np
 
+from freedm_tpu.core import profiling
 from freedm_tpu.core import tracing
 from freedm_tpu.scenarios.profiles import PROFILE_KINDS, ProfileSet, ProfileSpec
 
@@ -175,6 +176,10 @@ class QstsEngine:
         self.kind, self._case = _resolve_case(spec.case)
         self.compiles = 0  # distinct chunk shapes compiled (bench bound)
         self._fns: Dict[int, Callable] = {}
+        # Host gap between device chunks (checkpoint write + profile
+        # materialize + numpy roundtrip) — the profiling registry's
+        # qsts.chunk_gap account.
+        self._last_chunk_end: Optional[float] = None
         if self.kind == "bus":
             self._init_bus()
         else:
@@ -395,6 +400,11 @@ class QstsEngine:
 
         tc = int(t1 - t0)
         spec = self.spec
+        profiled = profiling.PROFILER.enabled  # one attribute check when off
+        if profiled and self._last_chunk_end is not None:
+            profiling.PROFILER.record_host(
+                "qsts.chunk_gap", time.monotonic() - self._last_chunk_end
+            )
         with tracing.TRACER.start(
             "qsts.chunk", kind="qsts",
             tags={"t0": t0, "steps": tc, "scenarios": spec.scenarios},
@@ -411,6 +421,7 @@ class QstsEngine:
                     else self._build_feeder_chunk(tc)
                 )
                 self.compiles += 1
+            t_solve = time.monotonic()
             with tracing.TRACER.start(
                 f"pf.solve:{self.solver_name}", kind="solve",
                 tags={"solver": self.solver_name, "jit_compile": new_shape,
@@ -418,7 +429,19 @@ class QstsEngine:
             ):
                 out = self._fns[tc](state, *arrays)
                 out = jax.block_until_ready(out)
-        return type(state)(*(np.asarray(x) for x in out))
+        if profiled:
+            if new_shape:
+                # block_until_ready above makes this the honest
+                # trace+compile(+one chunk) wall time for the shape.
+                profiling.PROFILER.record_compile(
+                    f"qsts:{self.solver_name}",
+                    f"S{spec.scenarios}xT{tc}",
+                    time.monotonic() - t_solve,
+                )
+            profiling.PROFILER.sample_memory("qsts")
+        out = type(state)(*(np.asarray(x) for x in out))
+        self._last_chunk_end = time.monotonic()
+        return out
 
     # -- checkpoint serialization -------------------------------------------
     def state_to_jsonable(self, state) -> dict:

@@ -192,6 +192,12 @@ class JobManager:
         self._jobs: "OrderedDict[str, JobRecord]" = OrderedDict()
         self._closed = False
         self._threads: List[threading.Thread] = []
+        # Watchdog surface (core.slo): each executing worker keeps its
+        # own beat (keyed by thread ident, present only while it runs a
+        # job), refreshed at pickup and every chunk boundary.  Per-
+        # worker beats matter: with a shared timestamp, one healthy
+        # worker's progress would mask a wedged sibling forever.
+        self._worker_beats: Dict[int, float] = {}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "JobManager":
@@ -268,6 +274,22 @@ class JobManager:
                 obs.QSTS_JOBS.labels("cancelled").inc()
         return rec.to_dict()
 
+    # -- watchdog surface (core.slo) -----------------------------------------
+    def progress_age(self) -> float:
+        """Seconds since the STALEST currently-executing worker last
+        reported progress (0 while idle) — the watchdog must see the
+        wedged worker, not the healthiest one."""
+        with self._cond:
+            if not self._worker_beats:
+                return 0.0
+            oldest = min(self._worker_beats.values())
+        return time.monotonic() - oldest
+
+    def busy(self) -> bool:
+        """True while a study is executing on a worker."""
+        with self._cond:
+            return bool(self._worker_beats)
+
     def stats(self) -> dict:
         with self._cond:
             states: Dict[str, int] = {}
@@ -306,6 +328,9 @@ class JobManager:
     def _execute(self, rec: JobRecord) -> None:
         spec = rec.spec
         obs.QSTS_RUNNING.inc()
+        ident = threading.get_ident()
+        with self._cond:
+            self._worker_beats[ident] = time.monotonic()
         span = tracing.TRACER.start(
             "qsts.job", kind="qsts",
             tags={"job_id": rec.id, "case": spec.case,
@@ -315,6 +340,7 @@ class JobManager:
         def on_chunk(done, total, chunk_s, lane_steps):
             rec.chunks_done = done
             rec.chunks_total = total
+            self._worker_beats[ident] = time.monotonic()
             obs.QSTS_CHUNK_SECONDS.observe(chunk_s)
             if chunk_s > 0:
                 obs.QSTS_SCENARIO_RATE.set(lane_steps / chunk_s)
@@ -351,4 +377,6 @@ class JobManager:
         finally:
             rec.finished_ts = time.time()
             span.end()
+            with self._cond:
+                self._worker_beats.pop(ident, None)
             obs.QSTS_RUNNING.dec()

@@ -42,6 +42,7 @@ import time
 from typing import List, Optional
 
 from freedm_tpu.core import metrics as obs
+from freedm_tpu.core import profiling
 from freedm_tpu.core import tracing
 from freedm_tpu.serve.queue import ServeError, Ticket
 
@@ -58,6 +59,16 @@ class MicroBatcher:
         self.service = service
         self.config = config
         self.buckets = config.bucket_table()
+        # Per-shape compile attribution: "workload/case:bucket" -> first
+        # dispatches of that shape (each one synchronous XLA compile).
+        # /stats exposes this table so a recompile storm is attributable
+        # without reading traces.
+        self.recompiles_by_bucket: dict = {}
+        # Watchdog surface (core.slo): the loop beats this every
+        # iteration; a dispatch stuck in a compile/solve stops beating
+        # while `busy()` stays true.
+        self.last_beat = time.monotonic()
+        self._dispatching = False
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -83,11 +94,22 @@ class MicroBatcher:
                 return b
         return self.buckets[-1]
 
+    # -- watchdog surface (core.slo) -----------------------------------------
+    def progress_age(self) -> float:
+        """Seconds since the dispatch loop last completed an iteration."""
+        return time.monotonic() - self.last_beat
+
+    def busy(self) -> bool:
+        """True while the loop owes progress: a dispatch is executing,
+        or admitted lanes are waiting for one."""
+        return self._dispatching or self.service.queue.depth_lanes > 0
+
     # -- main loop -----------------------------------------------------------
     def _run(self) -> None:
         q = self.service.queue
         window_s = max(self.config.max_wait_ms, 0.0) / 1000.0
         while not self._stop.is_set():
+            self.last_beat = time.monotonic()
             head = q.pop(timeout=0.2)
             if head is None:
                 continue
@@ -109,6 +131,13 @@ class MicroBatcher:
 
     # -- dispatch ------------------------------------------------------------
     def _dispatch(self, group: List[Ticket], lanes: int) -> None:
+        self._dispatching = True
+        try:
+            self._dispatch_inner(group, lanes)
+        finally:
+            self._dispatching = False
+
+    def _dispatch_inner(self, group: List[Ticket], lanes: int) -> None:
         workload, case = group[0].key
         engine = self.service.engine(workload, case)
         bucket = self.bucket_for(lanes)
@@ -123,6 +152,10 @@ class MicroBatcher:
         new_shape = bucket not in engine.compiled_buckets
         if new_shape:
             obs.SERVE_RECOMPILES.labels(workload).inc()
+            key = f"{workload}/{case}:{bucket}"
+            self.recompiles_by_bucket[key] = (
+                self.recompiles_by_bucket.get(key, 0) + 1
+            )
 
         span = tracing.TRACER.start(
             "serve.batch", kind="serve",
@@ -155,6 +188,18 @@ class MicroBatcher:
                 engine.scatter(group, results, info)
             span.tag(solve_ms=round(solve_s * 1e3, 3))
             span.end()
+            if profiling.PROFILER.enabled:  # one attribute check when off
+                if new_shape:
+                    # First dispatch of this (engine, bucket): solve_s IS
+                    # the synchronous XLA compile (plus one warm solve).
+                    profiling.PROFILER.record_compile(
+                        workload, bucket, solve_s
+                    )
+                profiling.PROFILER.record_host(
+                    "serve.dispatch",
+                    max(time.monotonic() - now - solve_s, 0.0),
+                )
+                profiling.PROFILER.sample_memory("serve")
             for t in group:
                 self.service._complete_ok(t, info)
         except Exception as e:  # noqa: BLE001 — waiters must never hang

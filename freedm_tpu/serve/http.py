@@ -18,7 +18,11 @@ Routes:
 - ``GET /v1/jobs/<id>`` — poll a job (progress, then the summary);
   ``POST /v1/jobs/<id>/cancel`` — stop it at the next chunk boundary.
 - ``GET /healthz`` — liveness + the workload/case table.
-- ``GET /stats`` — queue depth, bucket table, serve metric snapshot.
+- ``GET /stats`` — queue depth, the batcher's shape-bucket table, the
+  per-shape recompile attribution (``recompiles_by_bucket``:
+  ``"workload/case:bucket" -> first dispatches``, so a recompile storm
+  names its tenant without reading traces), and the serve metric
+  snapshot.
 
 Errors are *typed*, never free-text-only: the body is always
 ``{"error": {"type": <ServeError.code>, "detail": ...}}`` with the

@@ -831,6 +831,9 @@ class Service:
     # -- completion accounting (called by the batcher / queue) ---------------
     def _expire(self, ticket: Ticket) -> None:
         obs.SERVE_REQUESTS.labels(ticket.key[0], "deadline").inc()
+        obs.SERVE_REQUEST_LATENCY.observe(
+            max(_time.monotonic() - ticket.enqueued_at, 0.0)
+        )
         ticket.span.tag(outcome="deadline")
         ticket.span.end()
         ticket.future.set_exception(
@@ -839,6 +842,9 @@ class Service:
 
     def _complete_ok(self, ticket: Ticket, info: BatchInfo) -> None:
         self._ok_counters[ticket.key[0]].inc()
+        obs.SERVE_REQUEST_LATENCY.observe(
+            max(_time.monotonic() - ticket.enqueued_at, 0.0)
+        )
         span = ticket.span
         if span is not tracing.NOOP:
             span.tag(outcome="ok", bucket=info.bucket,
@@ -848,6 +854,9 @@ class Service:
     def _complete_error(self, ticket: Ticket, err: BaseException) -> None:
         outcome = err.code if isinstance(err, ServeError) else "error"
         obs.SERVE_REQUESTS.labels(ticket.key[0], outcome).inc()
+        obs.SERVE_REQUEST_LATENCY.observe(
+            max(_time.monotonic() - ticket.enqueued_at, 0.0)
+        )
         ticket.span.tag(outcome=outcome)
         ticket.span.end()
         if not ticket.future.done():
@@ -871,9 +880,19 @@ class Service:
             "requests": metric("serve_requests_total"),
             "shed": metric("serve_shed_total"),
             "recompiles": metric("serve_recompiles_total"),
+            # Per-shape compile attribution ("workload/case:bucket" ->
+            # first dispatches of that shape): the aggregate counter
+            # above says a storm happened, this table says WHO.
+            # .copy() first: the dispatch thread inserts keys while a
+            # /stats handler iterates, and a GIL-atomic snapshot beats
+            # a "dict changed size" 500 mid-recompile-storm.
+            "recompiles_by_bucket": dict(
+                sorted(self.batcher.recompiles_by_bucket.copy().items())
+            ),
             "batch_lanes": metric("serve_batch_lanes"),
             "queue_wait_seconds": metric("serve_queue_wait_seconds"),
             "solve_seconds": metric("serve_solve_seconds"),
+            "request_seconds": metric("serve_request_seconds"),
         }
 
     def start(self) -> "Service":

@@ -1,0 +1,313 @@
+"""Noise-aware perf-regression gate over ``bench.py`` snapshots.
+
+The repo's BENCH trajectory (``BENCH_r01..r05.json``) shows the hot
+kernels drifting by 4x across PRs when someone was watching — this tool
+is the watcher that doesn't sleep: feed it the JSON line ``bench.py``
+prints and it (a) flattens the snapshot into a flat metric dict,
+(b) appends it to a rolling ``bench_history.jsonl``, and (c) judges the
+current run against the history's rolling baseline with per-metric
+relative thresholds, exiting nonzero with a per-metric verdict table on
+a regression.  Designed to run in CI on a cheap ``--sections`` subset:
+
+    python bench.py --sections quick > snap.json
+    python -m freedm_tpu.tools.perf_gate snap.json \
+        --history bench_history.jsonl
+
+Noise discipline:
+
+- **Rolling baseline** — the *median* of the last ``--window`` runs
+  (default 8) that carried the metric, so one slow CI minute in the
+  history cannot poison the baseline the way a mean would.
+- **Min-samples rule** — a metric with fewer than ``--min-samples``
+  history points (default 3) is ``baseline`` (pass, build history);
+  gating starts only once the baseline is real.
+- **Direction-aware** — metric names carry their own polarity
+  (``*_ms``/``*_seconds``/latency = lower is better; ``*_per_sec``/
+  ``qps``/``speedup`` = higher is better); names matching neither rule
+  are reported as ``info`` and never gate.
+- **Per-metric thresholds** — ``--threshold 0.25`` is the default
+  relative tolerance; ``--set-threshold name=0.5`` overrides noisy
+  metrics individually.
+
+Exit codes: 0 = pass (ok/improved/baseline/info only), 1 = at least
+one ``REGRESSED`` metric, 2 = unreadable input.  The snapshot is
+appended to the history only on a passing run — a regressed run must
+not become the next run's baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: Keys whose subtrees are never flattened into gateable metrics: the
+#: registry snapshot is a scrape (huge, already covered by the explicit
+#: bench numbers), distributions/buckets are shape tables not scalars.
+SKIP_KEYS = {"metrics", "batch_lanes_distribution", "buckets"}
+
+#: Name fragments that mark a metric lower-is-better / higher-is-better.
+LOWER_BETTER = ("_ms", "_seconds", "latency", "mismatch", "residual",
+                "shed", "errors", "nonconv", "iters_mean", "iters_max",
+                "_bytes")
+HIGHER_BETTER = ("per_sec", "qps", "speedup", "reduction_pct", "mfu",
+                 "vs_baseline", "rounds_per")
+
+
+def flatten(obj, prefix: str = "") -> Dict[str, float]:
+    """Dot-joined numeric leaves of a bench snapshot (bools excluded —
+    a flipped assertion is a correctness problem, not a perf drift)."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if k in SKIP_KEYS:
+                continue
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.update(flatten(v, key))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        if prefix:
+            out[prefix] = float(obj)
+    return out
+
+
+def direction(name: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 informational.
+    Higher-better fragments win ties (``..._per_sec`` contains no
+    lower-better fragment, but ``...ms_per_iteration`` style names
+    must resolve deterministically)."""
+    low = name.lower()
+    if any(f in low for f in HIGHER_BETTER):
+        return 1
+    if any(f in low for f in LOWER_BETTER):
+        return -1
+    return 0
+
+
+def load_history(path: str) -> List[dict]:
+    """The history file's entries (oldest first); [] when absent."""
+    if not path or not os.path.exists(path):
+        return []
+    out = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # a torn tail write must not kill the gate
+            if isinstance(rec, dict) and isinstance(rec.get("metrics"), dict):
+                out.append(rec)
+    return out
+
+
+def append_history(path: str, flat: Dict[str, float],
+                   label: str = "") -> None:
+    rec = {"label": label, "metrics": flat}
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(rec) + "\n")
+
+
+def gate(
+    flat: Dict[str, float],
+    history: List[dict],
+    threshold: float = 0.25,
+    min_samples: int = 3,
+    window: int = 8,
+    per_metric: Optional[Dict[str, float]] = None,
+) -> Tuple[List[dict], bool]:
+    """Judge one flattened snapshot against the rolling baseline.
+
+    Returns ``(verdicts, passed)``; each verdict row is
+    ``{metric, status, current, baseline, samples, change_pct,
+    threshold_pct}`` with status one of ``ok`` / ``improved`` /
+    ``REGRESSED`` / ``baseline`` / ``info``.
+    """
+    per_metric = per_metric or {}
+    verdicts: List[dict] = []
+    passed = True
+    for name in sorted(flat):
+        cur = flat[name]
+        d = direction(name)
+        hist_vals = [
+            h["metrics"][name] for h in history[-int(window):]
+            if isinstance(h["metrics"].get(name), (int, float))
+            and not isinstance(h["metrics"].get(name), bool)
+        ]
+        thr = float(per_metric.get(name, threshold))
+        row = {
+            "metric": name,
+            "current": cur,
+            "samples": len(hist_vals),
+            "threshold_pct": round(100.0 * thr, 1),
+        }
+        if d == 0:
+            row.update(status="info", baseline=None, change_pct=None)
+        elif len(hist_vals) < max(int(min_samples), 1):
+            row.update(status="baseline", baseline=None, change_pct=None)
+        else:
+            base = statistics.median(hist_vals)
+            row["baseline"] = base
+            if abs(base) < 1e-12:
+                # A zero baseline has no relative scale: only gate on a
+                # lower-is-better metric growing past the threshold in
+                # absolute terms of... nothing to scale by — report it.
+                row.update(status="info", change_pct=None)
+            else:
+                change = (cur - base) / abs(base)
+                row["change_pct"] = round(100.0 * change, 2)
+                score = d * change  # >0 improved, <0 worse
+                if score < -thr:
+                    row["status"] = "REGRESSED"
+                    passed = False
+                elif score > thr:
+                    row["status"] = "improved"
+                else:
+                    row["status"] = "ok"
+        verdicts.append(row)
+    return verdicts, passed
+
+
+def render_table(verdicts: List[dict], all_rows: bool = False) -> str:
+    """Aligned verdict table; by default only gated rows (regressions,
+    improvements, fresh baselines) — ``info`` rows on request."""
+    rows = [
+        v for v in verdicts
+        if all_rows or v["status"] in ("REGRESSED", "improved", "baseline",
+                                       "ok")
+    ]
+    if not rows:
+        return "(no gateable metrics)"
+    head = ("STATUS", "METRIC", "CURRENT", "BASELINE", "CHANGE", "LIMIT")
+    table = [head]
+    for v in rows:
+        table.append((
+            v["status"],
+            v["metric"],
+            f"{v['current']:.6g}",
+            "-" if v.get("baseline") is None else f"{v['baseline']:.6g}",
+            "-" if v.get("change_pct") is None else f"{v['change_pct']:+.1f}%",
+            f"±{v['threshold_pct']:.0f}%",
+        ))
+    widths = [max(len(r[i]) for r in table) for i in range(len(head))]
+    lines = [
+        "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        for r in table
+    ]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    # Exit-code contract: 0 pass, 1 REGRESSED, 2 gate-side problem.
+    # A crash must land on 2, never 1 — CI asserts rc==1 as "the gate
+    # caught the regression", and a broken gate must not pass for that.
+    try:
+        return _main(argv)
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 — disambiguate crash from verdict
+        print(f"perf_gate: internal error: {e!r}", file=sys.stderr)
+        return 2
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Perf-regression gate over bench.py snapshots"
+    )
+    ap.add_argument("snapshot", help="bench.py JSON output (file path)")
+    ap.add_argument("--history", default="bench_history.jsonl",
+                    metavar="PATH", help="rolling history file (JSONL)")
+    ap.add_argument("--threshold", type=float, default=0.25, metavar="REL",
+                    help="default relative regression tolerance "
+                         "(default 0.25 = 25%%)")
+    ap.add_argument("--min-samples", type=int, default=3, metavar="N",
+                    help="history points required before a metric gates "
+                         "(default 3; fewer = baseline-building pass)")
+    ap.add_argument("--window", type=int, default=8, metavar="N",
+                    help="rolling-baseline width: median of the last N "
+                         "history points (default 8)")
+    ap.add_argument("--set-threshold", action="append", default=[],
+                    metavar="NAME=REL",
+                    help="per-metric threshold override (repeatable)")
+    ap.add_argument("--label", default="", help="label stored with the "
+                                                "history entry (e.g. a sha)")
+    ap.add_argument("--no-update", action="store_true",
+                    help="judge only; never append to the history")
+    ap.add_argument("--seed", action="append", default=[], metavar="PATH",
+                    help="append these snapshots to the history first "
+                         "(ungated) — e.g. the repo's BENCH_r*.json")
+    ap.add_argument("--all-rows", action="store_true",
+                    help="include info (ungated) metrics in the table")
+    args = ap.parse_args(argv)
+
+    per_metric: Dict[str, float] = {}
+    for spec in args.set_threshold:
+        name, _, val = spec.partition("=")
+        if not name or not val:
+            print(f"perf_gate: bad --set-threshold {spec!r}", file=sys.stderr)
+            return 2
+        per_metric[name] = float(val)
+
+    try:
+        with open(args.snapshot, "r", encoding="utf-8") as fh:
+            snapshot = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: unreadable snapshot: {e}", file=sys.stderr)
+        return 2
+
+    # Seeding is idempotent (a seed label already in the history is
+    # skipped, so a cron job passing --seed every run cannot pin the
+    # rolling baseline to stale values) and honors --no-update.
+    seeded_labels = {h.get("label") for h in load_history(args.history)}
+    for path in args.seed:
+        label = f"seed:{path}"
+        if label in seeded_labels:
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                snap = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: unreadable seed {path}: {e}", file=sys.stderr)
+            return 2
+        if not args.no_update:
+            append_history(args.history, flatten(snap), label=label)
+
+    flat = flatten(snapshot)
+    if not flat:
+        print("perf_gate: snapshot contains no numeric metrics",
+              file=sys.stderr)
+        return 2
+    history = load_history(args.history)
+    verdicts, passed = gate(
+        flat, history, threshold=args.threshold,
+        min_samples=args.min_samples, window=args.window,
+        per_metric=per_metric,
+    )
+    print(render_table(verdicts, all_rows=args.all_rows))
+    regressed = [v["metric"] for v in verdicts if v["status"] == "REGRESSED"]
+    summary = {
+        "perf_gate_pass": passed,
+        "metrics": len(flat),
+        "gated": sum(
+            1 for v in verdicts if v["status"] in ("ok", "improved",
+                                                   "REGRESSED")
+        ),
+        "baseline_building": sum(
+            1 for v in verdicts if v["status"] == "baseline"
+        ),
+        "regressed": regressed,
+        "history_runs": len(history),
+    }
+    print(json.dumps(summary))
+    if passed and not args.no_update:
+        append_history(args.history, flat, label=args.label)
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

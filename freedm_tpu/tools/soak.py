@@ -18,6 +18,12 @@ reading logs.  This tool is the framework's equivalent, automated
 - machine-checked assertions on the slices' own JSON round summaries:
   group membership counts, leadership change, power conservation
   (Σ gateway ≈ 0), and VVC liveness through the master's death;
+- SLO verdicts, not just counters: every slice runs the in-process SLO
+  monitor (``core/slo.py``), and the rig asserts that the member-kill
+  phase produced at least one ``slo.breach`` → ``slo.recovered`` pair
+  in some slice's journal (a restarted slice's kernel re-warm trips
+  the broker-overrun objective, then recovers warm); the artifact also
+  carries ``/slo`` + ``/profile`` snapshots;
 - one command, one pass/fail JSON artifact:
 
     python -m freedm_tpu.tools.soak --slices 5 --out soak.json
@@ -107,6 +113,59 @@ def scrape_slice_metrics(port: int, timeout_s: float = 3.0) -> Dict[str, float]:
             except ValueError:
                 pass
     return out
+
+
+def read_events_jsonl(path: Path) -> List[Dict]:
+    """The slice's event journal (append survives its kill/restart);
+    [] when missing/torn."""
+    if not path.exists():
+        return []
+    out: List[Dict] = []
+    for line in path.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # the kill can tear the last line
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def slo_breach_recover_pairs(events: List[Dict],
+                             after_ts: float = 0.0) -> List[Dict]:
+    """Matched (slo.breach, slo.recovered) pairs per objective, breach
+    no earlier than ``after_ts`` — the soak's "this slice went out of
+    objective and came back" evidence."""
+    open_breach: Dict[str, Dict] = {}
+    pairs: List[Dict] = []
+    for ev in events:
+        name = ev.get("event")
+        slo = ev.get("slo")
+        if name == "slo.breach" and ev.get("ts", 0.0) >= after_ts:
+            open_breach[slo] = ev
+        elif name == "slo.recovered" and slo in open_breach:
+            b = open_breach.pop(slo)
+            pairs.append({
+                "slo": slo,
+                "breach_ts": b.get("ts"),
+                "recovered_ts": ev.get("ts"),
+                "breach_value": b.get("value"),
+                "burn_fast": b.get("burn_fast"),
+            })
+    return pairs
+
+
+def scrape_json_route(port: int, route: str, timeout_s: float = 3.0) -> Dict:
+    """One JSON GET against a slice's metrics server ({} on failure)."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{route}", timeout=timeout_s
+        ) as r:
+            return json.loads(r.read())
+    except Exception:
+        return {}
 
 
 _CACHE_DIR: Optional[str] = None
@@ -563,6 +622,22 @@ def write_configs(
         # Per-slice trace files (core.tracing): trace_report.py merges
         # them into the skew-corrected causal round timeline.
         trace_line = f"trace-log = {workdir}/trace_{spec.port}.jsonl\n"
+        # SLO monitor + profiling registry (core.slo, core.profiling):
+        # every slice judges its own objectives and journals
+        # slo.breach/slo.recovered — the fault schedule's compile storms
+        # (a restarted slice re-warming its kernels inside 150-250 ms
+        # phase budgets) must breach the overrun objective and then
+        # recover, which run_soak asserts from the victim's journal.
+        # Short fast window so recovery lands within the soak; the
+        # overrun target is loose (0.25/round) so a loaded CI box's
+        # occasional steady-state overrun cannot breach on its own.
+        slo_line = (
+            "slo-enabled = yes\n"
+            "slo-fast-window-s = 20\n"
+            "slo-slow-window-s = 120\n"
+            "slo-overrun-rate = 0.25\n"
+            "profile-metrics = yes\n"
+        )
         # What-if query endpoint (freedm_tpu.serve): the soak drives a
         # closed-loop load against one slice to prove serving and the
         # broker round loop coexist through kills/rejoins.
@@ -575,7 +650,7 @@ def write_configs(
         cfg.write_text(
             f"hostname = 127.0.0.1\nport = {spec.port}\nfederate = yes\n"
             f"{peers}\nmigration-step = 1\n{vvc_line}{metrics_line}"
-            f"{trace_line}{serve_line}"
+            f"{trace_line}{slo_line}{serve_line}"
             f"device-config = {workdir}/device.xml\n"
             f"adapter-config = {workdir}/adapter.xml\n"
             f"timings-config = {workdir}/timings.cfg\n"
@@ -631,6 +706,10 @@ def run_soak(
     check = Check()
     slice_metrics: Dict[str, Dict[str, float]] = {}
     loader: Optional[ServeLoader] = None
+    slo_pairs: List[Dict] = []
+    pre_kill_pairs: List[Dict] = []
+    slo_status: Dict = {}
+    profile_snap: Dict = {}
     plant = subprocess.Popen(
         [sys.executable, "-m", "freedm_tpu.sim.plantserver", str(wd / "rig.xml")],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=_env(), text=True,
@@ -729,6 +808,7 @@ def run_soak(
                     probe.wait_chunks(1, timeout_s=form_timeout),
                     f"chunks_done={probe.chunks_before_kill}",
                 )
+        kill_ts = time.time()
         member.kill()
         survivors = [p for p in procs if p.alive()]
         ok = wait_for(survivors, lambda: all(
@@ -818,6 +898,45 @@ def run_soak(
                     f"{'exact' if got == want else f'{got} != {want}'}",
                 )
 
+        # SLO verdict: the member-kill schedule restarts two slices,
+        # and each restart re-warms its jit kernels inside 150-250 ms
+        # realtime phase budgets — the broker_overruns objective must
+        # BREACH on some slice after the first kill and then RECOVER
+        # once the kernels are warm.  Asserted from the slices' own
+        # journals (slo.breach/slo.recovered events), which is the
+        # whole point of the SLO layer: the rig reads a verdict, not a
+        # counter.
+        for spec in specs:
+            events = read_events_jsonl(wd / f"events_{spec.port}.jsonl")
+            for pair in slo_breach_recover_pairs(events, after_ts=kill_ts):
+                pair["slice"] = spec.uuid
+                slo_pairs.append(pair)
+            for pair in slo_breach_recover_pairs(events):
+                if pair.get("breach_ts", 0.0) < kill_ts:
+                    pair["slice"] = spec.uuid
+                    pre_kill_pairs.append(pair)
+        check.record(
+            "slo_breach_and_recover_after_kill", bool(slo_pairs),
+            f"pairs={[(p['slice'], p['slo']) for p in slo_pairs]}",
+        )
+
+        # /slo and /profile snapshots, preferring the slice that served
+        # queries (its profile account carries the serve compile/host
+        # entries): the artifact carries the judgment layer's final
+        # verdict and the compile/memory/host accounts alongside the
+        # raw counters.
+        for p in sorted(
+            procs,
+            key=lambda p: (p.spec.serve_port is None, p.spec is not specs[-1]),
+        ):
+            if p.alive() and p.spec.metrics_port is not None:
+                slo_status = scrape_json_route(p.spec.metrics_port, "/slo")
+                profile_snap = scrape_json_route(
+                    p.spec.metrics_port, "/profile"
+                )
+                if slo_status:
+                    break
+
         # Per-slice transport/solver counters, scraped from each live
         # slice's metrics endpoint before teardown — the SOAK trajectory's
         # retransmit columns.
@@ -884,6 +1003,12 @@ def run_soak(
         "metrics": totals,
         "slice_metrics": slice_metrics,
         "trace": trace_summary,
+        "slo": {
+            "breach_recover_pairs_after_kill": slo_pairs,
+            "breach_recover_pairs_before_kill": pre_kill_pairs,
+            "status": slo_status,
+        },
+        "profile": profile_snap,
     }
     if out:
         Path(out).write_text(json.dumps(artifact, indent=2))

@@ -68,9 +68,25 @@ def test_plant_server_death_regroups_fleet_automatically():
             auto_liveness=True,
         )
         broker = build_broker(fleet)
-        # Wait for all adapters to reveal, then a full 3-node group.
+        # Wait for all adapters to reveal, then poll rounds until the
+        # full 3-node group forms (a fixed round count raced the
+        # adapters' first health-bearing polls: auto-liveness counts a
+        # node with no fresh device data as down, so the GM phase can
+        # legitimately see an empty fleet for the first few rounds).
         wait_for(lambda: all(a.revealed for a in adapters), what="reveal")
-        broker.run(n_rounds=3)
+
+        def run_until(cond, what, max_rounds=60):
+            for _ in range(max_rounds):
+                broker.run(n_rounds=1)
+                if cond(broker.shared["group"]):
+                    return
+                time.sleep(0.02)
+            raise AssertionError(f"no {what} within {max_rounds} rounds")
+
+        run_until(
+            lambda g: int(g.n_groups) == 1 and int(g.group_size[0]) == 3,
+            what="full 3-node group",
+        )
         g = broker.shared["group"]
         assert int(g.n_groups) == 1 and int(g.group_size[0]) == 3
 
@@ -79,7 +95,10 @@ def test_plant_server_death_regroups_fleet_automatically():
         # the node, and the survivors regroup.
         servers[0].stop()
         wait_for(lambda: adapters[0].error is not None, what="adapter error")
-        broker.run(n_rounds=3)
+        run_until(
+            lambda g: int(g.n_groups) == 1 and int(g.coordinator[0]) == -1,
+            what="2-node regroup without node 0",
+        )
         g = broker.shared["group"]
         assert not fleet.nodes[0].alive
         assert int(g.n_groups) == 1

@@ -96,13 +96,62 @@ def test_snapshot_is_json_serializable():
 def test_estimate_quantiles_from_fixed_buckets():
     # Buckets (1, 2, 4) + overflow; one observation per finite bucket.
     qs = M.estimate_quantiles((1.0, 2.0, 4.0), (1, 1, 1, 0), (0.5, 1.0))
-    # target 1.5 of 3: half-way through the (1, 2] bucket.
-    assert qs[0] == pytest.approx(1.5)
+    # target 1.5 of 3 selects the (1, 2] bucket's ONLY sample, whose
+    # rank-anchored position is the bucket bound itself (not 1.5, the
+    # midpoint the pre-fix interpolation reported).
+    assert qs[0] == pytest.approx(2.0)
     assert qs[1] == pytest.approx(4.0)
     # Everything in the overflow bucket saturates at the last bound.
     assert M.estimate_quantiles((1.0,), (0, 5))[0] == pytest.approx(1.0)
     # Empty histograms have no quantiles.
     assert M.estimate_quantiles((1.0, 2.0), (0, 0, 0)) is None
+
+
+def test_exact_boundary_samples_quantile_at_the_bound():
+    # The bucket-edge interpolation fix: a sample sitting exactly ON a
+    # bucket bound must not smear to the bucket midpoint.  One
+    # observation at 2.0 under buckets (1, 2, 4) used to report
+    # p50=1.5/p99=1.99; every quantile of a single-sample bucket is now
+    # its upper bound.
+    h = M.MetricsRegistry().histogram("edge_it", buckets=(1.0, 2.0, 4.0))
+    h.observe(2.0)
+    q = h.labels().quantiles()
+    assert q == {"p50": 2.0, "p95": 2.0, "p99": 2.0}
+    # Multi-sample buckets keep interpolating BETWEEN sample ranks —
+    # but never below the first rank's position.
+    qs = M.estimate_quantiles((1.0, 2.0, 4.0), (0, 5, 0, 0),
+                              (0.01, 0.5, 1.0))
+    assert qs[0] == pytest.approx(1.2)  # first of 5 ranks, not lo+eps
+    assert qs[1] == pytest.approx(1.5)
+    assert qs[2] == pytest.approx(2.0)
+
+
+def test_registry_reset_for_tests_zeroes_without_dropping_series():
+    reg = M.MetricsRegistry()
+    c = reg.counter("r_total", labels=("k",))
+    g = reg.gauge("r_gauge")
+    h = reg.histogram("r_seconds", buckets=(1.0,))
+    c.labels("a").inc(5)
+    g.set(7)
+    h.observe(0.5)
+    reg.reset_for_tests()
+    # Values are zeroed...
+    assert c.labels("a").value == 0
+    assert g.value == 0
+    assert h.count == 0 and h.sum == 0.0
+    assert h.labels().quantiles() is None
+    # ...but registrations and labelled children survive (the module
+    # constants stay bound to live series).
+    assert reg.get("r_total") is c
+    assert ("a",) in dict(c.children())
+    c.labels("a").inc()
+    assert c.labels("a").value == 1
+    # The module-level helper covers the process-wide instances.
+    M.SERVE_SHED.inc(2)
+    M.EVENTS.emit("x.y")
+    M.reset_for_tests()
+    assert M.SERVE_SHED.value == 0
+    assert len(M.EVENTS) == 0
 
 
 def test_all_zero_count_histogram_has_no_quantiles():

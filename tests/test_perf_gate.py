@@ -1,0 +1,196 @@
+"""Perf-regression gate tests (``freedm_tpu.tools.perf_gate``).
+
+Covers: snapshot flattening (registry snapshot excluded, bools
+excluded), direction inference, the min-samples baseline-building rule,
+identical runs passing, an injected 50% regression failing (in both
+polarities), improvements not failing, the rolling-median baseline's
+outlier tolerance, per-metric threshold overrides, and history
+append-on-pass/freeze-on-fail via the CLI.
+"""
+
+import json
+
+from freedm_tpu.tools import perf_gate as pg
+
+
+def _hist(*metric_dicts):
+    return [{"label": "", "metrics": m} for m in metric_dicts]
+
+
+# ---------------------------------------------------------------------------
+# flatten + direction
+# ---------------------------------------------------------------------------
+
+
+def test_flatten_skips_registry_and_non_scalars():
+    flat = pg.flatten({
+        "metric": "pf_ladder_ms",
+        "value": 0.3,
+        "extra": {"nr_2000bus_mesh_solves_per_sec": 12.5,
+                  "ok": True},
+        "serve": {"case14": {"mixed": {"microbatch_speedup": 8.9}}},
+        "metrics": {"huge_registry": {"values": {"": 1e9}}},
+        "qsts": {"kill_resume": {"summary_exact_match": True}},
+    })
+    assert flat == {
+        "value": 0.3,
+        "extra.nr_2000bus_mesh_solves_per_sec": 12.5,
+        "serve.case14.mixed.microbatch_speedup": 8.9,
+    }
+
+
+def test_direction_rules():
+    assert pg.direction("extra.n1_case30_real_smw_ms") == -1
+    assert pg.direction("serve.overload.at_1x.p99_ms") == -1
+    assert pg.direction("extra.lb_256node_rounds_per_sec") == 1
+    assert pg.direction("serve.case14.pf.microbatch_speedup") == 1
+    assert pg.direction("qsts.warm_start.iters_reduction_pct") == 1
+    # ms_per_iteration carries both fragments: higher-better rules win
+    # deterministically... it does not contain one, check polarity:
+    assert pg.direction("value") == 0  # unknown: informational
+
+
+# ---------------------------------------------------------------------------
+# gating
+# ---------------------------------------------------------------------------
+
+
+def test_min_samples_rule_builds_baseline_before_gating():
+    flat = {"a_ms": 10.0}
+    verdicts, passed = pg.gate(flat, _hist({"a_ms": 1.0}), min_samples=3)
+    assert passed
+    assert verdicts[0]["status"] == "baseline"
+    # With enough history the same 10x blowup gates.
+    verdicts, passed = pg.gate(
+        flat, _hist({"a_ms": 1.0}, {"a_ms": 1.1}, {"a_ms": 0.9}),
+        min_samples=3,
+    )
+    assert not passed
+    assert verdicts[0]["status"] == "REGRESSED"
+
+
+def test_identical_runs_pass_and_injected_regression_fails():
+    cur = {"a_ms": 10.0, "b_per_sec": 100.0}
+    hist = _hist(cur, cur, cur)
+    _, passed = pg.gate(cur, hist)
+    assert passed
+    # 50% slower on a lower-is-better metric: rejected at the default
+    # 25% threshold.
+    v, passed = pg.gate({"a_ms": 15.0, "b_per_sec": 100.0}, hist)
+    assert not passed
+    assert [r["status"] for r in v] == ["REGRESSED", "ok"]
+    # 50% lower throughput on a higher-is-better metric: also rejected.
+    v, passed = pg.gate({"a_ms": 10.0, "b_per_sec": 50.0}, hist)
+    assert not passed
+    assert [r["status"] for r in v] == ["ok", "REGRESSED"]
+
+
+def test_improvement_does_not_fail():
+    hist = _hist({"a_ms": 10.0}, {"a_ms": 10.0}, {"a_ms": 10.0})
+    v, passed = pg.gate({"a_ms": 4.0}, hist)
+    assert passed
+    assert v[0]["status"] == "improved"
+
+
+def test_rolling_median_shrugs_off_one_outlier_run():
+    # One slow CI minute in the history must not drag the baseline: the
+    # median of (10, 10, 30, 10, 10) is 10, so a current 11 is ok.
+    hist = _hist(*[{"a_ms": x} for x in (10.0, 10.0, 30.0, 10.0, 10.0)])
+    v, passed = pg.gate({"a_ms": 11.0}, hist)
+    assert passed and v[0]["status"] == "ok"
+    assert v[0]["baseline"] == 10.0
+
+
+def test_per_metric_threshold_override():
+    hist = _hist({"a_ms": 10.0}, {"a_ms": 10.0}, {"a_ms": 10.0})
+    _, passed = pg.gate({"a_ms": 14.0}, hist)  # +40% > default 25%
+    assert not passed
+    _, passed = pg.gate({"a_ms": 14.0}, hist, per_metric={"a_ms": 0.5})
+    assert passed
+
+
+def test_unknown_direction_metrics_never_gate():
+    hist = _hist({"mystery": 1.0}, {"mystery": 1.0}, {"mystery": 1.0})
+    v, passed = pg.gate({"mystery": 100.0}, hist)
+    assert passed
+    assert v[0]["status"] == "info"
+
+
+# ---------------------------------------------------------------------------
+# CLI + history lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_cli_history_appends_on_pass_and_freezes_on_fail(tmp_path, capsys):
+    snap = {"extra": {"a_ms": 10.0, "b_per_sec": 100.0}}
+    s1 = tmp_path / "s1.json"
+    s1.write_text(json.dumps(snap))
+    hist = str(tmp_path / "hist.jsonl")
+
+    # Run 1: empty history -> baseline-building pass, appended.
+    assert pg.main([str(s1), "--history", hist, "--min-samples", "1"]) == 0
+    assert len(pg.load_history(hist)) == 1
+    # Run 2: identical -> ok, appended.
+    assert pg.main([str(s1), "--history", hist, "--min-samples", "1"]) == 0
+    assert len(pg.load_history(hist)) == 2
+    # Run 3: injected 50% regression -> exit 1, NOT appended (a
+    # regressed run must not become the next run's baseline).
+    bad = {"extra": {"a_ms": 15.0, "b_per_sec": 100.0}}
+    s3 = tmp_path / "s3.json"
+    s3.write_text(json.dumps(bad))
+    assert pg.main([str(s3), "--history", hist, "--min-samples", "1"]) == 1
+    assert len(pg.load_history(hist)) == 2
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["perf_gate_pass"] is False
+    assert summary["regressed"] == ["extra.a_ms"]
+
+
+def test_cli_unreadable_snapshot_is_usage_error(tmp_path):
+    missing = str(tmp_path / "nope.json")
+    assert pg.main([missing, "--history",
+                    str(tmp_path / "h.jsonl")]) == 2
+
+
+def test_cli_internal_errors_exit_2_never_1(tmp_path):
+    # The exit-code contract CI leans on: rc=1 means REGRESSED and
+    # nothing else — a gate-side crash (here: an unparseable threshold
+    # value) must land on 2.
+    snap = tmp_path / "s.json"
+    snap.write_text(json.dumps({"extra": {"a_ms": 1.0}}))
+    assert pg.main([str(snap), "--history", str(tmp_path / "h.jsonl"),
+                    "--set-threshold", "a_ms=abc"]) == 2
+
+
+def test_cli_seed_builds_history_from_bench_trajectory(tmp_path):
+    # The repo's BENCH_r*.json files can seed the baseline.
+    for i, ms in enumerate((10.0, 11.0, 9.5)):
+        (tmp_path / f"r{i}.json").write_text(
+            json.dumps({"extra": {"a_ms": ms}})
+        )
+    snap = tmp_path / "cur.json"
+    snap.write_text(json.dumps({"extra": {"a_ms": 10.5}}))
+    hist = str(tmp_path / "hist.jsonl")
+    seed_args = [
+        "--seed", str(tmp_path / "r0.json"),
+        "--seed", str(tmp_path / "r1.json"),
+        "--seed", str(tmp_path / "r2.json"),
+    ]
+    rc = pg.main([str(snap), "--history", hist] + seed_args)
+    assert rc == 0
+    # 3 seeds + the passing current run.
+    assert len(pg.load_history(hist)) == 4
+    # Seeding is idempotent: re-passing the same --seed flags appends
+    # nothing new (only the run itself lands), so a cron job cannot pin
+    # the rolling baseline to stale seed values.
+    rc = pg.main([str(snap), "--history", hist] + seed_args)
+    assert rc == 0
+    assert len(pg.load_history(hist)) == 5
+    labels = [h["label"] for h in pg.load_history(hist)]
+    assert labels.count(f"seed:{tmp_path / 'r0.json'}") == 1
+    # --no-update freezes the history completely, seeds included.
+    rc = pg.main([str(snap), "--history", hist, "--no-update",
+                  "--seed", str(tmp_path / "cur.json")])
+    assert rc == 0
+    assert len(pg.load_history(hist)) == 5

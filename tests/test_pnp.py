@@ -67,7 +67,13 @@ def test_hello_start_states_commands_roundtrip(server):
     assert manager.get_state("ctrl1:sst", "gateway") == pytest.approx(5.0)
     c.disconnect()
     wait_for(lambda: not manager.device_names(), what="slots freed")
-    assert ("leave", "ctrl1", "polite disconnect") in events
+    # The on_leave callback fires on the server thread and can land
+    # just after the slots free: poll for it instead of asserting a
+    # racy snapshot.
+    wait_for(
+        lambda: ("leave", "ctrl1", "polite disconnect") in events,
+        what="leave event",
+    )
 
 
 def test_heartbeat_timeout_reaps_adapter_and_allows_rejoin(server):

@@ -1,0 +1,195 @@
+"""Profiling registry tests (``freedm_tpu.core.profiling``).
+
+Covers: the compile account keying (one entry per (workload, shape
+bucket) no matter how often the shape recompiles), the device-memory
+peak's monotonicity, host-path timers, the disabled-by-default no-op
+path (the acceptance bar: one attribute check, no recorded state), and
+the ``traced_solver``/serve/QSTS integration hooks plus the ``/profile``
+route.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from freedm_tpu.core import metrics as M
+from freedm_tpu.core import profiling, tracing
+
+
+@pytest.fixture
+def profiler():
+    """Enable the process profiler for one test; hard-reset afterwards
+    so the rest of the suite runs on the disabled no-op path."""
+    profiling.PROFILER.configure(enabled=True)
+    yield profiling.PROFILER
+    profiling.PROFILER.reset()
+
+
+# ---------------------------------------------------------------------------
+# compile account
+# ---------------------------------------------------------------------------
+
+
+def test_compile_registry_one_entry_per_shape_bucket(profiler):
+    # Repeated compiles of the same (workload, bucket) accumulate onto
+    # ONE entry; a different bucket opens its own.
+    for _ in range(3):
+        profiler.record_compile("pf", 8, 0.25)
+    profiler.record_compile("pf", 64, 1.0)
+    profiler.record_compile("qsts:newton", "S16xT24", 2.0)
+    snap = profiler.snapshot()
+    assert set(snap["compiles"]) == {"pf", "qsts:newton"}
+    assert set(snap["compiles"]["pf"]) == {"8", "64"}
+    pf8 = snap["compiles"]["pf"]["8"]
+    assert pf8["count"] == 3
+    assert pf8["total_s"] == pytest.approx(0.75)
+    assert pf8["max_s"] == pytest.approx(0.25)
+    # The profile_* metric series carry the same account.
+    assert profiling.PROFILE_COMPILES.labels("pf", "8").value == 3
+    assert profiling.PROFILE_COMPILE_SECONDS.labels(
+        "pf", "8"
+    ).value == pytest.approx(0.75)
+
+
+def test_traced_solver_records_first_call_compile(profiler):
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.newton import make_newton_solver
+
+    sys_ = synthetic_mesh(10, seed=0, load_mw=1.0, chord_frac=1.0)
+    solve, _ = make_newton_solver(sys_)
+    solve()
+    solve()
+    solve()
+    snap = profiler.snapshot()
+    # Only the first call (the synchronous trace+compile hit) lands on
+    # the account, keyed (solver, "base"); warm dispatches add nothing.
+    assert snap["compiles"]["newton"]["base"]["count"] == 1
+    assert snap["compiles"]["newton"]["base"]["total_s"] > 0
+
+
+def test_solver_under_vmap_records_no_compile(profiler):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.newton import make_newton_solver
+
+    sys_ = synthetic_mesh(10, seed=0, load_mw=1.0, chord_frac=1.0)
+    _, solve_fixed = make_newton_solver(sys_, max_iter=4)
+    scale = np.random.default_rng(0).uniform(0.9, 1.1, (3, 1))
+    p = jnp.asarray(scale * np.asarray(sys_.p_inj)[None, :])
+    q = jnp.asarray(scale * np.asarray(sys_.q_inj)[None, :])
+    jax.vmap(lambda pi, qi: solve_fixed(p_inj=pi, q_inj=qi))(p, q)
+    assert "newton" not in profiler.snapshot()["compiles"]
+
+
+# ---------------------------------------------------------------------------
+# device-memory account
+# ---------------------------------------------------------------------------
+
+
+def test_memory_peak_is_monotone(profiler):
+    import jax.numpy as jnp
+
+    keep = [jnp.zeros((64, 64))]
+    first = profiler.sample_memory("serve")
+    assert first is not None and first > 0
+    keep.append(jnp.zeros((256, 256)))
+    second = profiler.sample_memory("serve")
+    assert second > first
+    peak_at_high = profiler.snapshot()["memory"]["serve"]["peak_bytes"]
+    assert peak_at_high >= second
+    del keep[:]
+    third = profiler.sample_memory("serve")
+    snap = profiler.snapshot()["memory"]["serve"]
+    # Live tracks the drop; the peak never comes down.
+    assert snap["live_bytes"] == third < second
+    assert snap["peak_bytes"] == peak_at_high
+    assert snap["samples"] == 3
+
+
+# ---------------------------------------------------------------------------
+# host-path account
+# ---------------------------------------------------------------------------
+
+
+def test_host_timers_accumulate(profiler):
+    profiler.record_host("serve.dispatch", 0.002)
+    profiler.record_host("serve.dispatch", 0.004)
+    profiler.record_host("qsts.chunk_gap", 0.5)
+    snap = profiler.snapshot()["host"]
+    assert snap["serve.dispatch"]["count"] == 2
+    assert snap["serve.dispatch"]["total_s"] == pytest.approx(0.006)
+    assert snap["serve.dispatch"]["mean_s"] == pytest.approx(0.003)
+    assert snap["qsts.chunk_gap"]["max_s"] == pytest.approx(0.5)
+    h = profiling.PROFILE_HOST_SECONDS.labels("serve.dispatch")
+    assert h.count == 2
+
+
+# ---------------------------------------------------------------------------
+# disabled mode: the one-attribute-check contract
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_profiler_records_nothing():
+    assert not profiling.PROFILER.enabled
+    before = profiling.PROFILE_COMPILES.labels("off", "1").value
+    profiling.PROFILER.record_compile("off", 1, 9.9)
+    profiling.PROFILER.record_host("off.path", 9.9)
+    assert profiling.PROFILER.sample_memory("off") is None
+    snap = profiling.PROFILER.snapshot()
+    assert snap == {"enabled": False, "compiles": {}, "memory": {},
+                    "host": {}}
+    assert profiling.PROFILE_COMPILES.labels("off", "1").value == before
+
+
+def test_disabled_mode_solver_path_does_no_profiling_work():
+    # The wrapped solver's disabled path must not touch the profiler
+    # beyond the enabled check: no compile entries appear even across
+    # a genuine first (compile) call.
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.newton import make_newton_solver
+
+    assert not profiling.PROFILER.enabled
+    assert not tracing.TRACER.enabled
+    sys_ = synthetic_mesh(10, seed=1, load_mw=1.0, chord_frac=1.0)
+    solve, _ = make_newton_solver(sys_)
+    solve()
+    solve()
+    assert profiling.PROFILER.snapshot()["compiles"] == {}
+
+
+# ---------------------------------------------------------------------------
+# QSTS + /profile route integration
+# ---------------------------------------------------------------------------
+
+
+def test_qsts_chunks_land_on_compile_account_and_profile_route(profiler):
+    from freedm_tpu.scenarios.engine import StudySpec, run_study
+
+    spec = StudySpec(case="vvc_9bus", scenarios=2, steps=6, chunk_steps=4,
+                     dt_minutes=15.0, seed=3)
+    run_study(spec)
+    snap = profiler.snapshot()
+    # Full chunk (T4) + ragged tail (T2): one account entry each.
+    assert set(snap["compiles"]["qsts:ladder"]) == {"S2xT4", "S2xT2"}
+    assert all(
+        v["count"] == 1 for v in snap["compiles"]["qsts:ladder"].values()
+    )
+    # The host gap between the two chunks was timed...
+    assert snap["host"]["qsts.chunk_gap"]["count"] >= 1
+    # ...memory was sampled per chunk...
+    assert snap["memory"]["qsts"]["samples"] >= 2
+    # ...and /profile serves the same snapshot.
+    server = M.MetricsServer(port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/profile", timeout=5
+        ) as r:
+            served = json.loads(r.read())
+    finally:
+        server.stop()
+    assert served["enabled"] is True
+    assert served["compiles"]["qsts:ladder"] == snap["compiles"]["qsts:ladder"]

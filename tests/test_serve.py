@@ -352,6 +352,31 @@ def test_http_roundtrip_and_typed_errors(svc):
         srv.stop()
 
 
+def test_stats_attributes_recompiles_per_shape_bucket(svc):
+    """/stats names WHICH (workload, case, bucket) shapes compiled —
+    the aggregate serve_recompiles_total counter says a storm happened,
+    the table says who, without reading traces."""
+    # Two deterministic shapes on a case this module's other tests
+    # don't screen: a 1-outage request (bucket 1) and a 3-outage
+    # request (3 lanes -> bucket 4).
+    eng = svc.engine("n1", "case_ieee30")
+    ks = list(eng._secure)
+    svc.request("n1", {"case": "case_ieee30", "outages": ks[:1]})
+    svc.request("n1", {"case": "case_ieee30", "outages": ks[:3]})
+    # Same shapes again: already-compiled buckets add nothing.
+    svc.request("n1", {"case": "case_ieee30", "outages": ks[1:2]})
+    table = svc.stats()["recompiles_by_bucket"]
+    assert table["n1/case_ieee30:1"] == 1
+    assert table["n1/case_ieee30:4"] == 1
+    # Every entry is a FIRST dispatch of its shape, and the aggregate
+    # counter covers the table's n1 total.
+    assert all(v == 1 for v in table.values())
+    snap = svc.stats()["recompiles"]
+    assert snap.get("n1", 0) >= sum(
+        v for k, v in table.items() if k.startswith("n1/")
+    )
+
+
 def _read_http_response(sock) -> bytes:
     """One full HTTP response (headers + Content-Length body) off a
     persistent connection, leaving any pipelined follow-up unread."""

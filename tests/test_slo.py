@@ -1,0 +1,237 @@
+"""SLO monitor tests (``freedm_tpu.core.slo``).
+
+Synthetic metric streams drive ``SloMonitor.tick`` with a fake clock:
+fast+slow burn-window crossing semantics (fast alone must not breach),
+breach → recover event pairing on the journal, the p99 objective over
+windowed histogram deltas, watchdog stall detection on a registered
+progress source, and the ``/slo`` route.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from freedm_tpu.core import metrics as M
+from freedm_tpu.core import slo
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    """The monitor reads the process-wide registry: start each test
+    from zeroed values (registrations survive)."""
+    M.reset_for_tests()
+    yield
+    M.reset_for_tests()
+
+
+def make_monitor(**over):
+    cfg = dict(fast_window_s=10.0, slow_window_s=40.0, interval_s=1.0,
+               burn_trip=2.0, serve_availability=0.9, serve_p99_ms=100.0,
+               broker_overrun_rate=0.1, watchdog_s=5.0)
+    cfg.update(over)
+    journal = M.JsonlEventJournal()
+    mon = slo.SloMonitor(slo.SloConfig(**cfg), journal=journal)
+    return mon, journal
+
+
+def slo_events(journal):
+    return [(e["event"], e.get("slo")) for e in journal.tail(200)
+            if e["event"].startswith("slo.")]
+
+
+# ---------------------------------------------------------------------------
+# burn windows
+# ---------------------------------------------------------------------------
+
+
+def test_availability_breach_and_recover_pairing():
+    mon, journal = make_monitor()
+    ok = M.SERVE_REQUESTS.labels("pf", "ok")
+    # "internal" is what a failed batch dispatch actually emits
+    # (ServeError.code via _complete_error) — the SLO must count it.
+    bad = M.SERVE_REQUESTS.labels("pf", "internal")
+    t = 0.0
+    # Healthy traffic long enough to fill both windows.
+    for _ in range(50):
+        ok.inc(10)
+        mon.tick(now=t)
+        t += 1.0
+    assert slo_events(journal) == []
+    # Sustained server faults: burns the 10% budget hard in BOTH
+    # windows -> exactly one breach event.
+    for _ in range(45):
+        ok.inc(5)
+        bad.inc(5)
+        mon.tick(now=t)
+        t += 1.0
+    assert slo_events(journal) == [("slo.breach", "serve_availability")]
+    assert mon.status()["breached"] == ["serve_availability"]
+    # Faults stop: the fast window comes clean -> one recovery, paired.
+    for _ in range(20):
+        ok.inc(10)
+        mon.tick(now=t)
+        t += 1.0
+    assert slo_events(journal) == [
+        ("slo.breach", "serve_availability"),
+        ("slo.recovered", "serve_availability"),
+    ]
+    assert mon.status()["breached"] == []
+    assert M.REGISTRY.get("slo_breaches_total").labels(
+        "serve_availability"
+    ).value == 1
+
+
+def test_fast_window_spike_without_slow_burn_does_not_breach():
+    # The whole point of the two-window discipline: a short fast-window
+    # spike on top of a long healthy history must NOT page, because the
+    # slow window is not burning.
+    mon, journal = make_monitor(fast_window_s=5.0, slow_window_s=200.0)
+    ok = M.SERVE_REQUESTS.labels("pf", "ok")
+    bad = M.SERVE_REQUESTS.labels("pf", "deadline_exceeded")
+    t = 0.0
+    for _ in range(200):  # 200 s of clean history
+        ok.inc(50)
+        mon.tick(now=t)
+        t += 1.0
+    for _ in range(6):  # a 6 s full-outage blip
+        bad.inc(50)
+        mon.tick(now=t)
+        t += 1.0
+    v = mon.tick(now=t)["serve_availability"]
+    assert v["burn_fast"] >= 2.0  # the fast window IS on fire...
+    assert v["burn_slow"] < 1.0  # ...but the budget is fine
+    assert slo_events(journal) == []
+
+
+def test_overrun_rate_breach_on_compile_storm():
+    mon, journal = make_monitor()
+    t = 0.0
+    # Startup storm: every round overruns (a restarted slice re-warming
+    # its kernels inside realtime budgets).
+    for _ in range(45):
+        M.BROKER_ROUNDS.inc(2)
+        M.BROKER_PHASE_OVERRUNS.labels("lb").inc(2)
+        mon.tick(now=t)
+        t += 1.0
+    assert ("slo.breach", "broker_overruns") in slo_events(journal)
+    # Warm kernels: clean rounds recover the objective.
+    for _ in range(15):
+        M.BROKER_ROUNDS.inc(2)
+        mon.tick(now=t)
+        t += 1.0
+    assert slo_events(journal)[-1] == ("slo.recovered", "broker_overruns")
+
+
+def test_p99_objective_over_windowed_histogram_delta():
+    mon, journal = make_monitor(serve_p99_ms=100.0)
+    t = 0.0
+    for _ in range(50):
+        M.SERVE_REQUEST_LATENCY.observe([0.01] * 20)
+        mon.tick(now=t)
+        t += 1.0
+    assert slo_events(journal) == []
+    for _ in range(45):
+        M.SERVE_REQUEST_LATENCY.observe([2.0] * 20)
+        mon.tick(now=t)
+        t += 1.0
+    assert ("slo.breach", "serve_p99") in slo_events(journal)
+    v = mon.status()["objectives"]["serve_p99"]
+    assert v["value"] > 100.0
+    for _ in range(20):
+        M.SERVE_REQUEST_LATENCY.observe([0.01] * 20)
+        mon.tick(now=t)
+        t += 1.0
+    assert slo_events(journal)[-1] == ("slo.recovered", "serve_p99")
+
+
+def test_qsts_floor_only_judged_while_running():
+    mon, journal = make_monitor(qsts_floor_steps_per_sec=1000.0)
+    rate = M.REGISTRY.get("qsts_scenario_steps_per_sec")
+    running = M.REGISTRY.get("qsts_jobs_running")
+    t = 0.0
+    # Slow chunks while NO job is running: not judged.
+    rate.set(10.0)
+    for _ in range(50):
+        mon.tick(now=t)
+        t += 1.0
+    assert slo_events(journal) == []
+    # A running job below the floor breaches; back above it recovers.
+    running.set(1)
+    for _ in range(45):
+        mon.tick(now=t)
+        t += 1.0
+    assert ("slo.breach", "qsts_throughput") in slo_events(journal)
+    rate.set(5000.0)
+    for _ in range(15):
+        mon.tick(now=t)
+        t += 1.0
+    assert slo_events(journal)[-1] == ("slo.recovered", "qsts_throughput")
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_stall_detection_and_recovery():
+    mon, journal = make_monitor(watchdog_s=5.0)
+    busy = [True]
+    age = [0.0]
+    mon.watch("serve.batcher", lambda: busy[0], lambda: age[0])
+    mon.tick(now=0.0)
+    assert [e for e in journal.tail() if e["event"].startswith("watchdog")] \
+        == []
+    # Busy with no progress past the limit: exactly one stall event,
+    # even across repeated ticks.
+    age[0] = 12.0
+    mon.tick(now=1.0)
+    mon.tick(now=2.0)
+    stalls = [e for e in journal.tail() if e["event"] == "watchdog.stall"]
+    assert len(stalls) == 1
+    assert stalls[0]["target"] == "serve.batcher"
+    assert stalls[0]["age_s"] == pytest.approx(12.0)
+    assert M.REGISTRY.get("watchdog_stalls_total").labels(
+        "serve.batcher"
+    ).value == 1
+    assert mon.status()["watchdogs"]["serve.batcher"]["stalled"] is True
+    # Progress resumes: recovery journaled, stall flag clears.
+    age[0] = 0.5
+    mon.tick(now=3.0)
+    assert journal.tail()[-1]["event"] == "watchdog.recovered"
+    assert mon.status()["watchdogs"]["serve.batcher"]["stalled"] is False
+    # Idle (not busy) never stalls, whatever the age says.
+    busy[0] = False
+    age[0] = 99.0
+    mon.tick(now=4.0)
+    stalls = [e for e in journal.tail() if e["event"] == "watchdog.stall"]
+    assert len(stalls) == 1
+
+
+# ---------------------------------------------------------------------------
+# exposition
+# ---------------------------------------------------------------------------
+
+
+def test_slo_route_serves_installed_monitor():
+    server = M.MetricsServer(port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/slo", timeout=5
+        ) as r:
+            assert json.loads(r.read()) == {"enabled": False}
+        mon, _ = make_monitor()
+        slo.install(mon)
+        try:
+            mon.tick(now=0.0)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/slo", timeout=5
+            ) as r:
+                body = json.loads(r.read())
+        finally:
+            slo.install(None)
+        assert body["enabled"] is True
+        assert body["config"]["serve_p99_ms"] == 100.0
+        assert "objectives" in body and "watchdogs" in body
+    finally:
+        server.stop()

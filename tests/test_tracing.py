@@ -407,6 +407,8 @@ FLEET_CHILD = textwrap.dedent("""
     class Pinger(DgiModule):
         name = "lb"
         sent_rounds = 0
+        def __init__(self):
+            self.pings_from = {p: 0 for p in peers}
         def run_phase(self, ctx):
             # Ping peers only once the clock sync demonstrably
             # converged: every peer's regression holds >= 8 sample
@@ -424,10 +426,12 @@ FLEET_CHILD = textwrap.dedent("""
                                              {"r": ctx.round_index},
                                              source=uuid))
         def handle_message(self, m, ctx=None):
-            pass
+            if m.type == "ping" and m.source in self.pings_from:
+                self.pings_from[m.source] += 1
 
     broker = Broker(clock=clock)
-    broker.register_module(Pinger(), 40)  # one 40 ms phase per round
+    pinger = Pinger()
+    broker.register_module(pinger, 40)  # one 40 ms phase per round
     ep = UdpEndpoint(uuid, bind=("127.0.0.1", port), sink=broker.deliver,
                      resend_time_s=0.02)
     for p in peers:
@@ -437,19 +441,40 @@ FLEET_CHILD = textwrap.dedent("""
                             query_interval_s=0.2)
     broker.attach_clock_sync(clk)
     ep.start()
-    # Generous tail (rounds past the ping window + drain sleep): the
-    # three children start staggered under load, and a peer that exits
-    # early would leave this node's last sends un-ACKed — their spans
-    # would never close.
-    broker.run(n_rounds=120, realtime=True)
-    time.sleep(1.0)
+
+    # Readiness-polled run (no fixed round count, no fixed drain
+    # sleep): batches of realtime rounds until (a) this node sent its
+    # ping window, (b) every peer's ping window ARRIVED here (the
+    # peers got their useful work done too, so an early exit cannot
+    # strand their un-ACKed sends), and (c) this node's own SR windows
+    # drained (our send spans closed on their ACKs) — all bounded by a
+    # hard wall-clock deadline so a wedged fleet exits instead of
+    # hanging the parent.
+    deadline = time.monotonic() + 90.0
+    while time.monotonic() < deadline:
+        broker.run(n_rounds=4, realtime=True)
+        done = (
+            pinger.sent_rounds >= 6
+            and all(n >= 6 for n in pinger.pings_from.values())
+            and all(len(ep.channel(p)._out_window) == 0 for p in peers)
+        )
+        if done:
+            break
     ep.stop()
     tracing.TRACER.close()
 """)
 
 
 def _run_three_node_fleet(workdir):
-    """Spawn the three skewed children; return the trace file paths."""
+    """Spawn the three skewed children and poll the fleet to completion
+    (readiness polling, not fixed sleeps: each child runs until its
+    pings went out, its peers' pings arrived, and its SR windows
+    drained, all under its own deadline); return the trace file paths.
+
+    Every failure mode — a child that exits nonzero AND a child that
+    outlives the parent's budget — surfaces as ``AssertionError`` so
+    the caller's bounded retry covers all of them.
+    """
     import os
 
     from test_federation import free_udp_ports
@@ -468,10 +493,28 @@ def _run_three_node_fleet(workdir):
              str(files[i]), str(port), str(skews[i]), *peers],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         ))
-    for p in procs:
-        out, err = p.communicate(timeout=120)
+    # Poll for fleet completion (the children gate their own exit on
+    # readiness, 90 s ceiling each); the parent budget only has to
+    # cover the slowest child plus startup stagger.
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline and any(
+        p.poll() is None for p in procs
+    ):
+        time.sleep(0.25)
+    hung = [p for p in procs if p.poll() is None]
+    for p in hung:
+        p.kill()
+    outs = [p.communicate(timeout=30) for p in procs]
+    assert not hung, "fleet children outlived the polling budget"
+    for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, err.decode()
     return [str(f) for f in files], uuids, skews
+
+
+#: Bounded retries for the fleet scenario: multi-process + wall-clock
+#: regression is inherently load-sensitive, so a failed run is retried,
+#: but never more than this many attempts total.
+FLEET_ATTEMPTS = 2
 
 
 def test_three_node_fleet_traced_end_to_end(tmp_path):
@@ -480,12 +523,9 @@ def test_three_node_fleet_traced_end_to_end(tmp_path):
     merged report must show round spans from every node, cross-node
     message spans parent-linked through the wire trace context, and
     timestamps corrected by the journaled clocksync offsets.
-
-    Multi-process + wall-clock regression = inherently load-sensitive,
-    so a failed scenario is retried once before the assertions count.
     """
     last = None
-    for attempt in range(2):
+    for attempt in range(FLEET_ATTEMPTS):
         try:
             _assert_three_node_fleet(tmp_path / f"attempt{attempt}")
             return
