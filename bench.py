@@ -679,6 +679,134 @@ def bench_serve(duration_s: float = 1.5) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Mesh scaling sweep (ISSUE 6): the same batched workload at 1/2/../all
+# local devices, lane axes sharded via shard_map (parallel/mesh.py).
+# On a 1-device host this degrades to a single-device no-op row; on a
+# multi-device host (incl. CPU with
+# XLA_FLAGS=--xla_force_host_platform_device_count=N) the sweep is the
+# acceptance measurement: >= 1.6x QSTS scenario throughput at D devices
+# with byte-identical results.
+# ---------------------------------------------------------------------------
+
+
+def _mesh_device_counts() -> list:
+    """1, the powers of two that divide the local device count, and the
+    full count — every entry divides D, so one lane count serves all."""
+    d_all = jax.local_device_count()
+    counts = [1]
+    d = 2
+    while d < d_all:
+        if d_all % d == 0:
+            counts.append(d)
+        d *= 2
+    if d_all > 1:
+        counts.append(d_all)
+    return counts
+
+
+def _lane_count(minimum: int, device_counts: list) -> int:
+    """Smallest multiple of every device count that is >= minimum."""
+    d_all = device_counts[-1]
+    return d_all * max(1, -(-minimum // d_all))
+
+
+def bench_mesh() -> dict:
+    """QSTS scenario-axis and Monte-Carlo lane-axis scaling over the
+    local device mesh, with sharded-vs-unsharded identity checks."""
+    from freedm_tpu.parallel.mesh import make_mesh
+    from freedm_tpu.scenarios.engine import (
+        QstsEngine,
+        StudySpec,
+        run_study,
+        strip_timing,
+    )
+    from freedm_tpu.utils import cplx
+
+    d_all = jax.local_device_count()
+    counts = _mesh_device_counts()
+    out: dict = {"devices_available": d_all}
+
+    # (a) QSTS: vmap-over-scenarios sharded, scan-over-time local.
+    s_lanes = _lane_count(16, counts)
+    spec_kw = dict(case="mesh118", scenarios=s_lanes, steps=24,
+                   chunk_steps=24, seed=5, max_iter=8)
+    qsts: dict = {}
+    base_rate = None
+    base_summary = None
+    identical = []
+    for d in counts:
+        spec = StudySpec(mesh_devices=0 if d == 1 else d, **spec_kw)
+        eng = QstsEngine(spec)
+        run_study(spec, engine=eng)  # compile + warm
+        s = run_study(spec, engine=eng)  # steady-state measurement
+        rate = s["scenario_steps_per_sec"]
+        row = {
+            "scenario_steps_per_sec": rate,
+            "qsts_steps_per_sec_per_device": round(rate / d, 1),
+        }
+        if d == 1:
+            base_rate, base_summary = rate, s
+        else:
+            row["speedup_vs_1dev"] = round(rate / base_rate, 2)
+            row["scaling_efficiency"] = round(rate / (base_rate * d), 3)
+            same = strip_timing(s) == strip_timing(base_summary)
+            identical.append(same)
+            row["identical_to_unsharded"] = same
+        qsts[str(d)] = row
+    out["qsts"] = qsts
+    out["qsts_workload"] = {"case": spec_kw["case"],
+                            "scenarios": s_lanes, "steps": spec_kw["steps"]}
+
+    # (b) Monte-Carlo ladder lanes through the mesh-batched solver.
+    feeder = synthetic_radial(512, seed=0, load_kw=1.0)
+    lanes = _lane_count(32, counts)
+    rng = np.random.default_rng(0)
+    s_load = cplx.as_c(
+        rng.uniform(0.7, 1.3, (lanes, 1, 1)) * np.asarray(feeder.s_load)[None]
+    )
+    mc: dict = {}
+    mc_base = None
+    mc_ref = None
+    mc_identical = []
+    for d in counts:
+        if d == 1:
+            _, sf = ladder.make_ladder_solver(feeder, max_iter=MAX_ITER)
+            solver = jax.jit(jax.vmap(sf))
+        else:
+            _, solver = ladder.make_ladder_solver(
+                feeder, max_iter=MAX_ITER,
+                mesh=make_mesh(d, axes=("batch",)),
+            )
+        r = solver(s_load)
+        dt = _time(lambda: solver(s_load), lambda r: r.v_node.re, reps=3)
+        rate = lanes / dt
+        row = {"mc_lane_solves_per_sec": round(rate, 1),
+               "mc_lane_solves_per_sec_per_device": round(rate / d, 1)}
+        if d == 1:
+            mc_base = rate
+            mc_ref = np.asarray(r.v_node.re).tobytes()
+        else:
+            row["speedup_vs_1dev"] = round(rate / mc_base, 2)
+            row["scaling_efficiency"] = round(rate / (mc_base * d), 3)
+            same = np.asarray(r.v_node.re).tobytes() == mc_ref
+            mc_identical.append(same)
+            row["identical_to_unsharded"] = same
+        mc[str(d)] = row
+    out["mc"] = mc
+    out["mc_workload"] = {"feeder_buses": 512, "lanes": lanes,
+                          "iters": MAX_ITER}
+
+    if d_all == 1:
+        out["no_op"] = True  # nothing to shard over; 1-device rows only
+    else:
+        top = str(counts[-1])
+        out["qsts_speedup_at_max_devices"] = qsts[top]["speedup_vs_1dev"]
+        out["mc_speedup_at_max_devices"] = mc[top]["speedup_vs_1dev"]
+        out["sharded_identical"] = bool(all(identical) and all(mc_identical))
+    return out
+
+
 def bench_quick() -> dict:
     """The cheap subset the CI perf gate runs twice per build
     (``tools/perf_gate.py``): small cases, short compiles, enough reps
@@ -695,19 +823,21 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="freedm_tpu headline benchmarks")
     ap.add_argument(
         "--sections", default="solvers,serve,qsts",
-        help="comma list of sections to run: solvers, serve, qsts, quick "
-             "(default solvers,serve,qsts; quick is the CI perf-gate "
-             "subset)",
+        help="comma list of sections to run: solvers, serve, qsts, quick, "
+             "mesh (default solvers,serve,qsts; quick is the CI perf-gate "
+             "subset; mesh is the device-scaling sweep — force virtual "
+             "CPU devices with "
+             "XLA_FLAGS=--xla_force_host_platform_device_count=N)",
     )
     ap.add_argument("--serve-duration", type=float, default=1.5, metavar="S",
                     help="seconds per serving measurement window")
     args = ap.parse_args(argv)
     sections = {s.strip() for s in args.sections.split(",") if s.strip()}
-    unknown = sections - {"solvers", "serve", "qsts", "quick"}
+    unknown = sections - {"solvers", "serve", "qsts", "quick", "mesh"}
     if unknown or not sections:
         raise SystemExit(
             f"--sections needs a non-empty subset of solvers,serve,qsts,"
-            f"quick; got {args.sections!r}"
+            f"quick,mesh; got {args.sections!r}"
         )
 
     obj: dict = {}
@@ -715,6 +845,8 @@ def main(argv=None) -> None:
         obj["serve"] = bench_serve(duration_s=args.serve_duration)
     if "qsts" in sections:
         obj["qsts"] = bench_qsts()
+    if "mesh" in sections:
+        obj["mesh"] = bench_mesh()
     # quick is a strict subset of the solvers section's extra metrics:
     # when solvers also runs, its full-measurement rows supersede quick
     # (same keys, longer reps), so quick only runs standalone.
@@ -755,6 +887,18 @@ def main(argv=None) -> None:
         obj["value"] = ws["iters_reduction_pct"]
         obj["unit"] = "% vs cold start"
         obj["vs_baseline"] = round(ws["iters_reduction_pct"] / 30.0, 2)
+    elif "metric" not in obj and "mesh" in obj:
+        # mesh-only invocation: the headline is QSTS throughput speedup
+        # at all devices (ISSUE 6 acceptance: >= 1.6x at D devices with
+        # byte-identical results; 1-device hosts report the no-op row).
+        m = obj["mesh"]
+        obj["metric"] = "mesh_qsts_speedup_at_max_devices"
+        obj["value"] = m.get("qsts_speedup_at_max_devices")
+        obj["unit"] = f"x vs 1 device (D={m['devices_available']})"
+        obj["vs_baseline"] = (
+            round(m["qsts_speedup_at_max_devices"] / 1.6, 2)
+            if "qsts_speedup_at_max_devices" in m else None
+        )
     # Registry snapshot: the BENCH trajectory gains solver-iteration /
     # residual / serving columns without new bench code.
     obj["metrics"] = REGISTRY.snapshot()

@@ -121,10 +121,15 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--federate", action="store_true", default=None,
                     help="treat add-host peers as remote processes over the DCN")
     ap.add_argument("--mesh-devices", type=int, default=None, metavar="N",
-                    help="dispatch rounds as one sharded superstep over an "
-                         "N-device mesh (0 = per-module kernels)")
+                    help="shard across an N-device mesh (-1 = all local "
+                         "devices): rounds dispatch as one sharded "
+                         "superstep AND the serve/QSTS batched solver "
+                         "lanes shard over the mesh (0 = single device)")
     ap.add_argument("--mesh-scenarios", type=int, default=None, metavar="B",
                     help="VVC Monte-Carlo scenario lanes on the mesh batch axis")
+    ap.add_argument("--mesh-batch-axis", default=None, metavar="NAME",
+                    help="axis name of the solver lane mesh (default "
+                         "'batch'; PartitionSpec vocabulary for embedders)")
     ap.add_argument("--checkpoint", default=None, metavar="PATH",
                     help="write a round-boundary checkpoint to PATH")
     ap.add_argument("--checkpoint-every", type=int, default=None, metavar="N",
@@ -223,6 +228,7 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("timings_config", "timings_config"), ("topology_config", "topology_config"),
         ("network_config", "network_config"), ("federate", "federate"),
         ("mesh_devices", "mesh_devices"), ("mesh_scenarios", "mesh_scenarios"),
+        ("mesh_batch_axis", "mesh_batch_axis"),
         ("checkpoint", "checkpoint"), ("checkpoint_every", "checkpoint_every"),
         ("resume", "resume"),
         ("metrics_port", "metrics_port"), ("events_log", "events_log"),
@@ -294,11 +300,19 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
     # Config sanity BEFORE any resource is bound: --mesh-devices and
     # --federate are different deployment shapes, and rejecting them
     # after endpoint construction leaked a bound UDP socket (ADVICE r5).
-    if cfg.federate and cfg.mesh_devices > 0:
+    if cfg.federate and cfg.mesh_devices != 0:
         raise ValueError(
             "--mesh-devices and --federate are different deployment "
             "shapes (one sharded process vs DCN slices); pick one"
         )
+    # Resolve -1 = all local devices ONCE (typed error if the host has
+    # fewer than an explicit N); every mesh consumer below sees the
+    # resolved count.
+    mesh_n = 0
+    if cfg.mesh_devices != 0:
+        from freedm_tpu.parallel.mesh import resolve_device_count
+
+        mesh_n = resolve_device_count(cfg.mesh_devices)
 
     layout = (
         compile_layout(parse_device_xml(cfg.device_config))
@@ -426,7 +440,7 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
 
     invariant = omega_invariant() if cfg.check_invariant else None
     mesh_mod = None
-    if cfg.mesh_devices > 0:
+    if mesh_n > 0:
         # Multi-chip dispatch: the whole round is ONE sharded superstep
         # (runtime/meshfleet.py); GM/SC/LB/VVC phases are inside it.
         # (The --federate exclusion was checked up top, before any
@@ -438,7 +452,7 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         mesh_mod = MeshFleetModule(
             fleet,
             vvc_feeder,
-            n_devices=cfg.mesh_devices,
+            n_devices=mesh_n,
             n_scenarios=cfg.mesh_scenarios,
             invariant=invariant,
         )
@@ -509,12 +523,19 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             max_batch=cfg.serve_max_batch,
             max_wait_ms=cfg.serve_max_wait_ms,
             queue_depth=cfg.serve_queue_depth,
+            # --mesh-devices also shards the engines' solver lanes
+            # (docs/scaling.md); 0 keeps every engine single-device.
+            mesh_devices=mesh_n,
+            mesh_batch_axis=cfg.mesh_batch_axis,
         ))
         qsts_jobs = JobManager(
             workers=cfg.qsts_workers,
             max_pending=cfg.qsts_max_jobs,
             checkpoint_dir=cfg.qsts_checkpoint_dir,
             default_chunk_steps=cfg.qsts_chunk_steps,
+            # Submitted studies shard their scenario axis by default;
+            # a request's own mesh_devices field overrides.
+            default_mesh_devices=mesh_n,
         ).start()
         serve_server = ServeServer(
             serve_service, port=cfg.serve_port, jobs=qsts_jobs

@@ -231,14 +231,24 @@ class GlobalConfig:
     clock_skew_us: int = 0
 
     # --- TPU-specific additions (no reference equivalent) ---
-    # Multi-chip dispatch: >0 runs the round loop as ONE sharded
-    # superstep over a mesh of this many devices
-    # (:mod:`freedm_tpu.runtime.meshfleet`); 0 = per-module kernels on
-    # the default device.  Mutually exclusive with ``federate``.
+    # Multi-chip dispatch: the ONE key that flips every batched hot
+    # path from a single chip to the mesh.  N > 1 (or -1 = all local
+    # devices): the broker round loop runs as one sharded superstep
+    # over an N-device mesh (:mod:`freedm_tpu.runtime.meshfleet`), AND
+    # the batched solver lanes behind the serve engines plus the QSTS
+    # scenario axis shard over an N-device lane mesh
+    # (:func:`freedm_tpu.parallel.mesh.solver_mesh`, ``shard_map``;
+    # results stay byte-identical to unsharded — docs/scaling.md).
+    # 0 = per-module kernels on the default device, everything
+    # unsharded.  Mutually exclusive with ``federate``.
     mesh_devices: int = 0
     # VVC Monte-Carlo scenario lanes carried by the mesh superstep
     # (sharded over the mesh's ``batch`` axis).
     mesh_scenarios: int = 8
+    # Axis name of the solver lane mesh (PartitionSpec vocabulary for
+    # embedders composing their own meshes; the default matches the
+    # superstep's batch axis).
+    mesh_batch_axis: str = "batch"
     # Feeder case (freedm_tpu.grid.cases constructor name) the VVC module
     # controls; unset = no VVC phase.  The reference compiles its feeder
     # into vvc_main (load_system_data.cpp); ours is a config knob.

@@ -68,9 +68,14 @@ PROFILE_DEVICE_PEAK = obs.REGISTRY.gauge(
 PROFILE_HOST_SECONDS = obs.REGISTRY.histogram(
     "profile_host_seconds",
     "Host-side hot-path wall time between device work (serve.dispatch "
-    "overhead per batch, qsts.chunk_gap between device chunks)",
+    "overhead per batch, qsts.chunk_gap between device chunks, "
+    "mesh.shard_put/mesh.gather at the mesh host boundary)",
     buckets=(0.0001, 0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 30.0),
     labels=("path",))
+PROFILE_MESH_DEVICES = obs.REGISTRY.gauge(
+    "profile_mesh_devices",
+    "Devices the workload's batch axis is sharded over (1 = unsharded)",
+    labels=("workload",))
 
 
 def _live_device_bytes() -> Optional[int]:
@@ -105,6 +110,8 @@ class ProfilingRegistry:
         self._memory: Dict[str, list] = {}
         # path -> [count, total_s, max_s]
         self._host: Dict[str, list] = {}
+        # workload -> device count its batch axis shards over
+        self._mesh: Dict[str, int] = {}
 
     # -- configuration -------------------------------------------------------
     def configure(self, enabled: Optional[bool] = None) -> "ProfilingRegistry":
@@ -122,6 +129,7 @@ class ProfilingRegistry:
             self._compiles.clear()
             self._memory.clear()
             self._host.clear()
+            self._mesh.clear()
 
     # -- compile account -----------------------------------------------------
     def record_compile(self, workload: str, bucket, seconds: float) -> None:
@@ -166,6 +174,19 @@ class ProfilingRegistry:
         PROFILE_DEVICE_LIVE.labels(w).set(live)
         PROFILE_DEVICE_PEAK.labels(w).set(peak)
         return live
+
+    # -- mesh placement account ----------------------------------------------
+    def record_mesh(self, workload: str, n_devices: int) -> None:
+        """``workload``'s batch axis is sharded over ``n_devices``
+        devices (1 = unsharded).  Exposed as ``profile_mesh_devices``
+        so a scrape can tell WHERE a throughput number came from."""
+        if not self.enabled:
+            return
+        w = str(workload)
+        d = int(n_devices)
+        with self._lock:
+            self._mesh[w] = d
+        PROFILE_MESH_DEVICES.labels(w).set(d)
 
     # -- host-path account ---------------------------------------------------
     def record_host(self, path: str, seconds: float) -> None:
@@ -213,6 +234,7 @@ class ProfilingRegistry:
                 "compiles": compiles,
                 "memory": memory,
                 "host": host,
+                "mesh_devices": dict(sorted(self._mesh.items())),
             }
 
 

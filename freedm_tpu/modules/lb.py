@@ -30,6 +30,18 @@ for Monte-Carlo fleets.  The frequency-invariant gate
 caller-supplied scalar mask so it can come from the plant's Omega
 device or from a power-flow feasibility check
 (:mod:`freedm_tpu.pf`) — the reference's TODO made real.
+
+**Hot-path realization (BENCH ``lb_256node_rounds_per_sec``).**  The
+round used to rank supplies/demands with pairwise [N, N] comparison
+matrices (≈20 [N, N] temporaries per round — the r05 regression's hot
+path).  Groups are a *partition* (``gm.form_groups`` membership is an
+equivalence relation), so ranking within groups is one lexicographic
+``lax.sort`` over ``(group, class, -key)`` and matching is
+rank-vs-count in sorted space — O(N log N) per round instead of O(N²),
+with the [N, N] ``matched`` matrix still emitted for callers that read
+it (XLA dead-code-eliminates it in the convergence loop, which only
+carries the gateway vector).  ``tests/test_gm_sc_lb.py`` pins the sort
+kernel against the pairwise reference on randomized partitions.
 """
 
 from __future__ import annotations
@@ -67,7 +79,10 @@ def classify(net_generation: jax.Array, gateway: jax.Array, step: float) -> jax.
 
 
 def _group_rank(key: jax.Array, member: jax.Array, group_mask: jax.Array) -> jax.Array:
-    """Rank of each member *within its group* by descending key.
+    """Rank of each member *within its group* by descending key —
+    the O(N²) pairwise REFERENCE implementation (kept as the oracle
+    ``tests/test_gm_sc_lb.py`` pins the sort-based round against; the
+    hot path no longer calls it).
 
     ``member``: [N] 0/1 participation mask; ties break by node index.
     Rank 0 = best. Non-members get rank N (never matched).
@@ -83,6 +98,19 @@ def _group_rank(key: jax.Array, member: jax.Array, group_mask: jax.Array) -> jax
     return jnp.where(member > 0, rank, jnp.float32(n)).astype(jnp.int32)
 
 
+def group_ids(group_mask: jax.Array) -> jax.Array:
+    """[N] partition id per node: the smallest member index of its
+    group.  ``group_mask`` is gm's membership matrix — an equivalence
+    relation, so equal ids ⟺ same group.  Constant across a convergence
+    run whose mask doesn't change; :func:`run_rounds` hoists it out of
+    the round loop."""
+    n = group_mask.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    gid = jnp.min(jnp.where(group_mask > 0, idx[None, :], n), axis=1)
+    # A node is always in its own group even if the mask's diagonal is 0.
+    return jnp.minimum(gid, idx)
+
+
 def lb_round(
     net_generation: jax.Array,
     gateway: jax.Array,
@@ -90,6 +118,7 @@ def lb_round(
     migration_step: float,
     malicious: Optional[jax.Array] = None,
     invariant_ok: Optional[jax.Array] = None,
+    gid: Optional[jax.Array] = None,
 ) -> LBRound:
     """One complete LB round for all nodes.
 
@@ -97,50 +126,105 @@ def lb_round(
     ``group_mask``: [N, N] from gm; ``malicious``: [N] 0/1 nodes that
     accept but never actuate (``--malicious-behavior``);
     ``invariant_ok``: [] or [N] 0/1 gate on migrations (frequency /
-    power-flow feasibility; default pass).
+    power-flow feasibility; default pass); ``gid``: precomputed
+    :func:`group_ids` (hoist it when the mask is loop-invariant).
+
+    The draft auction as one sorted matching pass: lexicographic sort
+    by ``(group, class, -key)`` puts each group's gated supplies (by
+    surplus) then gated demands (by age) in rank order; the r-th supply
+    of a group pairs with its r-th demand, so a node migrates iff its
+    in-class rank is below the opposite class's member count.  The
+    reference's ``DraftStandard`` eligibility test (age ≥ step,
+    ``:749-797``) is implied by classification: DEMAND already means
+    ``gateway − net_generation ≥ step`` — the same float comparison —
+    so every demand member is eligible by construction.
     """
     n = gateway.shape[0]
     step = migration_step
     state = classify(net_generation, gateway, step)
-    is_supply = (state == SUPPLY).astype(jnp.float32)
-    is_demand = (state == DEMAND).astype(jnp.float32)
-    malicious = jnp.zeros(n) if malicious is None else malicious.astype(jnp.float32)
-    gate = jnp.ones(()) if invariant_ok is None else jnp.asarray(invariant_ok)
-    gate = jnp.broadcast_to(gate, (n,)).astype(jnp.float32)
-
-    # Draft ages: demand deficit magnitude (SendDraftAge, :688-708).
-    age = jnp.maximum(gateway - net_generation, 0.0) * is_demand
-
-    # Within-group ranks: supplies by surplus, demands by age.
-    surplus = jnp.maximum(net_generation - gateway, 0.0) * is_supply
-    s_rank = _group_rank(surplus, is_supply * gate, group_mask)
-    d_rank = _group_rank(age, is_demand * gate, group_mask)
-
-    # Pair r-th supply with r-th demand of the same group; demand must
-    # still need at least one quantum (age >= step, DraftStandard's
-    # eligibility, :749-797).
-    eligible = (age >= step).astype(jnp.float32)
-    pair = (
-        (s_rank[:, None] == d_rank[None, :]).astype(jnp.float32)
-        * (s_rank[:, None] < n).astype(jnp.float32)
-        * group_mask
-        * is_supply[:, None]
-        * (is_demand * eligible)[None, :]
+    is_supply = state == SUPPLY
+    is_demand = state == DEMAND
+    malicious = (
+        jnp.zeros(n) if malicious is None else malicious.astype(jnp.float32)
     )
+    gate = jnp.ones(()) if invariant_ok is None else jnp.asarray(invariant_ok)
+    gate = jnp.broadcast_to(gate, (n,)) > 0
+    if gid is None:
+        gid = group_ids(group_mask)
 
-    supply_delta = jnp.sum(pair, axis=1) * step  # each supply exports +step
+    # Draft keys: demand age = deficit (SendDraftAge, :688-708), supply
+    # surplus — disjoint classes, so |imbalance| covers both.
+    imbalance = net_generation - gateway
+    mem_s = jnp.logical_and(is_supply, gate)
+    mem_d = jnp.logical_and(is_demand, gate)
+    key = jnp.abs(imbalance).astype(jnp.float32)
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    cls = jnp.where(mem_s, 0, jnp.where(mem_d, 1, 2)).astype(jnp.int32)
+    # Stable sort: equal keys keep index order = the pairwise tie-break.
+    gid_s, cls_s, _, p = jax.lax.sort(
+        (gid, cls, -key, idx), num_keys=3, is_stable=True
+    )
+    seg = jnp.concatenate([
+        jnp.ones(1, bool),
+        jnp.logical_or(gid_s[1:] != gid_s[:-1], cls_s[1:] != cls_s[:-1]),
+    ])
+    start = jax.lax.associative_scan(jnp.maximum, jnp.where(seg, idx, 0))
+    rank_in = idx - start  # rank within the (group, class) segment
+    is_s_s = cls_s == 0
+    is_d_s = cls_s == 1
+    # Per-group member counts, one segment pass (bit-packed while the
+    # counts fit 16 bits; two passes past that).
+    if n < (1 << 15):
+        packed = is_s_s.astype(jnp.int32) + (is_d_s.astype(jnp.int32) << 16)
+        cnt = jax.ops.segment_sum(packed, gid_s, num_segments=n)[gid_s]
+        s_cnt, d_cnt = cnt & 0xFFFF, cnt >> 16
+    else:
+        s_cnt = jax.ops.segment_sum(
+            is_s_s.astype(jnp.int32), gid_s, num_segments=n
+        )[gid_s]
+        d_cnt = jax.ops.segment_sum(
+            is_d_s.astype(jnp.int32), gid_s, num_segments=n
+        )[gid_s]
+    sup_m_s = jnp.logical_and(is_s_s, rank_in < d_cnt)
+    dem_m_s = jnp.logical_and(is_d_s, rank_in < s_cnt)
+
     # Malicious demand accepts but silently drops actuation
     # (LoadBalance.cpp:862-865) -> incomplete migration.
-    demand_applied = jnp.sum(pair, axis=0) * step * (1.0 - malicious)
-    demand_accepted = jnp.sum(pair, axis=0) * step
+    mal_s = malicious[p]
+    f32 = jnp.float32
+    delta_s = jnp.where(sup_m_s, f32(step), f32(0.0)) - jnp.where(
+        dem_m_s, f32(step) * (f32(1.0) - mal_s.astype(f32)), f32(0.0)
+    )
+    gateway_new = gateway + jnp.zeros(n, jnp.float32).at[p].set(
+        delta_s, unique_indices=True
+    )
 
-    gateway_new = gateway + supply_delta - demand_applied
+    # Unsorted-space views (dead-code-eliminated by XLA in convergence
+    # loops that only carry the gateway).
+    rank = jnp.full(n, n, jnp.int32).at[p].set(
+        jnp.where(cls_s < 2, rank_in, n), unique_indices=True
+    )
+    s_rank = jnp.where(mem_s, rank, n)
+    d_rank = jnp.where(mem_d, rank, n)
+    sup_m = jnp.zeros(n, bool).at[p].set(sup_m_s, unique_indices=True)
+    dem_m = jnp.zeros(n, bool).at[p].set(dem_m_s, unique_indices=True)
+    supply_delta = sup_m.astype(jnp.float32) * step
+    demand_accepted = dem_m.astype(jnp.float32) * step
+    demand_applied = demand_accepted * (1.0 - malicious)
     # Ledger: signed gateway delta still in flight — accepted at the
     # demand side but not yet actuated (the reference counts Accept
     # messages crossing the snapshot cut). Chosen so that
     # Σ gateway + Σ intransit is conserved within each group
     # (sc.invariant_total).
     intransit = demand_applied - demand_accepted
+    pair = (
+        (s_rank[:, None] == d_rank[None, :])
+        & (s_rank[:, None] < n)
+        & (gid[:, None] == gid[None, :])
+        & mem_s[:, None]
+        & mem_d[None, :]
+    ).astype(jnp.float32)
 
     return LBRound(
         state=state,
@@ -149,7 +233,7 @@ def lb_round(
         supply_step=supply_delta,
         demand_step=-demand_applied,
         intransit=intransit,
-        n_migrations=jnp.sum(pair).astype(jnp.int32),
+        n_migrations=jnp.sum(sup_m_s).astype(jnp.int32),
     )
 
 
@@ -182,10 +266,17 @@ def run_rounds(
     Returns the final gateway vector and the per-round migration counts —
     the trajectory the 3-node CPU baseline produces over its 3000 ms
     rounds (BASELINE.md config #1), produced here in one device program.
+
+    The group partition is loop-invariant, so :func:`group_ids` is
+    hoisted out of the scan (one [N, N] pass total, not per round).
     """
+    gid = group_ids(group_mask)
 
     def body(gw, _):
-        out = lb_round(net_generation, gw, group_mask, migration_step, malicious)
+        out = lb_round(
+            net_generation, gw, group_mask, migration_step, malicious,
+            gid=gid,
+        )
         return out.gateway, (out.n_migrations, out.state)
 
     gw, (migs, states) = jax.lax.scan(body, gateway0, None, length=n_rounds)

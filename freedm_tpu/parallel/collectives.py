@@ -26,8 +26,12 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exports it at top level; 0.4.x keeps it experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover - version-dependent import path
+    from jax.experimental.shard_map import shard_map
 
 
 def group_totals(mesh: Mesh, group_mask: jax.Array, values: jax.Array) -> jax.Array:
@@ -79,7 +83,7 @@ def alive_argmax(mesh: Mesh, score: jax.Array, alive: jax.Array) -> Tuple[jax.Ar
         best = jax.lax.pmax(local_best, "nodes")
         # Ties across shards resolve to the LOWEST global index (like a
         # replicated argmax): min-combine candidate indices.
-        n_total = block * jax.lax.axis_size("nodes")
+        n_total = block * jax.lax.psum(1, "nodes")
         winner = jax.lax.pmin(
             jnp.where(local_best == best, local_arg, n_total), "nodes"
         )

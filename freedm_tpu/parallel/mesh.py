@@ -14,15 +14,32 @@ global device list, the mesh spans hosts, and XLA routes collectives
 over ICI within a slice and DCN across slices — the transport layer the
 reference hand-built with CProtocolSR over UDP (SURVEY.md §5) exists
 below XLA here.
+
+The host boundary (docs/scaling.md): batches are placed onto the mesh
+with :func:`make_shard_and_gather_fns` — the pjit shard/gather-fns
+pattern of SNIPPETS.md — and batched solver bodies run under
+:func:`shard_batched` (``shard_map``), NOT bare GSPMD annotation:
+solver lanes contain ``lax.while_loop``s and LAPACK/linalg custom
+calls, which GSPMD cannot partition (it replicates the whole batch on
+every device — measured 16x SLOWER than single-device on the CPU
+backend); ``shard_map`` keeps each device's lane block a fully local
+program, which is also what makes sharded results byte-identical to
+unsharded ones.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import time
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exports it at top level; 0.4.x keeps it experimental
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - version-dependent import path
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 
 def make_mesh(
@@ -37,9 +54,6 @@ def make_mesh(
     """
     devs = jax.devices()
     n = len(devs) if n_devices is None else n_devices
-    if len(devs) < n:
-        raise RuntimeError(f"need {n} devices, host has {len(devs)}")
-    devs = devs[:n]
     if shape is None:
         if len(axes) == 1:
             shape = (n,)
@@ -50,9 +64,31 @@ def make_mesh(
             shape = (n // a, a)
         else:
             raise ValueError("give an explicit shape for >2 axes")
+    # Validate an explicit shape= HERE, with the device/axes arithmetic
+    # spelled out — reshape()/Mesh() failures are opaque at best (and a
+    # rank-mismatched shape would otherwise reach Mesh with the wrong
+    # number of axis names).  Pure arithmetic, so it runs BEFORE the
+    # device-availability check: a wrong shape is a wrong shape on any
+    # host.
+    shape = tuple(int(s) for s in shape)
+    if len(shape) != len(axes):
+        raise ValueError(
+            f"mesh shape {shape} has {len(shape)} dim(s) but axes "
+            f"{axes} name {len(axes)}: give one extent per axis"
+        )
+    if any(s < 1 for s in shape):
+        raise ValueError(f"mesh shape {shape}: every extent must be >= 1")
     if int(np.prod(shape)) != n:
-        raise ValueError(f"mesh shape {shape} != {n} devices")
-    return Mesh(np.asarray(devs).reshape(shape), axis_names=axes)
+        prod = " x ".join(str(s) for s in shape)
+        raise ValueError(
+            f"mesh shape {shape} places {prod} = {int(np.prod(shape))} "
+            f"devices but {n} are requested "
+            f"({'all local' if n_devices is None else 'n_devices'}; "
+            f"host has {len(jax.devices())})"
+        )
+    if len(devs) < n:
+        raise RuntimeError(f"need {n} devices, host has {len(devs)}")
+    return Mesh(np.asarray(devs[:n]).reshape(shape), axis_names=axes)
 
 
 def _largest_divisor_at_most(n: int, cap: int) -> int:
@@ -76,3 +112,158 @@ def batch_sharding(mesh: Mesh, rank: int = 1) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# Lane (batch) sharding: the embarrassingly-parallel axis over the mesh.
+# ---------------------------------------------------------------------------
+
+
+def lane_entry(mesh: Mesh, batch_spec=None):
+    """The PartitionSpec ENTRY for a lane axis: ``batch_spec`` verbatim
+    when given (an axis name or tuple of names), else all the mesh's
+    axes (a ("nodes", "batch") mesh flattens onto the lane axis)."""
+    if batch_spec is not None:
+        names = (batch_spec,) if isinstance(batch_spec, str) else tuple(batch_spec)
+        unknown = [a for a in names if a not in mesh.axis_names]
+        if unknown:
+            raise ValueError(
+                f"batch_spec axes {unknown} not in mesh axes "
+                f"{tuple(mesh.axis_names)}"
+            )
+        return batch_spec
+    axes = mesh.axis_names
+    return axes[0] if len(axes) == 1 else tuple(axes)
+
+
+def lane_spec(mesh: Mesh, rank: int, lane_axis: int = 0, batch_spec=None) -> P:
+    """PartitionSpec sharding dimension ``lane_axis`` of a rank-``rank``
+    array over :func:`lane_entry`'s axes, everything else replicated."""
+    entries = [None] * rank
+    entries[lane_axis] = lane_entry(mesh, batch_spec)
+    return P(*entries)
+
+
+def lane_sharding(
+    mesh: Mesh, rank: int, lane_axis: int = 0, batch_spec=None,
+) -> NamedSharding:
+    """NamedSharding for :func:`lane_spec`."""
+    return NamedSharding(mesh, lane_spec(mesh, rank, lane_axis, batch_spec))
+
+
+def mesh_devices(mesh: Mesh) -> int:
+    return int(np.prod(list(mesh.shape.values())))
+
+
+def resolve_device_count(n: int) -> int:
+    """The ``mesh-devices`` config convention: ``-1`` means all local
+    devices, ``0``/``1`` mean unsharded, ``N > 1`` means exactly N (typed
+    error when the host has fewer)."""
+    local = jax.local_device_count()
+    if n < 0:
+        return local
+    if n > local:
+        raise ValueError(
+            f"mesh-devices={n} but this host has {local} local "
+            f"device(s); use -1 for all of them"
+        )
+    return max(int(n), 1)
+
+
+def solver_mesh(n_devices: int, batch_axis: str = "batch") -> Optional[Mesh]:
+    """The one-axis lane mesh the batched solvers / QSTS engine shard
+    over, from a ``mesh-devices`` config value (see
+    :func:`resolve_device_count`); ``None`` when that resolves to 1 —
+    unsharded is the plain single-device program, not a 1-device mesh."""
+    n = resolve_device_count(n_devices)
+    if n <= 1:
+        return None
+    return make_mesh(n, axes=(str(batch_axis),))
+
+
+def lane_shards(mesh: Mesh, batch_spec=None) -> int:
+    """How many ways :func:`lane_entry`'s axes split the lane axis."""
+    entry = lane_entry(mesh, batch_spec)
+    names = (entry,) if isinstance(entry, str) else tuple(entry)
+    return int(np.prod([mesh.shape[a] for a in names]))
+
+
+def validate_lane_count(
+    mesh: Mesh, lanes: int, what: str = "batch", batch_spec=None,
+) -> None:
+    """Typed error when a lane axis cannot split evenly over the mesh
+    (jax shards require even division; the message carries the fix)."""
+    d = lane_shards(mesh, batch_spec)
+    if lanes % d != 0:
+        raise ValueError(
+            f"{what} axis of {lanes} lane(s) does not divide over the "
+            f"{d}-way mesh sharding {dict(mesh.shape)}: use a multiple "
+            f"of {d} lanes or a mesh whose device count divides {lanes}"
+        )
+
+
+def make_shard_and_gather_fns(
+    mesh: Mesh, specs,
+) -> Tuple[Callable, Callable]:
+    """The SNIPPETS.md pjit shard/gather-fns pattern for the host
+    boundary of a batched computation.
+
+    ``specs`` is a pytree of :class:`PartitionSpec` (or ``None`` for
+    replicated) matching the arrays it will place leaf-for-leaf.
+    Returns ``(shard_fn, gather_fn)``:
+
+    - ``shard_fn(tree)`` — ``device_put`` every leaf with its
+      ``NamedSharding`` (host arrays split across the mesh, one shard
+      per device); wall time lands on the profiling registry's
+      ``mesh.shard_put`` host account when profiling is enabled.
+    - ``gather_fn(tree)`` — materialize every leaf back to host numpy
+      (the checkpoint/summary boundary); wall time on ``mesh.gather``.
+    """
+    from freedm_tpu.core import profiling
+
+    def _sharding(spec):
+        return NamedSharding(mesh, spec if spec is not None else P())
+
+    shardings = jax.tree_util.tree_map(
+        _sharding, specs, is_leaf=lambda x: x is None or isinstance(x, P)
+    )
+
+    def shard_fn(tree):
+        profiled = profiling.PROFILER.enabled
+        t0 = time.monotonic() if profiled else 0.0
+        out = jax.device_put(tree, shardings)
+        if profiled:
+            profiling.PROFILER.record_host(
+                "mesh.shard_put", time.monotonic() - t0
+            )
+        return out
+
+    def gather_fn(tree):
+        profiled = profiling.PROFILER.enabled
+        t0 = time.monotonic() if profiled else 0.0
+        out = jax.tree_util.tree_map(np.asarray, tree)
+        if profiled:
+            profiling.PROFILER.record_host(
+                "mesh.gather", time.monotonic() - t0
+            )
+        return out
+
+    return shard_fn, gather_fn
+
+
+def shard_batched(fn, mesh: Mesh, in_specs, out_specs):
+    """``jit(shard_map(fn))`` — run a lane-batched program with each
+    device executing its lane block as a fully LOCAL program.
+
+    This is the mesh execution primitive for the batched solvers: their
+    bodies hold ``lax.while_loop``s and linalg custom calls that GSPMD
+    cannot partition (it falls back to replicating the whole batch per
+    device), while ``shard_map`` splits the lane axis by construction.
+    ``check_rep=False`` because of those while_loops; any cross-lane
+    reduction inside ``fn`` must use explicit collectives
+    (``lax.pmax``/``psum`` over the mesh axes).
+    """
+    return jax.jit(_shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    ))

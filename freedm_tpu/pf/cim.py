@@ -219,7 +219,12 @@ def make_cim_solver(
             def body(carry, _):
                 v, _ = carry
                 v_new = _iterate(v, s_pu, v_base)
-                err = jnp.max((v_new - v).abs())
+                # stop_gradient: the residual is convergence DIAGNOSTICS
+                # only, and |z|'s backward pass is z/|z| = 0/0 = NaN at
+                # the exact zeros dead phases produce — it poisoned
+                # reverse-mode through solve_fixed even under a zero
+                # cotangent.  Forward values are unchanged.
+                err = jax.lax.stop_gradient(jnp.max((v_new - v).abs()))
                 return (v_new, err), None
 
             (v, err), _ = jax.lax.scan(

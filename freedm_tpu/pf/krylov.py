@@ -221,6 +221,8 @@ def make_krylov_solver(
     inner_iters: int = 24,
     dtype: Optional[jnp.dtype] = None,
     precond_dtype: jnp.dtype = jnp.bfloat16,
+    mesh=None,
+    batch_spec=None,
 ):
     """Compile the matrix-free Newton solver with Richardson inner.
 
@@ -231,6 +233,11 @@ def make_krylov_solver(
     ``inner_iters`` is the Krylov dimension of the inner solve — the
     per-Newton-step work is bounded by that many JVPs + preconditioner
     matvecs.
+
+    ``mesh``/``batch_spec``: as in ``make_newton_solver`` — the returns
+    become lane-batched mesh-sharded solvers (leading lane axis on every
+    argument, sharded via ``shard_map``; the bf16 preconditioner pair is
+    replicated to every device, each lane's GMRES stays chip-local).
     """
     rdtype = cplx.default_rdtype(dtype)
     if tol is None:
@@ -357,12 +364,91 @@ def make_krylov_solver(
         x, ps, qs = _prep(p_inj, q_inj, v0, theta0)
         return _solve_fixed_impl(_bp_inv, _bq_inv, x, ps, qs, status)
 
+    if mesh is not None:
+        # Same span/compile-account contract as the unsharded returns
+        # (pf.solve spans + the (krylov, "base") compile entry).
+        return (
+            tracing.traced_solver("krylov", _mesh_batched_krylov(
+                sys, _solve_impl, _bp_inv, _bq_inv, v_free, v_set,
+                p_sched0, q_sched0, rdtype, mesh, batch_spec,
+            )),
+            tracing.traced_solver("krylov", _mesh_batched_krylov(
+                sys, _solve_fixed_impl, _bp_inv, _bq_inv, v_free, v_set,
+                p_sched0, q_sched0, rdtype, mesh, batch_spec,
+            )),
+        )
+
     # Tracing (core.tracing): pf.solve spans, first call tagged as the
     # jit-compile hit; a no-op while tracing is disabled.
     return (
         tracing.traced_solver("krylov", solve),
         tracing.traced_solver("krylov", solve_fixed),
     )
+
+
+def _mesh_batched_krylov(sys, impl, bp_inv, bq_inv, v_free, v_set,
+                         p_sched0, q_sched0, rdtype, mesh, batch_spec):
+    """Lane-batched mesh form: ``shard_map`` over the lane axis with the
+    preconditioner pair passed replicated; each device runs
+    ``vmap(impl)`` on its local lane block (no cross-lane collectives).
+    Optional args are filled with the scheduled/flat defaults so ONE
+    program serves every call pattern."""
+    from jax.sharding import PartitionSpec as P
+
+    from freedm_tpu.core import profiling
+    from freedm_tpu.parallel import mesh as pmesh
+
+    n = sys.n_bus
+    s1 = pmesh.lane_spec(mesh, 1, batch_spec=batch_spec)
+    s2 = pmesh.lane_spec(mesh, 2, batch_spec=batch_spec)
+    out_specs = KrylovResult(
+        v=s2, theta=s2, p=s2, q=s2,
+        iterations=s1, converged=s1, mismatch=s1,
+    )
+    prog = pmesh.shard_batched(
+        lambda bp, bq, x, ps, qs, st: jax.vmap(
+            lambda xi, pi, qi, si: impl(bp, bq, xi, pi, qi, si)
+        )(x, ps, qs, st),
+        mesh,
+        in_specs=(P(), P(), s2, s2, s2, s2),
+        out_specs=out_specs,
+    )
+    profiling.PROFILER.record_mesh(
+        "krylov", pmesh.lane_shards(mesh, batch_spec)
+    )
+    flat_v = jnp.where(v_free > 0, 1.0, v_set).astype(rdtype)
+    status1 = jnp.ones(sys.n_branch, rdtype)
+
+    def solve_batch(p_inj=None, q_inj=None, status=None, v0=None,
+                    theta0=None):
+        args = [p_inj, q_inj, status, v0, theta0]
+        lanes = next(
+            (int(jnp.shape(a)[0]) for a in args if a is not None), None
+        )
+        if lanes is None:
+            raise ValueError(
+                "mesh-batched krylov solver needs at least one "
+                "argument with a leading lane axis"
+            )
+        pmesh.validate_lane_count(
+            mesh, lanes, what="krylov lane", batch_spec=batch_spec
+        )
+
+        def fill(a, f):
+            return (
+                jnp.broadcast_to(f, (lanes,) + f.shape) if a is None
+                else jnp.asarray(a, rdtype)
+            )
+
+        p = fill(p_inj, p_sched0)
+        q = fill(q_inj, q_sched0)
+        st = fill(status, status1)
+        v = fill(v0, flat_v)
+        th = fill(theta0, jnp.zeros(n, rdtype))
+        x = jnp.concatenate([th, v], axis=1)
+        return prog(bp_inv, bq_inv, x, p, q, st)
+
+    return solve_batch
 
 
 def record_result(result: KrylovResult) -> None:

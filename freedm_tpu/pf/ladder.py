@@ -73,6 +73,8 @@ def make_ladder_solver(
     max_iter: int = 20,
     dtype: Optional[jnp.dtype] = None,
     sweep_method: Optional[str] = None,
+    mesh=None,
+    batch_spec=None,
 ):
     """Compile ladder-sweep solvers for a feeder.
 
@@ -92,6 +94,15 @@ def make_ladder_solver(
     ``sweep_method`` selects the tree-sweep realization ("dense",
     "doubling", or ``None`` to auto-select; see
     :mod:`freedm_tpu.pf.sweeps`).
+
+    ``mesh`` (a ``jax.sharding.Mesh``) switches both returns to their
+    LANE-BATCHED mesh-sharded Monte-Carlo form: ``s_load_kva`` then
+    carries a leading scenario axis (length divisible by the mesh's
+    device count — typed error otherwise) sharded across the mesh via
+    ``shard_map``; each device sweeps its lane block as a fully local
+    program, byte-identical to the unsharded ``vmap``.  ``batch_spec``
+    optionally names the mesh axis (or axis tuple) the lane axis shards
+    over; default: all of them.
     """
     rdtype = cplx.default_rdtype(dtype)
 
@@ -135,7 +146,14 @@ def make_ladder_solver(
         return v_new, i_branch, i_load
 
     def _root_err(i_branch: C, i_prev: C):
-        d = (i_branch - i_prev).abs() * root[:, None]
+        # stop_gradient: the residual is convergence DIAGNOSTICS, not
+        # part of the solution path — and |z|'s backward pass is z/|z|,
+        # which is 0/0 = NaN at the exact zeros dead phases produce,
+        # poisoning reverse-mode through solve_fixed (the VVC gradient)
+        # even under a zero cotangent.  Forward values are unchanged.
+        d = jax.lax.stop_gradient(
+            (i_branch - i_prev).abs() * root[:, None]
+        )
         return jnp.max(d).astype(rdtype)
 
     def _v0(v_source_pu):
@@ -218,6 +236,16 @@ def make_ladder_solver(
     def solve_fixed(s_load_kva, v_source_pu=None) -> LadderResult:
         return _solve_fixed(cplx.as_c(s_load_kva, dtype=rdtype), v_source_pu)
 
+    if mesh is not None:
+        # Same span/compile-account contract as the unsharded returns
+        # (pf.solve spans + the (ladder, "base") compile entry).
+        return (
+            tracing.traced_solver("ladder", _mesh_batched_ladder(
+                _solve, rdtype, mesh, batch_spec)),
+            tracing.traced_solver("ladder", _mesh_batched_ladder(
+                _solve_fixed, rdtype, mesh, batch_spec)),
+        )
+
     # Tracing/profiling (core.tracing, core.profiling): pf.solve spans
     # with the first call tagged as the jit-compile hit, and the compile
     # wall time on the profiling registry; both a no-op while disabled.
@@ -227,6 +255,50 @@ def make_ladder_solver(
         tracing.traced_solver("ladder", solve),
         tracing.traced_solver("ladder", solve_fixed),
     )
+
+
+def _mesh_batched_ladder(impl, rdtype, mesh, batch_spec):
+    """Lane-batched mesh form: ``shard_map`` over the scenario axis,
+    each device running ``vmap(impl)`` on its local lane block (lanes
+    never communicate — GSPMD would replicate the while_loop body per
+    device instead, see ``parallel/mesh.py``).  The source voltage is
+    replicated: one scalar knob for the whole Monte-Carlo population,
+    like the unbatched API."""
+    from jax.sharding import PartitionSpec as P
+
+    from freedm_tpu.core import profiling
+    from freedm_tpu.parallel import mesh as pmesh
+
+    s1 = pmesh.lane_spec(mesh, 1, batch_spec=batch_spec)
+    s3 = pmesh.lane_spec(mesh, 3, batch_spec=batch_spec)
+    c3 = C(s3, s3)
+    out_specs = LadderResult(
+        v_node=c3, i_branch=c3, i_load=c3,
+        iterations=s1, converged=s1, residual=s1,
+    )
+    prog = pmesh.shard_batched(
+        lambda s: jax.vmap(impl)(s), mesh,
+        in_specs=(c3,), out_specs=out_specs,
+    )
+    prog_vs = pmesh.shard_batched(
+        lambda s, vs: jax.vmap(lambda si: impl(si, vs))(s), mesh,
+        in_specs=(c3, P()), out_specs=out_specs,
+    )
+    profiling.PROFILER.record_mesh(
+        "ladder", pmesh.lane_shards(mesh, batch_spec)
+    )
+
+    def solve_batch(s_load_kva, v_source_pu=None) -> LadderResult:
+        s = cplx.as_c(s_load_kva, dtype=rdtype)
+        pmesh.validate_lane_count(
+            mesh, int(s.re.shape[0]), what="ladder lane",
+            batch_spec=batch_spec,
+        )
+        if v_source_pu is None:
+            return prog(s)
+        return prog_vs(s, jnp.asarray(v_source_pu, rdtype))
+
+    return solve_batch
 
 
 # ---------------------------------------------------------------------------

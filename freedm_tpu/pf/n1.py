@@ -88,14 +88,24 @@ def make_n1_screen(
     tol: Optional[float] = None,
     max_iter: int = 40,
     dtype: Optional[jnp.dtype] = None,
+    mesh=None,
+    batch_spec=None,
 ):
     """Compile the SMW fast-decoupled N-1 screen.
 
     Returns ``screen(outages)``: ``outages`` is an ``[k]`` int array of
     branch indices (each lane removes exactly that branch); the result
     is a lane-batched :class:`~freedm_tpu.pf.newton.NewtonResult`.
-    Jitted; the lane axis is a ``vmap``, so sharding the lane axis over
-    a mesh is one ``pjit`` annotation away.
+    Jitted; the lane axis is a ``vmap``.
+
+    ``mesh`` (a ``jax.sharding.Mesh``) shards the outage-lane axis over
+    the mesh via ``shard_map`` (each device screens its lane block as a
+    fully local program; the precomputed Z/LU factors replicate to every
+    device).  Outage counts are arbitrary, so a lane count that does not
+    divide the mesh is PADDED with replicas of the last outage and the
+    pad lanes sliced off the result — every lane is independent, so the
+    visible rows are unaffected.  ``batch_spec`` optionally names the
+    mesh axis (or axis tuple) the lane axis shards over.
     """
     rdtype = cplx.default_rdtype(dtype)
     if tol is None:
@@ -208,6 +218,40 @@ def make_n1_screen(
             converged=err < tol,
             mismatch=err,
         )
+
+    if mesh is not None:
+        from freedm_tpu.core import profiling
+        from freedm_tpu.parallel import mesh as pmesh
+
+        s1 = pmesh.lane_spec(mesh, 1, batch_spec=batch_spec)
+        s2 = pmesh.lane_spec(mesh, 2, batch_spec=batch_spec)
+        out_specs = NewtonResult(
+            v=s2, theta=s2, p=s2, q=s2,
+            iterations=s1, converged=s1, mismatch=s1,
+        )
+
+        def _local(ks):
+            with jax.default_matmul_precision("highest"):
+                return jax.vmap(_solve_lane)(ks)
+
+        prog = pmesh.shard_batched(
+            _local, mesh, in_specs=(s1,), out_specs=out_specs
+        )
+        d = pmesh.lane_shards(mesh, batch_spec)
+        profiling.PROFILER.record_mesh("n1", d)
+
+        def screen_mesh(outages):
+            ks = jnp.asarray(outages)
+            k = int(ks.shape[0])
+            pad = (-k) % d
+            if pad:
+                ks = jnp.concatenate([ks, jnp.broadcast_to(ks[-1:], (pad,))])
+            r = prog(ks)
+            if pad:
+                r = jax.tree_util.tree_map(lambda x: x[:k], r)
+            return r
+
+        return screen_mesh
 
     @jax.jit
     def screen(outages):

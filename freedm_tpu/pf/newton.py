@@ -66,6 +66,76 @@ class NewtonResult(NamedTuple):
     mismatch: jax.Array  # [] float: max |free-equation residual|
 
 
+class _LaneFills(NamedTuple):
+    """Per-lane default values a mesh-batched solver broadcasts over the
+    lane axis when the caller omits an argument (one compiled program
+    regardless of which optional args are given)."""
+
+    p: jax.Array
+    q: jax.Array
+    status: jax.Array
+    v0: jax.Array
+    theta0: jax.Array
+
+
+def _newton_result_specs(mesh, batch_spec):
+    """Out-specs pytree for a lane-batched :class:`NewtonResult`."""
+    from freedm_tpu.parallel.mesh import lane_spec
+
+    s1 = lane_spec(mesh, 1, batch_spec=batch_spec)
+    s2 = lane_spec(mesh, 2, batch_spec=batch_spec)
+    return NewtonResult(
+        v=s2, theta=s2, p=s2, q=s2,
+        iterations=s1, converged=s1, mismatch=s1,
+    )
+
+
+def _mesh_batched(solve_one, mesh, batch_spec, fills: _LaneFills,
+                  out_specs, name: str):
+    """Lane-batched mesh form of a per-lane solver: ``shard_map`` over
+    the lane axis, each device running ``vmap(solve_one)`` on its local
+    block (no cross-lane collectives — GSPMD would instead replicate
+    the while_loop/linalg bodies, see ``parallel/mesh.py``)."""
+    from freedm_tpu.core import profiling
+    from freedm_tpu.parallel import mesh as pmesh
+
+    s2 = pmesh.lane_spec(mesh, 2, batch_spec=batch_spec)
+    prog = pmesh.shard_batched(
+        lambda p, q, st, v0, th0: jax.vmap(
+            lambda pi, qi, si, vi, ti: solve_one(
+                p_inj=pi, q_inj=qi, status=si, v0=vi, theta0=ti
+            )
+        )(p, q, st, v0, th0),
+        mesh,
+        in_specs=(s2, s2, s2, s2, s2),
+        out_specs=out_specs,
+    )
+    profiling.PROFILER.record_mesh(name, pmesh.lane_shards(mesh, batch_spec))
+
+    def solve_batch(p_inj=None, q_inj=None, status=None, v0=None,
+                    theta0=None):
+        args = [p_inj, q_inj, status, v0, theta0]
+        lanes = next(
+            (int(jnp.shape(a)[0]) for a in args if a is not None), None
+        )
+        if lanes is None:
+            raise ValueError(
+                f"mesh-batched {name} solver needs at least one "
+                f"argument with a leading lane axis"
+            )
+        pmesh.validate_lane_count(
+            mesh, lanes, what=f"{name} lane", batch_spec=batch_spec
+        )
+        filled = [
+            jnp.broadcast_to(f, (lanes,) + f.shape) if a is None
+            else jnp.asarray(a)
+            for a, f in zip(args, fills)
+        ]
+        return prog(*filled)
+
+    return solve_batch
+
+
 def s_calc(y: C, theta, v):
     """Realized (P, Q) bus injections at a voltage profile — the one
     power-calculation both the Newton and fast-decoupled solvers share
@@ -95,6 +165,8 @@ def make_newton_solver(
     tol: Optional[float] = None,
     max_iter: int = 10,
     dtype: Optional[jnp.dtype] = None,
+    mesh=None,
+    batch_spec=None,
 ):
     """Compile NR solvers for a bus system.
 
@@ -115,6 +187,15 @@ def make_newton_solver(
     ``tol=None`` picks a dtype-appropriate default: 1e-8 in float64,
     3e-5 in float32 (the TPU default, where 1e-8 is below the mismatch
     noise floor and would never report convergence).
+
+    ``mesh`` (a ``jax.sharding.Mesh``) switches both returns to their
+    LANE-BATCHED mesh-sharded form: every argument then carries a
+    leading scenario/lane axis (length divisible by the mesh's device
+    count — typed error otherwise) that is sharded across the mesh via
+    ``shard_map``, each device solving its lane block as a fully local
+    program (lanes never communicate), byte-identical to the unsharded
+    ``vmap``.  ``batch_spec`` optionally names the mesh axis (or axis
+    tuple) the lane axis shards over; default: all of them.
     """
     rdtype = cplx.default_rdtype(dtype)
     if tol is None:
@@ -226,6 +307,24 @@ def make_newton_solver(
             x, _ = jax.lax.scan(body, x, None, length=max_iter)
             err = jnp.max(jnp.abs(_residual(x, y, ps, qs) * free))
             return _finish(x, y, ps, qs, max_iter, err)
+
+    if mesh is not None:
+        flat_v = jnp.where(v_free > 0, 1.0, v_set).astype(rdtype)
+        fills = _LaneFills(
+            p=p_sched0, q=q_sched0,
+            status=jnp.ones(sys.n_branch, rdtype),
+            v0=flat_v, theta0=jnp.zeros(n, rdtype),
+        )
+        out_specs = _newton_result_specs(mesh, batch_spec)
+        # Same span/compile-account contract as the unsharded returns:
+        # pf.solve spans + the (newton, "base") compile entry stay
+        # attributable when --mesh-devices is on.
+        return (
+            tracing.traced_solver("newton", _mesh_batched(
+                solve, mesh, batch_spec, fills, out_specs, "newton")),
+            tracing.traced_solver("newton", _mesh_batched(
+                solve_fixed, mesh, batch_spec, fills, out_specs, "newton")),
+        )
 
     # Tracing (core.tracing, --trace-log): each call records a
     # ``pf.solve`` span, the first one tagged with its jit-compile hit.

@@ -62,9 +62,22 @@ CKPT_VERSION = 1
 #: Summary keys that legitimately differ between two runs of the same
 #: study (wall-clock and bookkeeping) — the resume-exactness contract
 #: is "summaries equal modulo these"; bench/soak/tests import this so
-#: the strip list cannot drift per consumer.
+#: the strip list cannot drift per consumer.  ``mesh_devices`` is
+#: bookkeeping too: the sharded-equals-unsharded contract says WHERE a
+#: study ran must not change WHAT it computed.
 SUMMARY_TIMING_KEYS = ("wall_s", "scenario_steps_per_sec", "compiles",
-                       "resumed_from_chunk", "chunks_done")
+                       "resumed_from_chunk", "chunks_done", "mesh_devices")
+
+#: StudySpec keys that describe EXECUTION PLACEMENT, not the study —
+#: checkpoint spec matching ignores them, which is what lets a killed
+#: 4-device study resume on 1 device (or vice versa) bit-for-bit.
+MESH_SPEC_KEYS = ("mesh_devices",)
+
+
+def placement_free_spec(d: dict) -> dict:
+    """The checkpoint-compatibility view of a spec dict: placement keys
+    (:data:`MESH_SPEC_KEYS`) out, so resume works across device counts."""
+    return {k: v for k, v in d.items() if k not in MESH_SPEC_KEYS}
 
 
 def strip_timing(summary: dict) -> dict:
@@ -99,6 +112,13 @@ class StudySpec:
     chunk_steps: int = 24
     warm_start: bool = True
     max_iter: int = 12
+    # Execution placement (NOT part of the study's identity — see
+    # MESH_SPEC_KEYS): shard the scenario axis over this many devices
+    # via shard_map (0 = unsharded single device, -1 = all local
+    # devices, N > 0 = exactly N).  ``scenarios`` must divide by the
+    # resolved device count.  The lax.scan time axis stays local; only
+    # the vmap-over-scenarios axis shards.
+    mesh_devices: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -145,6 +165,14 @@ class FeederState(NamedTuple):
     peak_kva: np.ndarray  # []
 
 
+def _lane_axes(mesh):
+    """The mesh axis name(s) the scenario axis shards over — what the
+    chunk-exit collectives reduce across."""
+    from freedm_tpu.parallel.mesh import lane_entry
+
+    return lane_entry(mesh)
+
+
 def _resolve_case(name: str):
     """(kind, case object) via the serving registry's vocabulary — QSTS
     and the synchronous queries must agree on what a case name means."""
@@ -180,11 +208,59 @@ class QstsEngine:
         # materialize + numpy roundtrip) — the profiling registry's
         # qsts.chunk_gap account.
         self._last_chunk_end: Optional[float] = None
+        # Scenario-axis sharding (spec.mesh_devices): the vmap-over-
+        # scenarios axis splits over a one-axis lane mesh under
+        # shard_map; the scan time axis stays device-local.  State
+        # round-trips through host numpy at chunk boundaries either
+        # way, so checkpoints stay placement-free.
+        self._mesh = None
+        self.mesh_devices = 1
+        if spec.mesh_devices not in (0, 1):
+            from freedm_tpu.parallel import mesh as pmesh
+
+            self._mesh = pmesh.solver_mesh(spec.mesh_devices)
+            if self._mesh is not None:
+                self.mesh_devices = pmesh.mesh_devices(self._mesh)
+                pmesh.validate_lane_count(
+                    self._mesh, spec.scenarios, what="qsts scenario"
+                )
+                profiling.PROFILER.record_mesh("qsts", self.mesh_devices)
+        self._shard_in = None  # built lazily with the first chunk shapes
+        self._gather = None
         if self.kind == "bus":
             self._init_bus()
         else:
             self._init_feeder()
         self.profiles = ProfileSet(spec.profile_spec(), self._n_profile)
+
+    def _shard_chunk(self, fn, state_ranks, arr_rank: int, n_arrays: int):
+        """``shard_map`` a chunk body over the scenario axis.
+
+        ``state_ranks`` is the state NamedTuple with each field's array
+        rank (0 = replicated scalar carry, >0 = lane-sharded on axis 0);
+        injection arrays are rank ``arr_rank`` with the lane axis at 1
+        (axis 0 is time).  Also builds the engine's host-boundary
+        shard/gather fns (profiled as ``mesh.shard_put``/``mesh.gather``)
+        the first time through.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from freedm_tpu.parallel import mesh as pmesh
+
+        mesh = self._mesh
+        state_specs = type(state_ranks)(*(
+            pmesh.lane_spec(mesh, r) if r else P() for r in state_ranks
+        ))
+        arr_spec = pmesh.lane_spec(mesh, arr_rank, lane_axis=1)
+        if self._shard_in is None:
+            self._shard_in, self._gather = pmesh.make_shard_and_gather_fns(
+                mesh, (state_specs, (arr_spec,) * n_arrays)
+            )
+        return pmesh.shard_batched(
+            fn, mesh,
+            in_specs=(state_specs,) + (arr_spec,) * n_arrays,
+            out_specs=state_specs,
+        )
 
     # -- bus (Newton) path ---------------------------------------------------
     def _init_bus(self):
@@ -226,10 +302,10 @@ class QstsEngine:
         f_idx = jnp.asarray(sys_.from_bus)
         t_idx = jnp.asarray(sys_.to_bus)
         yff, yft, ytf, ytt = branch_admittances(sys_, dtype=rdtype)
-        flat_v = jnp.asarray(
-            np.broadcast_to(self._v_flat, (spec.scenarios, sys_.n_bus))
-        )
-        flat_th = jnp.zeros_like(flat_v)
+        # Lane-independent flat-start ROW, broadcast to the step's local
+        # block shape: under shard_map a device sees S/D lanes, so a
+        # closed-over [S, n] constant would be the wrong shape there.
+        flat_row = jnp.asarray(self._v_flat)
 
         def flow_peak(v, theta):
             vc = cplx.polar(v, theta)
@@ -249,8 +325,11 @@ class QstsEngine:
             outside = (vm < lo) | (vm > hi)
             iters = r.iterations.astype(jnp.int32)
             peak = jax.vmap(flow_peak)(r.v, r.theta)
-            nxt_v = r.v if spec.warm_start else flat_v
-            nxt_th = r.theta if spec.warm_start else flat_th
+            nxt_v = (
+                r.v if spec.warm_start
+                else jnp.broadcast_to(flat_row[None, :], r.v.shape)
+            )
+            nxt_th = r.theta if spec.warm_start else jnp.zeros_like(r.theta)
             return BusState(
                 v=nxt_v,
                 theta=nxt_th,
@@ -271,7 +350,34 @@ class QstsEngine:
             out, _ = jax.lax.scan(step, state, (p, q))
             return out
 
-        return jax.jit(chunk)
+        if self._mesh is None:
+            return jax.jit(chunk)
+
+        # Sharded form: the SAME chunk body under shard_map, each device
+        # scanning its local lane block.  Per-scenario accumulators are
+        # purely lane-local; the scalar reductions combine across
+        # devices at chunk exit — max/min are exact and idempotent, so
+        # the carried global value rides through the local scan, while
+        # the int sum restarts from zero and psums its delta.  Result:
+        # byte-identical to the unsharded chunk.
+        ax = _lane_axes(self._mesh)
+
+        def chunk_sharded(state: BusState, p, q):
+            out = chunk(
+                state._replace(nonconv=jnp.zeros_like(state.nonconv)), p, q
+            )
+            return out._replace(
+                nonconv=state.nonconv + jax.lax.psum(out.nonconv, ax),
+                it_max=jax.lax.pmax(out.it_max, ax),
+                v_lo=jax.lax.pmin(out.v_lo, ax),
+                v_hi=jax.lax.pmax(out.v_hi, ax),
+                peak_pu=jax.lax.pmax(out.peak_pu, ax),
+            )
+
+        return self._shard_chunk(chunk_sharded, BusState(
+            v=2, theta=2, viol_min=1, loss_puh=1, it_sum=1,
+            it_max=0, nonconv=0, v_lo=0, v_hi=0, peak_pu=0,
+        ), arr_rank=3, n_arrays=2)
 
     def _bus_injections(self, t0: int, t1: int):
         """[Tc, S, n] scheduled injections for timesteps [t0, t1):
@@ -350,7 +456,30 @@ class QstsEngine:
             out, _ = jax.lax.scan(step, state, (s_re, s_im))
             return out
 
-        return jax.jit(chunk)
+        if self._mesh is None:
+            return jax.jit(chunk)
+
+        # Same sharding discipline as the bus chunk (see there): local
+        # scan per device, exact scalar combines at chunk exit.
+        ax = _lane_axes(self._mesh)
+
+        def chunk_sharded(state: FeederState, s_re, s_im):
+            out = chunk(
+                state._replace(nonconv=jnp.zeros_like(state.nonconv)),
+                s_re, s_im,
+            )
+            return out._replace(
+                nonconv=state.nonconv + jax.lax.psum(out.nonconv, ax),
+                it_max=jax.lax.pmax(out.it_max, ax),
+                v_lo=jax.lax.pmin(out.v_lo, ax),
+                v_hi=jax.lax.pmax(out.v_hi, ax),
+                peak_kva=jax.lax.pmax(out.peak_kva, ax),
+            )
+
+        return self._shard_chunk(chunk_sharded, FeederState(
+            viol_min=1, loss_kwh=1, it_sum=1,
+            it_max=0, nonconv=0, v_lo=0, v_hi=0, peak_kva=0,
+        ), arr_rank=4, n_arrays=2)
 
     def _feeder_injections(self, t0: int, t1: int):
         """[Tc, S, nb, 3] net loads: base loads under the multiplier,
@@ -421,11 +550,16 @@ class QstsEngine:
                     else self._build_feeder_chunk(tc)
                 )
                 self.compiles += 1
+            if self._shard_in is not None:
+                # Explicit host->mesh placement (one shard per device,
+                # profiled as mesh.shard_put) — the shard half of the
+                # shard/gather-fns host boundary.
+                state, arrays = self._shard_in((state, tuple(arrays)))
             t_solve = time.monotonic()
             with tracing.TRACER.start(
                 f"pf.solve:{self.solver_name}", kind="solve",
                 tags={"solver": self.solver_name, "jit_compile": new_shape,
-                      "steps": tc},
+                      "steps": tc, "mesh_devices": self.mesh_devices},
             ):
                 out = self._fns[tc](state, *arrays)
                 out = jax.block_until_ready(out)
@@ -439,7 +573,11 @@ class QstsEngine:
                     time.monotonic() - t_solve,
                 )
             profiling.PROFILER.sample_memory("qsts")
-        out = type(state)(*(np.asarray(x) for x in out))
+        if self._gather is not None:
+            # Gather shards back to host numpy (profiled as mesh.gather)
+            # — the boundary that keeps chunk checkpoints placement-free.
+            out = self._gather(out)
+        out = type(out)(*(np.asarray(x) for x in out))
         self._last_chunk_end = time.monotonic()
         return out
 
@@ -480,6 +618,7 @@ class QstsEngine:
             "iters_max": int(state.it_max),
             "lane_steps_not_converged": int(state.nonconv),
             "compiles": self.compiles,
+            "mesh_devices": self.mesh_devices,
             "wall_s": round(float(wall_s), 3),
         }
         if self.kind == "bus":
@@ -549,9 +688,15 @@ def run_study(
         from freedm_tpu.runtime import checkpoint as ckpt
 
         saved = ckpt.load(checkpoint_path)
+        # Placement keys are stripped from BOTH sides: a study killed on
+        # a 4-device mesh resumes on 1 device (or any other count the
+        # scenario axis divides by) — the chunk state was gathered to
+        # host numpy, so it carries no placement.
         if (
             saved.get("version") == CKPT_VERSION
-            and saved.get("spec") == spec.to_dict()
+            and isinstance(saved.get("spec"), dict)
+            and placement_free_spec(saved["spec"])
+            == placement_free_spec(spec.to_dict())
         ):
             state = engine.state_from_jsonable(saved["state"])
             start_chunk = int(saved["chunk_index"])

@@ -58,13 +58,18 @@ _JOB_KEY_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
 
 _FIELDS = {
     "case", "scenarios", "steps", "dt_minutes", "seed", "profile",
-    "chunk_steps", "warm_start", "max_iter", "job_key",
+    "chunk_steps", "warm_start", "max_iter", "job_key", "mesh_devices",
 }
 
 
-def parse_job_request(payload: dict, default_chunk_steps: int = 24):
+def parse_job_request(payload: dict, default_chunk_steps: int = 24,
+                      default_mesh_devices: int = 0):
     """``(StudySpec, job_key)`` from a JSON payload, every field range-
-    checked with typed errors (mirrors ``serve.service.parse_request``)."""
+    checked with typed errors (mirrors ``serve.service.parse_request``).
+
+    ``mesh_devices`` (request field, default from the server config)
+    shards the scenario axis over that many local devices (-1 = all);
+    the scenario count must divide by the resolved device count."""
     if not isinstance(payload, dict):
         raise InvalidRequest("request body must be a JSON object")
     unknown = set(payload) - _FIELDS
@@ -102,6 +107,19 @@ def parse_job_request(payload: dict, default_chunk_steps: int = 24):
     warm = payload.get("warm_start", True)
     if not isinstance(warm, bool):
         raise InvalidRequest("'warm_start' must be a boolean")
+    mesh_devices = _int("mesh_devices", int(default_mesh_devices), -1, 4096)
+    if mesh_devices not in (0, 1):
+        from freedm_tpu.parallel.mesh import resolve_device_count
+
+        try:
+            d = resolve_device_count(mesh_devices)
+        except ValueError as e:
+            raise InvalidRequest(str(e)) from None
+        if d > 1 and scenarios % d != 0:
+            raise InvalidRequest(
+                f"'scenarios' ({scenarios}) must divide over "
+                f"mesh_devices={d} (use a multiple of {d})"
+            )
     job_key = payload.get("job_key")
     if job_key is not None and (
         not isinstance(job_key, str) or not _JOB_KEY_RE.match(job_key)
@@ -113,7 +131,7 @@ def parse_job_request(payload: dict, default_chunk_steps: int = 24):
     spec = StudySpec(
         case=case, scenarios=scenarios, steps=steps, dt_minutes=float(dt),
         seed=seed, profile=profile, chunk_steps=chunk_steps,
-        warm_start=warm, max_iter=max_iter,
+        warm_start=warm, max_iter=max_iter, mesh_devices=mesh_devices,
     )
     # Resolve the case NOW (typed error, and the lane-cell bound needs
     # its size); the engine built later resolves it again cheaply.
@@ -182,11 +200,13 @@ class JobManager:
 
     def __init__(self, workers: int = 1, max_pending: int = 16,
                  checkpoint_dir: Optional[str] = None,
-                 default_chunk_steps: int = 24):
+                 default_chunk_steps: int = 24,
+                 default_mesh_devices: int = 0):
         self.workers = max(int(workers), 1)
         self.max_pending = max(int(max_pending), 1)
         self.checkpoint_dir = checkpoint_dir
         self.default_chunk_steps = int(default_chunk_steps)
+        self.default_mesh_devices = int(default_mesh_devices)
         self._cond = threading.Condition()
         self._pending: deque = deque()
         self._jobs: "OrderedDict[str, JobRecord]" = OrderedDict()
@@ -223,7 +243,10 @@ class JobManager:
 
     # -- submission / polling ------------------------------------------------
     def submit(self, payload: dict) -> dict:
-        spec, job_key = parse_job_request(payload, self.default_chunk_steps)
+        spec, job_key = parse_job_request(
+            payload, self.default_chunk_steps,
+            default_mesh_devices=self.default_mesh_devices,
+        )
         rec = JobRecord(id=os.urandom(8).hex(), spec=spec, job_key=job_key)
         rec.chunks_total = math.ceil(spec.steps / spec.chunk_steps)
         with self._cond:

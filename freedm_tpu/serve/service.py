@@ -288,10 +288,20 @@ class _Engine:
         raise NotImplementedError
 
 
+def _mesh_lanes(mesh) -> int:
+    """Lane-shard count of an engine's mesh (0 = no mesh): the batch
+    sizes the mesh program accepts are this count's multiples."""
+    if mesh is None:
+        return 0
+    from freedm_tpu.parallel.mesh import lane_shards
+
+    return lane_shards(mesh)
+
+
 class PowerFlowEngine(_Engine):
     workload = "pf"
 
-    def __init__(self, case: str, max_iter: int = 12):
+    def __init__(self, case: str, max_iter: int = 12, mesh=None):
         super().__init__(case)
         import jax
 
@@ -320,6 +330,26 @@ class PowerFlowEngine(_Engine):
                 p_inj=p, q_inj=q, v0=v0, theta0=th0
             ))
         )
+        # Mesh form of the same while-loop solve: used for buckets the
+        # device count divides; other buckets take the vmap program.
+        self._mesh_lanes = _mesh_lanes(mesh)
+        if self._mesh_lanes:
+            self._batched_mesh, _ = make_newton_solver(
+                sys_, max_iter=max_iter, mesh=mesh
+            )
+
+    def solve(self, batch):
+        import jax
+
+        p = batch[0]
+        if self._mesh_lanes and p.shape[0] % self._mesh_lanes == 0:
+            r = self._batched_mesh(
+                p_inj=p, q_inj=batch[1], v0=batch[2], theta0=batch[3]
+            )
+        else:
+            r = self._batched(*batch)
+        jax.block_until_ready(r.v)
+        return r
 
     def validate(self, req: PowerFlowRequest):
         if not (math.isfinite(req.scale) and 0.0 < req.scale <= 10.0):
@@ -358,13 +388,6 @@ class PowerFlowEngine(_Engine):
         v0 = _pad_rows(np.stack([t.prepared["v0"] for t in group]), bucket)
         th0 = _pad_rows(np.stack([t.prepared["th0"] for t in group]), bucket)
         return p, q, v0, th0
-
-    def solve(self, batch):
-        import jax
-
-        r = self._batched(*batch)
-        jax.block_until_ready(r.v)
-        return r
 
     def scatter(self, group: List[Ticket], r, info: BatchInfo) -> None:
         v = np.asarray(r.v)
@@ -408,7 +431,7 @@ class N1Engine(_Engine):
     #: Validation cap on outages per request (also the largest bucket).
     MAX_OUTAGES = 256
 
-    def __init__(self, case: str, max_iter: int = 24):
+    def __init__(self, case: str, max_iter: int = 24, mesh=None):
         super().__init__(case)
         from freedm_tpu.pf.n1 import make_n1_screen, secure_outages
 
@@ -416,7 +439,9 @@ class N1Engine(_Engine):
         self.n_branch = sys_.n_branch
         self._secure = sorted(secure_outages(sys_))
         self._secure_set = frozenset(self._secure)
-        self._screen = make_n1_screen(sys_, max_iter=max_iter)
+        # The mesh screen pads ragged lane counts internally, so it
+        # serves every bucket; no fallback program needed.
+        self._screen = make_n1_screen(sys_, max_iter=max_iter, mesh=mesh)
 
     def validate(self, req: N1Request):
         ks = list(req.outages)
@@ -489,7 +514,7 @@ class N1Engine(_Engine):
 class VVCEngine(_Engine):
     workload = "vvc"
 
-    def __init__(self, case: str, pf_iters: int = 20):
+    def __init__(self, case: str, pf_iters: int = 20, mesh=None):
         super().__init__(case)
         import jax
         import jax.numpy as jnp
@@ -518,6 +543,16 @@ class VVCEngine(_Engine):
             return loss, res.v_node.abs(), res.converged, res.residual
 
         self._batched = jax.jit(jax.vmap(one))
+        self._mesh_lanes = _mesh_lanes(mesh)
+        if self._mesh_lanes:
+            from freedm_tpu.parallel import mesh as pmesh
+
+            s1 = pmesh.lane_spec(mesh, 1)
+            s3 = pmesh.lane_spec(mesh, 3)
+            self._batched_mesh = pmesh.shard_batched(
+                lambda qb: jax.vmap(one)(qb), mesh,
+                in_specs=(s3,), out_specs=(s1, s3, s1, s1),
+            )
         base = solve_fixed(s)
         self.loss_base_kw = float(ladder.total_loss_kw(feeder, base))
 
@@ -544,7 +579,10 @@ class VVCEngine(_Engine):
     def solve(self, batch):
         import jax
 
-        out = self._batched(batch)
+        if self._mesh_lanes and batch.shape[0] % self._mesh_lanes == 0:
+            out = self._batched_mesh(jax.numpy.asarray(batch))
+        else:
+            out = self._batched(batch)
         jax.block_until_ready(out[0])
         return out
 
@@ -650,6 +688,13 @@ class ServeConfig(NamedTuple):
     n1_max_iter: int = 24
     vvc_pf_iters: int = 20
     buckets: Optional[Tuple[int, ...]] = None
+    # Solver-lane mesh (CLI: --mesh-devices / --mesh-batch-axis): shard
+    # each engine's batched lane axis over this many local devices via
+    # shard_map (-1 = all, 0 = unsharded).  Buckets that do not divide
+    # the device count dispatch on the single-device program instead —
+    # responses are byte-identical either way (docs/scaling.md).
+    mesh_devices: int = 0
+    mesh_batch_axis: str = "batch"
 
     def bucket_table(self) -> Tuple[int, ...]:
         bs = self.buckets if self.buckets else default_buckets(self.max_batch)
@@ -677,6 +722,15 @@ class Service:
         from freedm_tpu.serve.batcher import MicroBatcher
 
         self.config = config
+        # The solver-lane mesh every engine shards over (None =
+        # unsharded); built once so all engines share one device set.
+        self.mesh = None
+        if config.mesh_devices not in (0, 1):
+            from freedm_tpu.parallel.mesh import solver_mesh
+
+            self.mesh = solver_mesh(
+                config.mesh_devices, config.mesh_batch_axis
+            )
         self._engines: Dict[Tuple[str, str], _Engine] = {}
         # Global lock guards the maps only; SLOW engine construction
         # (XLA compiles in VVCEngine/N1Engine __init__) happens under a
@@ -733,7 +787,7 @@ class Service:
                 "n1": {"max_iter": cfg.n1_max_iter},
                 "vvc": {"pf_iters": cfg.vvc_pf_iters},
             }[workload]
-            eng = _ENGINE_TYPES[workload](case, **kwargs)
+            eng = _ENGINE_TYPES[workload](case, mesh=self.mesh, **kwargs)
             with self._engines_lock:
                 self._engines[key] = eng
             return eng
@@ -877,6 +931,7 @@ class Service:
             "buckets": list(self.config.bucket_table()),
             "max_batch": self.config.max_batch,
             "max_wait_ms": self.config.max_wait_ms,
+            "mesh_devices": _mesh_lanes(self.mesh) or 1,
             "requests": metric("serve_requests_total"),
             "shed": metric("serve_shed_total"),
             "recompiles": metric("serve_recompiles_total"),

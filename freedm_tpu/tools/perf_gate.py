@@ -28,6 +28,12 @@ Noise discipline:
 - **Per-metric thresholds** — ``--threshold 0.25`` is the default
   relative tolerance; ``--set-threshold name=0.5`` overrides noisy
   metrics individually.
+- **Absolute floors** — ``--floor name=7000`` pins a metric to an
+  absolute bar independent of the rolling baseline (below it for a
+  higher-is-better metric — above it for lower-is-better — is
+  ``REGRESSED`` even while the baseline is still building).  This is
+  how a recovered regression stays recovered:
+  ``--floor lb_256node_rounds_per_sec=7000``.
 
 Exit codes: 0 = pass (ok/improved/baseline/info only), 1 = at least
 one ``REGRESSED`` metric, 2 = unreadable input.  The snapshot is
@@ -119,16 +125,20 @@ def gate(
     min_samples: int = 3,
     window: int = 8,
     per_metric: Optional[Dict[str, float]] = None,
+    floors: Optional[Dict[str, float]] = None,
 ) -> Tuple[List[dict], bool]:
     """Judge one flattened snapshot against the rolling baseline.
 
     Returns ``(verdicts, passed)``; each verdict row is
     ``{metric, status, current, baseline, samples, change_pct,
     threshold_pct}`` with status one of ``ok`` / ``improved`` /
-    ``REGRESSED`` / ``baseline`` / ``info``.
+    ``REGRESSED`` / ``baseline`` / ``info``.  ``floors`` are absolute
+    bars judged on top of (and independent of) the rolling baseline.
     """
     per_metric = per_metric or {}
+    floors = floors or {}
     verdicts: List[dict] = []
+    matched_floors: set = set()
     passed = True
     for name in sorted(flat):
         cur = flat[name]
@@ -168,7 +178,38 @@ def gate(
                     row["status"] = "improved"
                 else:
                     row["status"] = "ok"
+        floor_key = name if name in floors else next(
+            # Flattening prefixes section paths (extra.lb_..., mesh.qsts
+            # ...), so a bare metric name matches as a dot-suffix too.
+            (k for k in floors if name.endswith("." + k)),
+            None,
+        )
+        floor = floors.get(floor_key) if floor_key is not None else None
+        if floor is not None:
+            matched_floors.add(floor_key)
+            # Absolute bar, judged even while the baseline builds;
+            # direction-less names default to higher-is-better.
+            row["floor"] = floor
+            below = cur > floor if d < 0 else cur < floor
+            if below:
+                row["status"] = "REGRESSED"
+                passed = False
+            elif row["status"] in ("info", "baseline"):
+                row["status"] = "ok"
         verdicts.append(row)
+    # A floor that matched NOTHING is a broken guard, not a pass: the
+    # metric it pins was renamed/dropped (or the --floor name is a
+    # typo), and silence here would un-guard the exact regression the
+    # floor was added against.
+    for key in sorted(set(floors) - matched_floors):
+        verdicts.append({
+            "metric": key, "status": "REGRESSED",
+            "current": float("nan"), "baseline": None, "samples": 0,
+            "change_pct": None, "threshold_pct": 0.0,
+            "floor": floors[key],
+            "note": "floor metric absent from snapshot",
+        })
+        passed = False
     return verdicts, passed
 
 
@@ -234,6 +275,12 @@ def _main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--set-threshold", action="append", default=[],
                     metavar="NAME=REL",
                     help="per-metric threshold override (repeatable)")
+    ap.add_argument("--floor", action="append", default=[],
+                    metavar="NAME=VALUE",
+                    help="absolute bar for a metric, judged even while "
+                         "the baseline builds: below it (higher-is-"
+                         "better) or above it (lower-is-better) is "
+                         "REGRESSED (repeatable)")
     ap.add_argument("--label", default="", help="label stored with the "
                                                 "history entry (e.g. a sha)")
     ap.add_argument("--no-update", action="store_true",
@@ -252,6 +299,13 @@ def _main(argv: Optional[List[str]] = None) -> int:
             print(f"perf_gate: bad --set-threshold {spec!r}", file=sys.stderr)
             return 2
         per_metric[name] = float(val)
+    floors: Dict[str, float] = {}
+    for spec in args.floor:
+        name, _, val = spec.partition("=")
+        if not name or not val:
+            print(f"perf_gate: bad --floor {spec!r}", file=sys.stderr)
+            return 2
+        floors[name] = float(val)
 
     try:
         with open(args.snapshot, "r", encoding="utf-8") as fh:
@@ -286,7 +340,7 @@ def _main(argv: Optional[List[str]] = None) -> int:
     verdicts, passed = gate(
         flat, history, threshold=args.threshold,
         min_samples=args.min_samples, window=args.window,
-        per_metric=per_metric,
+        per_metric=per_metric, floors=floors,
     )
     print(render_table(verdicts, all_rows=args.all_rows))
     regressed = [v["metric"] for v in verdicts if v["status"] == "REGRESSED"]

@@ -905,12 +905,28 @@ def run_soak(
         # once the kernels are warm.  Asserted from the slices' own
         # journals (slo.breach/slo.recovered events), which is the
         # whole point of the SLO layer: the rig reads a verdict, not a
-        # counter.
+        # counter.  Recovery needs a CLEAN fast window (20 s) after the
+        # storm, so the rig settles here until the pair appears instead
+        # of reading the journals the instant the last rejoin lands —
+        # the faults themselves used to take >20 s of kernel re-warm,
+        # which hid this; fast warm paths finish the schedule before
+        # the monitor can possibly declare recovery.
+        def _post_kill_pairs() -> List[Dict]:
+            out: List[Dict] = []
+            for spec in specs:
+                events = read_events_jsonl(wd / f"events_{spec.port}.jsonl")
+                for pair in slo_breach_recover_pairs(events, after_ts=kill_ts):
+                    pair["slice"] = spec.uuid
+                    out.append(pair)
+            return out
+
+        slo_pairs[:] = _post_kill_pairs()
+        settle_deadline = time.time() + 60.0  # fast window + slack
+        while not slo_pairs and time.time() < settle_deadline:
+            time.sleep(2.0)
+            slo_pairs[:] = _post_kill_pairs()
         for spec in specs:
             events = read_events_jsonl(wd / f"events_{spec.port}.jsonl")
-            for pair in slo_breach_recover_pairs(events, after_ts=kill_ts):
-                pair["slice"] = spec.uuid
-                slo_pairs.append(pair)
             for pair in slo_breach_recover_pairs(events):
                 if pair.get("breach_ts", 0.0) < kill_ts:
                     pair["slice"] = spec.uuid

@@ -293,3 +293,102 @@ def test_form_groups_with_raw_hash_priorities():
     # Highest raw priority (last index) coordinates the single group.
     assert int(g.n_groups) == 1
     assert np.asarray(g.coordinator).tolist() == [n - 1] * n
+
+
+# ---------------------------------------------------------------------------
+# lb: the sorted-matching round vs the O(N^2) pairwise reference
+# ---------------------------------------------------------------------------
+
+
+def _pairwise_lb_round(net_generation, gateway, group_mask, step,
+                       malicious=None, invariant_ok=None):
+    """The pre-optimization O(N^2) round (pairwise comparison matrices),
+    kept verbatim as the oracle the sort-based `lb.lb_round` must match
+    outcome-for-outcome (BENCH `lb_256node_rounds_per_sec` hot path)."""
+    n = gateway.shape[0]
+    state = lb.classify(net_generation, gateway, step)
+    is_supply = (state == lb.SUPPLY).astype(jnp.float32)
+    is_demand = (state == lb.DEMAND).astype(jnp.float32)
+    malicious = (
+        jnp.zeros(n) if malicious is None else malicious.astype(jnp.float32)
+    )
+    gate = jnp.ones(()) if invariant_ok is None else jnp.asarray(invariant_ok)
+    gate = jnp.broadcast_to(gate, (n,)).astype(jnp.float32)
+    age = jnp.maximum(gateway - net_generation, 0.0) * is_demand
+    surplus = jnp.maximum(net_generation - gateway, 0.0) * is_supply
+    s_rank = lb._group_rank(surplus, is_supply * gate, group_mask)
+    d_rank = lb._group_rank(age, is_demand * gate, group_mask)
+    eligible = (age >= step).astype(jnp.float32)
+    pair = (
+        (s_rank[:, None] == d_rank[None, :]).astype(jnp.float32)
+        * (s_rank[:, None] < n).astype(jnp.float32)
+        * group_mask
+        * is_supply[:, None]
+        * (is_demand * eligible)[None, :]
+    )
+    supply_delta = jnp.sum(pair, axis=1) * step
+    demand_applied = jnp.sum(pair, axis=0) * step * (1.0 - malicious)
+    demand_accepted = jnp.sum(pair, axis=0) * step
+    return lb.LBRound(
+        state=state,
+        gateway=gateway + supply_delta - demand_applied,
+        matched=pair,
+        supply_step=supply_delta,
+        demand_step=-demand_applied,
+        intransit=demand_applied - demand_accepted,
+        n_migrations=jnp.sum(pair).astype(jnp.int32),
+    )
+
+
+def _random_partition_mask(rng, n, n_groups):
+    gid = rng.integers(0, n_groups, n)
+    return jnp.asarray((gid[:, None] == gid[None, :]).astype(np.float32))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sorted_round_matches_pairwise_reference(seed):
+    rng = np.random.default_rng(seed)
+    n = 64
+    mask = _random_partition_mask(rng, n, rng.integers(1, 9))
+    netgen = jnp.asarray(rng.normal(0, 10, n).astype(np.float32))
+    gw = jnp.asarray(rng.normal(0, 2, n).astype(np.float32))
+    mal = jnp.asarray((rng.uniform(size=n) < 0.2).astype(np.float32))
+    got = lb.lb_round(netgen, gw, mask, 1.0, malicious=mal)
+    want = _pairwise_lb_round(netgen, gw, mask, 1.0, malicious=mal)
+    np.testing.assert_array_equal(np.asarray(got.state), np.asarray(want.state))
+    np.testing.assert_allclose(
+        np.asarray(got.gateway), np.asarray(want.gateway), atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.matched), np.asarray(want.matched)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.supply_step), np.asarray(want.supply_step), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.demand_step), np.asarray(want.demand_step), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.intransit), np.asarray(want.intransit), atol=1e-6
+    )
+    assert int(got.n_migrations) == int(want.n_migrations)
+
+
+def test_sorted_round_trajectory_matches_pairwise_to_convergence():
+    # The whole convergence trajectory (the bench workload), not just
+    # one round: per-round migration counts and final gateways agree.
+    rng = np.random.default_rng(3)
+    n = 48
+    mask = _random_partition_mask(rng, n, 4)
+    netgen = jnp.asarray(rng.normal(0, 10, n).astype(np.float32))
+    gw = jnp.zeros(n, jnp.float32)
+    gw_ref = gw
+    for _ in range(40):
+        got = lb.lb_round(netgen, gw, mask, 1.0)
+        want = _pairwise_lb_round(netgen, gw_ref, mask, 1.0)
+        assert int(got.n_migrations) == int(want.n_migrations)
+        np.testing.assert_allclose(
+            np.asarray(got.gateway), np.asarray(want.gateway), atol=1e-4
+        )
+        gw, gw_ref = got.gateway, want.gateway
+    assert int(got.n_migrations) == 0  # converged within the budget

@@ -20,11 +20,18 @@ from freedm_tpu.parallel.superstep import make_superstep
 
 @pytest.fixture(scope="module")
 def mesh8():
+    # conftest forces 8 virtual CPU devices, but CI re-runs this file
+    # under a 4-device XLA_FLAGS override (the mesh scale-out step) —
+    # the 8-device cases skip there instead of erroring.
+    if jax.local_device_count() < 8:
+        pytest.skip("needs 8 devices")
     return make_mesh(8, axes=("nodes",))
 
 
 @pytest.fixture(scope="module")
 def mesh42():
+    if jax.local_device_count() < 8:
+        pytest.skip("needs 8 devices")
     return make_mesh(8, axes=("nodes", "batch"))
 
 
@@ -109,7 +116,8 @@ def test_superstep_outputs_are_sharded(mesh42):
     shard = out.lb_out.gateway.sharding
     assert shard.spec == node_sharding(mesh42, 1).spec
     # 4 distinct row-blocks over the nodes axis (replicated over batch).
-    slices = {s.index for s in out.state.gateway.addressable_shards}
+    # (repr: tuple-of-slices indices are unhashable before py3.12)
+    slices = {repr(s.index) for s in out.state.gateway.addressable_shards}
     assert len(slices) == 4
 
 
@@ -132,7 +140,7 @@ def test_krylov_lanes_shard_over_mesh(mesh8):
     p = jnp.asarray(scale * sys_.p_inj[None, :])
     q = jnp.asarray(scale * sys_.q_inj[None, :])
 
-    lane_sharding = NamedSharding(mesh8, P(("nodes", "batch")))
+    lane_sharding = NamedSharding(mesh8, P("nodes"))
     p_sh = jax.device_put(p, lane_sharding)
     q_sh = jax.device_put(q, lane_sharding)
     batched = jax.jit(
@@ -148,3 +156,93 @@ def test_krylov_lanes_shard_over_mesh(mesh8):
     np.testing.assert_allclose(
         np.asarray(r_sh.v), np.asarray(r_rep.v), atol=1e-10
     )
+
+
+# ---------------------------------------------------------------------------
+# mesh construction validation + lane-sharding helpers (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+def test_make_mesh_explicit_shape_mismatch_is_typed():
+    from freedm_tpu.parallel.mesh import make_mesh as mk
+
+    # Wrong product: the error carries the device/axes arithmetic.
+    with pytest.raises(ValueError, match=r"3 x 2 = 6 devices but 8"):
+        mk(8, axes=("nodes", "batch"), shape=(3, 2))
+    # Rank mismatch: one extent per axis.
+    with pytest.raises(ValueError, match="2 dim\\(s\\) but axes"):
+        mk(8, axes=("nodes",), shape=(4, 2))
+    with pytest.raises(ValueError, match="every extent must be >= 1"):
+        mk(8, axes=("nodes", "batch"), shape=(8, 0))
+    # >2 axes without a shape cannot be inferred.
+    with pytest.raises(ValueError, match="explicit shape"):
+        mk(8, axes=("a", "b", "c"))
+    # Shape arithmetic is validated before device availability, so the
+    # typed errors above fire even on hosts with fewer than 8 devices;
+    # asking for more devices than exist (with a consistent shape) is
+    # still the RuntimeError.
+    n_local = jax.local_device_count()
+    with pytest.raises(RuntimeError, match="need"):
+        mk(2 * n_local, axes=("nodes",))
+    # A valid explicit shape still builds.
+    m = mk(n_local, axes=("nodes", "batch"), shape=(1, n_local))
+    assert m.shape == {"nodes": 1, "batch": n_local}
+
+
+def test_lane_helpers(mesh8, mesh42):
+    from jax.sharding import PartitionSpec as P
+
+    from freedm_tpu.parallel.mesh import (
+        lane_shards,
+        lane_spec,
+        validate_lane_count,
+    )
+
+    assert lane_spec(mesh8, 2) == P("nodes", None)
+    assert lane_spec(mesh8, 3, lane_axis=1) == P(None, "nodes", None)
+    # A two-axis mesh flattens both axes onto the lane axis by default.
+    assert lane_spec(mesh42, 1) == P(("nodes", "batch"))
+    assert lane_shards(mesh8) == 8
+    assert lane_shards(mesh42) == 8
+    assert lane_shards(mesh42, batch_spec="batch") == 2
+    validate_lane_count(mesh8, 16)
+    with pytest.raises(ValueError, match="does not divide"):
+        validate_lane_count(mesh8, 12)
+    with pytest.raises(ValueError, match="not in mesh axes"):
+        lane_spec(mesh8, 1, batch_spec="bogus")
+
+
+def test_resolve_device_count_and_solver_mesh():
+    from freedm_tpu.parallel.mesh import resolve_device_count, solver_mesh
+
+    local = jax.local_device_count()
+    assert resolve_device_count(-1) == local
+    assert resolve_device_count(0) == 1
+    assert resolve_device_count(1) == 1
+    with pytest.raises(ValueError, match="local device"):
+        resolve_device_count(local + 1)
+    assert solver_mesh(0) is None
+    assert solver_mesh(1) is None
+    m = solver_mesh(-1, "lanes")
+    if local > 1:
+        assert m.shape == {"lanes": local}
+    else:
+        assert m is None
+
+
+def test_shard_and_gather_fns_roundtrip(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    from freedm_tpu.parallel.mesh import make_shard_and_gather_fns
+
+    shard, gather = make_shard_and_gather_fns(
+        mesh8, ({"a": P("nodes"), "b": None},)
+    )
+    tree = ({"a": np.arange(16.0), "b": np.float32(3.5)},)
+    placed = shard(tree)
+    assert len(placed[0]["a"].sharding.device_set) == 8
+    assert len(placed[0]["b"].sharding.device_set) == 8  # replicated
+    back = gather(placed)
+    assert isinstance(back[0]["a"], np.ndarray)
+    np.testing.assert_array_equal(back[0]["a"], tree[0]["a"])
+    assert float(back[0]["b"]) == 3.5

@@ -194,3 +194,56 @@ def test_cli_seed_builds_history_from_bench_trajectory(tmp_path):
                   "--seed", str(tmp_path / "cur.json")])
     assert rc == 0
     assert len(pg.load_history(hist)) == 5
+
+
+# ---------------------------------------------------------------------------
+# absolute floors (--floor): the recovered-regression guard
+# ---------------------------------------------------------------------------
+
+
+def test_floor_trips_below_bar_even_while_baseline_builds():
+    flat = {"extra.lb_256node_rounds_per_sec": 5823.0}
+    verdicts, passed = pg.gate(
+        flat, [], min_samples=3,
+        floors={"lb_256node_rounds_per_sec": 7000.0},
+    )
+    assert not passed
+    (row,) = verdicts
+    assert row["status"] == "REGRESSED" and row["floor"] == 7000.0
+
+
+def test_floor_passes_above_bar_and_matches_dot_suffix():
+    flat = {"extra.lb_256node_rounds_per_sec": 8100.0}
+    verdicts, passed = pg.gate(
+        flat, [], min_samples=3,
+        floors={"lb_256node_rounds_per_sec": 7000.0},
+    )
+    assert passed
+    (row,) = verdicts
+    # Floored metrics gate immediately: "baseline" upgrades to "ok".
+    assert row["status"] == "ok" and row["floor"] == 7000.0
+
+
+def test_floor_on_lower_is_better_trips_above_bar():
+    verdicts, passed = pg.gate(
+        {"extra.n1_case30_real_smw_ms": 30.0}, [], min_samples=3,
+        floors={"n1_case30_real_smw_ms": 20.0},
+    )
+    assert not passed and verdicts[0]["status"] == "REGRESSED"
+
+
+def test_floor_matching_no_metric_is_a_broken_guard_not_a_pass():
+    # A renamed/dropped metric (or a --floor typo) must fail loudly:
+    # silence would un-guard the regression the floor was added against.
+    flat = {"extra.other_per_sec": 100.0}
+    verdicts, passed = pg.gate(
+        flat, [], min_samples=3,
+        floors={"lb_256node_rounds_per_sec": 7000.0},
+    )
+    assert not passed
+    broken = [v for v in verdicts if v["metric"] ==
+              "lb_256node_rounds_per_sec"]
+    assert broken and broken[0]["status"] == "REGRESSED"
+    assert broken[0]["note"] == "floor metric absent from snapshot"
+    # ...and the table renders it without crashing on the NaN current.
+    assert "lb_256node_rounds_per_sec" in pg.render_table(verdicts)

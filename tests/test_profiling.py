@@ -138,10 +138,11 @@ def test_disabled_profiler_records_nothing():
     before = profiling.PROFILE_COMPILES.labels("off", "1").value
     profiling.PROFILER.record_compile("off", 1, 9.9)
     profiling.PROFILER.record_host("off.path", 9.9)
+    profiling.PROFILER.record_mesh("off", 4)
     assert profiling.PROFILER.sample_memory("off") is None
     snap = profiling.PROFILER.snapshot()
     assert snap == {"enabled": False, "compiles": {}, "memory": {},
-                    "host": {}}
+                    "host": {}, "mesh_devices": {}}
     assert profiling.PROFILE_COMPILES.labels("off", "1").value == before
 
 
