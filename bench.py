@@ -807,6 +807,160 @@ def bench_mesh() -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Sparse-vs-dense solver benchmarks (ISSUE 7): the BCSR sparse Newton
+# path head-to-head with the dense-LU path at 2000 buses (single and
+# batched lanes — acceptance: >=3x with solutions within documented
+# tolerance), the 10k-bus solve through the sparse assembly vs the
+# jvp-based matrix-free path (same preconditioner, so the delta is the
+# assembly strategy), and DC-screen lane throughput.
+# ---------------------------------------------------------------------------
+
+
+def bench_sparse(with_10k: bool = False) -> dict:
+    from freedm_tpu.pf.dc import make_dc_solver
+    from freedm_tpu.pf.n1 import make_n1_screen
+    from freedm_tpu.pf.sparse import (
+        jacobian_pattern,
+        make_sparse_newton_solver,
+    )
+
+    out: dict = {}
+    sys2k = synthetic_mesh(2000, seed=4, load_mw=2.0, chord_frac=1.0)
+    pat = jacobian_pattern(sys2k)
+    slots = (2 * sys2k.n_bus) ** 2
+    out["jacobian_2000bus"] = {
+        "nnz": pat.nnz,
+        "dense_slots": slots,
+        "density_pct": round(100.0 * pat.nnz / slots, 4),
+    }
+
+    # -- 2000-bus head-to-head: single solve ---------------------------------
+    sp, sp_fixed = make_sparse_newton_solver(sys2k, max_iter=12,
+                                             inner_iters=16)
+    r_s = sp()
+    assert bool(r_s.converged), f"sparse 2k diverged: {float(r_s.mismatch)}"
+    dn, dn_fixed = make_newton_solver(sys2k, max_iter=10)
+    r_d = dn()
+    assert bool(r_d.converged), "dense 2k diverged"
+    max_dv = float(jnp.max(jnp.abs(r_s.v - r_d.v)))
+    sp_rate = 1.0 / _time(sp, lambda r: r.v, reps=5)
+    dn_rate = 1.0 / _time(dn, lambda r: r.v, reps=2)
+
+    # -- 2000-bus head-to-head: batched lanes --------------------------------
+    lanes = 4  # a dense lane is ~6 s on a 2-vCPU host; keep the row honest
+    rng = np.random.default_rng(0)
+    scale = rng.uniform(0.9, 1.1, (lanes, 1))
+    p = jnp.asarray(scale * sys2k.p_inj[None])
+    q = jnp.asarray(scale * sys2k.q_inj[None])
+    b_sp = jax.jit(jax.vmap(lambda pi, qi: sp_fixed(p_inj=pi, q_inj=qi)))
+    b_dn = jax.jit(jax.vmap(lambda pi, qi: dn_fixed(p_inj=pi, q_inj=qi)))
+    rb_s = b_sp(p, q)
+    assert bool(jnp.all(rb_s.converged)), "sparse 2k batch diverged"
+    sp_lane_rate = lanes / _time(lambda: b_sp(p, q), lambda r: r.v, reps=3)
+    rb_d = b_dn(p, q)
+    dn_lane_rate = lanes / _time(lambda: b_dn(p, q), lambda r: r.v, reps=1)
+    batch_dv = float(jnp.max(jnp.abs(rb_s.v - rb_d.v)))
+
+    single_speedup = sp_rate / dn_rate
+    batch_speedup = sp_lane_rate / dn_lane_rate
+    out.update({
+        "nr_2000bus_dense_solves_per_sec": round(dn_rate, 3),
+        "nr_2000bus_sparse_solves_per_sec": round(sp_rate, 3),
+        "nr_2000bus_sparse_speedup": round(single_speedup, 2),
+        f"nr_2000bus_batch{lanes}_dense_lane_solves_per_sec": round(
+            dn_lane_rate, 3
+        ),
+        f"nr_2000bus_batch{lanes}_sparse_lane_solves_per_sec": round(
+            sp_lane_rate, 3
+        ),
+        "nr_2000bus_batch_sparse_speedup": round(batch_speedup, 2),
+        # Documented tolerance (docs/solvers.md): both backends converge
+        # the same masked mismatch below the same tol; f32 solutions
+        # agree to ~2e-4 pu worst-case (measured ~1e-6 here).
+        "sparse_vs_dense_max_dv_pu": float(f"{max(max_dv, batch_dv):.2e}"),
+        "sparse_within_tolerance": bool(max(max_dv, batch_dv) < 2e-4),
+        "meets_3x_target": bool(
+            single_speedup >= 3.0 and batch_speedup >= 3.0
+        ),
+    })
+
+    # -- DC loadflow screen: lane throughput ---------------------------------
+    dc = make_dc_solver(sys2k)
+    inj_lanes = 4096
+    p_stack = jnp.asarray(
+        rng.uniform(0.8, 1.2, (inj_lanes, 1)) * sys2k.p_inj[None]
+    )
+    r_inj = dc.solve(p_stack)
+    assert bool(jnp.all(jnp.isfinite(r_inj.theta))), "DC injection lanes NaN"
+    inj_rate = inj_lanes / _time(
+        lambda: dc.solve(p_stack), lambda r: r.theta, reps=5
+    )
+    n_out = 1024  # chord outages: indices >= n_bus never island the ring
+    ks = jnp.arange(sys2k.n_bus, sys2k.n_bus + n_out)
+    r_out = dc.screen_outages(ks)
+    assert not bool(jnp.any(r_out.islanded)), "chord outage flagged islanded"
+    out_rate = n_out / _time(
+        lambda: dc.screen_outages(ks), lambda r: r.theta, reps=5
+    )
+    out.update({
+        "dc_2000bus_injection_lanes_per_sec": round(inj_rate, 1),
+        "dc_2000bus_outage_lanes_per_sec": round(out_rate, 1),
+    })
+
+    # -- DC prefilter in front of the AC screen ------------------------------
+    # 64 requested outages, AC-verify the 8 DC-worst: the whole point is
+    # the DC pass costing a small fraction of the AC lanes it avoids.
+    screen_pre = make_n1_screen(sys2k, max_iter=12, backend="sparse",
+                                dc_prefilter=8)
+    ks64 = np.arange(sys2k.n_bus, sys2k.n_bus + 64)
+    pre_res = screen_pre(ks64)
+    assert bool(np.all(np.asarray(pre_res.result.converged)))
+    pre_ms = _time(
+        lambda: screen_pre(ks64), lambda r: r.result.v, reps=2
+    ) * 1000.0
+    out["n1_2000bus_64to8_dc_prefiltered_screen_ms"] = round(pre_ms, 1)
+
+    # -- 10k-bus: BCSR assembly vs jvp-based matrix-free, shared factors -----
+    if with_10k:
+        from freedm_tpu.pf.krylov import (
+            build_fdlf_precond,
+            make_krylov_solver,
+            true_mismatch,
+        )
+
+        sys10k = synthetic_mesh(10_000, seed=4, load_mw=2.0, chord_frac=0.3)
+        # One preconditioner build shared by both paths, so the measured
+        # delta is the assembly strategy alone.  kind="auto": streaming
+        # inverses on tpu/gpu; LU factors on cpu, where the Newton-
+        # Schulz [10k,10k] GEMM iteration is infeasible.
+        pre10 = build_fdlf_precond(sys10k, kind="auto")
+        s10, _ = make_sparse_newton_solver(
+            sys10k, max_iter=15, inner_iters=16, precond=pre10
+        )
+        r10 = s10()
+        assert bool(r10.converged), f"sparse 10k: {float(r10.mismatch)}"
+        sp10_ms = _time(s10, lambda r: r.v, reps=2) * 1000.0
+        k10, _ = make_krylov_solver(
+            sys10k, max_iter=15, inner_iters=16, precond=pre10
+        )
+        rk10 = k10()
+        assert bool(rk10.converged), "krylov 10k diverged"
+        ky10_ms = _time(k10, lambda r: r.v, reps=2) * 1000.0
+        out.update({
+            "nr_10000bus_sparse_solve_ms": round(sp10_ms, 1),
+            "nr_10000bus_sparse_true_mismatch_pu": float(
+                f"{true_mismatch(sys10k, r10):.2e}"
+            ),
+            "nr_10000bus_mfree_solve_ms": round(ky10_ms, 1),
+            "nr_10000bus_sparse_vs_mfree_drop_pct": round(
+                100.0 * (1.0 - sp10_ms / ky10_ms), 1
+            ),
+            "precond_kind_10k": pre10.kind,
+        })
+    return out
+
+
 def bench_quick() -> dict:
     """The cheap subset the CI perf gate runs twice per build
     (``tools/perf_gate.py``): small cases, short compiles, enough reps
@@ -824,20 +978,28 @@ def main(argv=None) -> None:
     ap.add_argument(
         "--sections", default="solvers,serve,qsts",
         help="comma list of sections to run: solvers, serve, qsts, quick, "
-             "mesh (default solvers,serve,qsts; quick is the CI perf-gate "
-             "subset; mesh is the device-scaling sweep — force virtual "
-             "CPU devices with "
-             "XLA_FLAGS=--xla_force_host_platform_device_count=N)",
+             "mesh, sparse (default solvers,serve,qsts; quick is the CI "
+             "perf-gate subset; mesh is the device-scaling sweep — force "
+             "virtual CPU devices with "
+             "XLA_FLAGS=--xla_force_host_platform_device_count=N; sparse "
+             "is the dense-vs-BCSR head-to-head + DC screen throughput)",
     )
     ap.add_argument("--serve-duration", type=float, default=1.5, metavar="S",
                     help="seconds per serving measurement window")
+    ap.add_argument("--sparse-10k", action="store_true",
+                    help="include the sparse section's 10k-bus head-to-head "
+                         "(two [10k,10k] factorizations + ~minute-long CPU "
+                         "solves — ~10 min on a 2-vCPU host, milliseconds "
+                         "on a TPU; the 2000-bus acceptance rows always "
+                         "run)")
     args = ap.parse_args(argv)
     sections = {s.strip() for s in args.sections.split(",") if s.strip()}
-    unknown = sections - {"solvers", "serve", "qsts", "quick", "mesh"}
+    unknown = sections - {"solvers", "serve", "qsts", "quick", "mesh",
+                          "sparse"}
     if unknown or not sections:
         raise SystemExit(
             f"--sections needs a non-empty subset of solvers,serve,qsts,"
-            f"quick,mesh; got {args.sections!r}"
+            f"quick,mesh,sparse; got {args.sections!r}"
         )
 
     obj: dict = {}
@@ -847,6 +1009,8 @@ def main(argv=None) -> None:
         obj["qsts"] = bench_qsts()
     if "mesh" in sections:
         obj["mesh"] = bench_mesh()
+    if "sparse" in sections:
+        obj["sparse"] = bench_sparse(with_10k=args.sparse_10k)
     # quick is a strict subset of the solvers section's extra metrics:
     # when solvers also runs, its full-measurement rows supersede quick
     # (same keys, longer reps), so quick only runs standalone.
@@ -887,6 +1051,15 @@ def main(argv=None) -> None:
         obj["value"] = ws["iters_reduction_pct"]
         obj["unit"] = "% vs cold start"
         obj["vs_baseline"] = round(ws["iters_reduction_pct"] / 30.0, 2)
+    elif "metric" not in obj and "sparse" in obj:
+        # sparse-only invocation: the headline is the sparse 2000-bus
+        # solve rate (ISSUE 7 acceptance: >= 3x the dense path with
+        # solutions inside the documented tolerance).
+        sp = obj["sparse"]
+        obj["metric"] = "nr_2000bus_sparse_solves_per_sec"
+        obj["value"] = sp["nr_2000bus_sparse_solves_per_sec"]
+        obj["unit"] = "solves/s"
+        obj["vs_baseline"] = round(sp["nr_2000bus_sparse_speedup"] / 3.0, 2)
     elif "metric" not in obj and "mesh" in obj:
         # mesh-only invocation: the headline is QSTS throughput speedup
         # at all devices (ISSUE 6 acceptance: >= 1.6x at D devices with

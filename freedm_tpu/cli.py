@@ -185,6 +185,13 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--serve-queue-depth", type=int, default=None, metavar="N",
                     help="admission bound in lanes; beyond it requests shed "
                          "with a typed overloaded error (default 512)")
+    ap.add_argument("--pf-backend", default=None,
+                    choices=("dense", "sparse", "auto"),
+                    help="Jacobian backend for the Newton/N-1 power-flow "
+                         "paths: dense [2n,2n] LU, sparse BCSR assembly + "
+                         "pattern-reuse Krylov solves, or auto by case "
+                         "size (default auto; serves the pf/N-1 engines "
+                         "and the QSTS scenario default)")
     ap.add_argument("--qsts-workers", type=int, default=None, metavar="N",
                     help="background workers for QSTS scenario jobs "
                          "(default 1; jobs ride the serve port)")
@@ -233,6 +240,7 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("resume", "resume"),
         ("metrics_port", "metrics_port"), ("events_log", "events_log"),
         ("trace_log", "trace_log"), ("profile_metrics", "profile_metrics"),
+        ("pf_backend", "pf_backend"),
         ("slo_enabled", "slo_enabled"),
         ("slo_fast_window_s", "slo_fast_window_s"),
         ("slo_slow_window_s", "slo_slow_window_s"),
@@ -523,6 +531,7 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             max_batch=cfg.serve_max_batch,
             max_wait_ms=cfg.serve_max_wait_ms,
             queue_depth=cfg.serve_queue_depth,
+            pf_backend=cfg.pf_backend,
             # --mesh-devices also shards the engines' solver lanes
             # (docs/scaling.md); 0 keeps every engine single-device.
             mesh_devices=mesh_n,

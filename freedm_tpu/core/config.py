@@ -272,6 +272,13 @@ class GlobalConfig:
     serve_max_batch: int = 64
     serve_max_wait_ms: float = 2.0
     serve_queue_depth: int = 512
+    # Jacobian backend for the batched Newton/N-1 power-flow paths
+    # (pf/newton.py vs pf/sparse.py): "dense" (hand-assembled [2n,2n]
+    # LU), "sparse" (BCSR/segment-sum assembly + pattern-reuse Krylov
+    # solves), or "auto" (sparse at/above the documented bus-count
+    # crossover).  Threads through the serve engines AND the QSTS
+    # scenario engine default (docs/solvers.md).
+    pf_backend: str = "auto"
     # QSTS scenario jobs (freedm_tpu.scenarios), exposed on the serve
     # port as POST /v1/qsts + GET /v1/jobs/<id>: background worker
     # count (the solvers share one device — 1 is the right default),

@@ -76,6 +76,16 @@ PROFILE_MESH_DEVICES = obs.REGISTRY.gauge(
     "profile_mesh_devices",
     "Devices the workload's batch axis is sharded over (1 = unsharded)",
     labels=("workload",))
+PROFILE_PF_NNZ = obs.REGISTRY.gauge(
+    "profile_pf_jacobian_nnz",
+    "Nonzeros of the case's [2n, 2n] polar Jacobian under the sparse "
+    "(BCSR) power-flow backend — set at pattern-build time, per case",
+    labels=("case",))
+PROFILE_PF_BLOCKS = obs.REGISTRY.gauge(
+    "profile_pf_jacobian_blocks",
+    "Dense sub-blocks of the sparse backend's Jacobian layout (the "
+    "four polar blocks H/N/J/L sharing one incidence pattern)",
+    labels=("case",))
 
 
 def _live_device_bytes() -> Optional[int]:
@@ -112,6 +122,8 @@ class ProfilingRegistry:
         self._host: Dict[str, list] = {}
         # workload -> device count its batch axis shards over
         self._mesh: Dict[str, int] = {}
+        # case -> (jacobian nnz, dense blocks) from the sparse backend
+        self._pf_patterns: Dict[str, tuple] = {}
 
     # -- configuration -------------------------------------------------------
     def configure(self, enabled: Optional[bool] = None) -> "ProfilingRegistry":
@@ -130,6 +142,7 @@ class ProfilingRegistry:
             self._memory.clear()
             self._host.clear()
             self._mesh.clear()
+            self._pf_patterns.clear()
 
     # -- compile account -----------------------------------------------------
     def record_compile(self, workload: str, bucket, seconds: float) -> None:
@@ -188,6 +201,22 @@ class ProfilingRegistry:
             self._mesh[w] = d
         PROFILE_MESH_DEVICES.labels(w).set(d)
 
+    # -- sparse-Jacobian pattern account -------------------------------------
+    def record_pf_pattern(self, case: str, nnz: int, blocks: int) -> None:
+        """One (case, topology) Jacobian pattern was built by the
+        sparse power-flow backend (``pf/sparse.py``): per-case nnz and
+        dense-block gauges, so a scrape can see how sparse the served
+        cases actually are.  Recorded at pattern-BUILD time only — the
+        pattern-reuse contract means later solvers are cache hits and
+        record nothing."""
+        if not self.enabled:
+            return
+        c = str(case)
+        with self._lock:
+            self._pf_patterns[c] = (int(nnz), int(blocks))
+        PROFILE_PF_NNZ.labels(c).set(int(nnz))
+        PROFILE_PF_BLOCKS.labels(c).set(int(blocks))
+
     # -- host-path account ---------------------------------------------------
     def record_host(self, path: str, seconds: float) -> None:
         """Wall time of one pass through a host-side hot path (the
@@ -235,6 +264,10 @@ class ProfilingRegistry:
                 "memory": memory,
                 "host": host,
                 "mesh_devices": dict(sorted(self._mesh.items())),
+                "pf_patterns": {
+                    c: {"nnz": nz, "blocks": bl}
+                    for c, (nz, bl) in sorted(self._pf_patterns.items())
+                },
             }
 
 

@@ -413,13 +413,17 @@ def _in_jax_trace() -> bool:
         return False
 
 
-def traced_solver(solver: str, fn):
+def traced_solver(solver: str, fn, tags=None):
     """Wrap a compiled power-flow solve so each call records a
     ``pf.solve`` span, tagging the first call ``jit_compile=True`` (the
     synchronous trace+compile hit) vs steady-state ``False``, and —
     when the profiling registry (``core.profiling``) is enabled — the
     first call's wall time lands on the compile account keyed
     ``(solver, "base")``.
+
+    ``tags`` adds solver-construction attributes to every span — e.g.
+    the Newton paths pass ``{"pf_backend": "dense"|"sparse"}`` so trace
+    reports can attribute solve time per backend.
 
     Steady-state spans measure the *dispatch* side of an async jax
     execution (no ``block_until_ready`` is inserted — tracing must not
@@ -437,6 +441,7 @@ def traced_solver(solver: str, fn):
     from freedm_tpu.core import profiling as _profiling
 
     seen = [False]
+    extra_tags = dict(tags) if tags else {}
 
     @functools.wraps(fn)
     def wrapper(*a, **kw):
@@ -459,7 +464,8 @@ def traced_solver(solver: str, fn):
             return fn(*a, **kw)
         t0 = _time.perf_counter()
         with TRACER.start(f"pf.solve:{solver}", kind="solve",
-                          tags={"solver": solver, "jit_compile": first}):
+                          tags={"solver": solver, "jit_compile": first,
+                                **extra_tags}):
             out = fn(*a, **kw)
         if profiled:
             _profiling.PROFILER.record_compile(

@@ -14,5 +14,17 @@ from freedm_tpu.pf.newton import (  # noqa: F401
 )
 from freedm_tpu.pf.fdlf import make_fdlf_solver  # noqa: F401
 from freedm_tpu.pf.mfree import make_injection_fn  # noqa: F401
-from freedm_tpu.pf.n1 import make_n1_screen, secure_outages  # noqa: F401
+from freedm_tpu.pf.n1 import (  # noqa: F401
+    N1Prefiltered,
+    make_n1_screen,
+    secure_outages,
+)
+from freedm_tpu.pf.sparse import (  # noqa: F401
+    BACKENDS,
+    SPARSE_AUTO_MIN_BUSES,
+    jacobian_pattern,
+    make_sparse_newton_solver,
+    resolve_backend,
+)
+from freedm_tpu.pf.dc import make_dc_solver  # noqa: F401
 from freedm_tpu.pf.sweeps import make_sweeps, dense_sweeps, doubling_sweeps  # noqa: F401

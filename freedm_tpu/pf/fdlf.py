@@ -216,6 +216,7 @@ def make_fdlf_solver(
     # Tracing (core.tracing): pf.solve spans, first call tagged as the
     # jit-compile hit; a no-op while tracing is disabled.
     return (
-        tracing.traced_solver("fdlf", solve),
-        tracing.traced_solver("fdlf", solve_fixed),
+        tracing.traced_solver("fdlf", solve, tags={"pf_backend": "dense"}),
+        tracing.traced_solver("fdlf", solve_fixed,
+                              tags={"pf_backend": "dense"}),
     )

@@ -139,6 +139,81 @@ def _precond_inv(mat, out_dtype):
     return jnp.asarray(host, out_dtype)
 
 
+class FdlfPrecond(NamedTuple):
+    """A built FDLF preconditioner: the B′/B″ operator pair plus how to
+    apply it.  ``kind="inverse"`` stores explicit inverses (applied as
+    dense matvecs — one MXU pass each, the TPU-right trade) in the
+    requested storage dtype; ``kind="lu"`` stores LU factor pairs
+    (applied as triangular solves — the CPU-right trade: an O(n³/3)
+    factorization instead of the Newton–Schulz GEMM iteration whose
+    build cost only a systolic array amortizes)."""
+
+    bp: object  # [n, n] inverse, or (lu, piv) factors, for B′
+    bq: object  # same for B″
+    kind: str
+
+
+#: ``kind`` vocabulary for :func:`build_fdlf_precond`; "auto" picks
+#: "inverse" on matmul-rich backends (tpu/gpu) and "lu" on cpu.
+PRECOND_KINDS = ("inverse", "lu", "auto")
+
+
+def _resolve_precond_kind(kind: str) -> str:
+    if kind not in PRECOND_KINDS:
+        raise ValueError(
+            f"unknown preconditioner kind {kind!r} "
+            f"(have: {', '.join(PRECOND_KINDS)})"
+        )
+    if kind == "auto":
+        return "lu" if jax.default_backend() == "cpu" else "inverse"
+    return kind
+
+
+def precond_apply_half(kind: str):
+    """The half-system M⁻¹ application for a built pair's ``kind`` —
+    shared by this module's and ``pf/sparse.py``'s preconditioner
+    wrappers so the inverse-vs-LU decision lives in one place."""
+    if kind == "inverse":
+        return lambda b, s: b @ s.astype(b.dtype)
+    return lambda b, s: jax.scipy.linalg.lu_solve(b, s.astype(b[0].dtype))
+
+
+def build_fdlf_precond(
+    sys: BusSystem,
+    dtype: Optional[jnp.dtype] = None,
+    precond_dtype: jnp.dtype = jnp.bfloat16,
+    kind: str = "inverse",
+):
+    """Build the FDLF preconditioner pair (see :class:`FdlfPrecond`).
+
+    The classic decoupled approximation J ≈ blockdiag(diag(V)·B′,
+    diag(V)·B″), built once per (case, dtype).  ``kind="inverse"``
+    inverts both matrices (Newton–Schulz GEMMs with a host LAPACK
+    fallback, :func:`_precond_inv`) and stores them in
+    ``precond_dtype``; ``kind="lu"`` LU-factorizes them in the working
+    dtype (``precond_dtype`` is ignored — triangular solves need the
+    full-precision factors); ``kind="auto"`` picks by backend.  Both
+    the matrix-free solver here and the BCSR sparse path
+    (:mod:`freedm_tpu.pf.sparse`) accept a prebuilt pair via their
+    ``precond=`` argument, so one build can serve several solvers on
+    the same case.
+    """
+    rdtype = cplx.default_rdtype(dtype)
+    kind = _resolve_precond_kind(kind)
+    parts = decoupled_parts(sys, rdtype)
+    with jax.default_matmul_precision("highest"):
+        b_p = parts.b_prime(None)
+        b_q = parts.b_dblprime(ybus_dense(sys, status=None, dtype=rdtype))
+        if kind == "inverse":
+            bp = _precond_inv(b_p, precond_dtype)
+            bq = _precond_inv(b_q, precond_dtype)
+        else:
+            factor = jax.jit(jax.scipy.linalg.lu_factor)
+            bp = factor(b_p)
+            bq = factor(b_q)
+    return FdlfPrecond(bp=bp, bq=bq, kind=kind)
+
+
 def _pgmres(a_op, m_op, b, m: int):
     """Right-preconditioned GMRES(m), one cycle, f32-robust.
 
@@ -221,6 +296,7 @@ def make_krylov_solver(
     inner_iters: int = 24,
     dtype: Optional[jnp.dtype] = None,
     precond_dtype: jnp.dtype = jnp.bfloat16,
+    precond=None,
     mesh=None,
     batch_spec=None,
 ):
@@ -238,6 +314,10 @@ def make_krylov_solver(
     become lane-batched mesh-sharded solvers (leading lane axis on every
     argument, sharded via ``shard_map``; the bf16 preconditioner pair is
     replicated to every device, each lane's GMRES stays chip-local).
+
+    ``precond``: an already-built ``(bp_inv, bq_inv)`` pair from
+    :func:`build_fdlf_precond` — reuse it to share the one-time inverse
+    build across several solvers on the same case.
     """
     rdtype = cplx.default_rdtype(dtype)
     if tol is None:
@@ -257,13 +337,12 @@ def make_krylov_solver(
     # Build-time preconditioner: FDLF B′/B″ inverted once, stored bf16.
     # (The dense [n, n] build peaks at ~3 n² f32 bytes — build-time only;
     # the Newton loop itself never touches an [n, n] f32 array.)
-    parts = decoupled_parts(sys, rdtype)
-    with jax.default_matmul_precision("highest"):
-        _bp_inv = _precond_inv(parts.b_prime(None), precond_dtype)
-        _bq_inv = _precond_inv(
-            parts.b_dblprime(ybus_dense(sys, status=None, dtype=rdtype)),
-            precond_dtype,
+    if precond is None:
+        precond = build_fdlf_precond(
+            sys, dtype=rdtype, precond_dtype=precond_dtype
         )
+    _bp_inv, _bq_inv = precond.bp, precond.bq
+    _apply_half = precond_apply_half(precond.kind)
 
     def _residual(x, p_sched, q_sched, status):
         theta, v = x[:n], x[n:]
@@ -277,10 +356,10 @@ def make_krylov_solver(
         Jacobian approximation.  Pinned rows are identity in B′/B″ (see
         ``decoupled_parts``), so they pass through unscaled."""
         u_p, u_q = u[:n], u[n:]
-        s_p = jnp.where(th_free > 0, u_p / v_now, u_p).astype(precond_dtype)
-        s_q = jnp.where(v_free > 0, u_q / v_now, u_q).astype(precond_dtype)
-        d_th = (bp_inv @ s_p).astype(rdtype)
-        d_v = (bq_inv @ s_q).astype(rdtype)
+        s_p = jnp.where(th_free > 0, u_p / v_now, u_p)
+        s_q = jnp.where(v_free > 0, u_q / v_now, u_q)
+        d_th = _apply_half(bp_inv, s_p).astype(rdtype)
+        d_v = _apply_half(bq_inv, s_q).astype(rdtype)
         return jnp.concatenate([d_th, d_v])
 
     def _newton_step(bp_inv, bq_inv, x, p_sched, q_sched, status):
@@ -371,28 +450,34 @@ def make_krylov_solver(
             tracing.traced_solver("krylov", _mesh_batched_krylov(
                 sys, _solve_impl, _bp_inv, _bq_inv, v_free, v_set,
                 p_sched0, q_sched0, rdtype, mesh, batch_spec,
-            )),
+            ), tags={"pf_backend": "matrix_free"}),
             tracing.traced_solver("krylov", _mesh_batched_krylov(
                 sys, _solve_fixed_impl, _bp_inv, _bq_inv, v_free, v_set,
                 p_sched0, q_sched0, rdtype, mesh, batch_spec,
-            )),
+            ), tags={"pf_backend": "matrix_free"}),
         )
 
     # Tracing (core.tracing): pf.solve spans, first call tagged as the
     # jit-compile hit; a no-op while tracing is disabled.
     return (
-        tracing.traced_solver("krylov", solve),
-        tracing.traced_solver("krylov", solve_fixed),
+        tracing.traced_solver("krylov", solve,
+                              tags={"pf_backend": "matrix_free"}),
+        tracing.traced_solver("krylov", solve_fixed,
+                              tags={"pf_backend": "matrix_free"}),
     )
 
 
 def _mesh_batched_krylov(sys, impl, bp_inv, bq_inv, v_free, v_set,
-                         p_sched0, q_sched0, rdtype, mesh, batch_spec):
+                         p_sched0, q_sched0, rdtype, mesh, batch_spec,
+                         out_type=KrylovResult, name="krylov"):
     """Lane-batched mesh form: ``shard_map`` over the lane axis with the
     preconditioner pair passed replicated; each device runs
     ``vmap(impl)`` on its local lane block (no cross-lane collectives).
     Optional args are filled with the scheduled/flat defaults so ONE
-    program serves every call pattern."""
+    program serves every call pattern.  ``out_type`` is the solver's
+    result NamedTuple (same 7 fields as :class:`KrylovResult`) — the
+    BCSR sparse path (:mod:`freedm_tpu.pf.sparse`) shares this wrapper
+    with its :class:`~freedm_tpu.pf.newton.NewtonResult` output."""
     from jax.sharding import PartitionSpec as P
 
     from freedm_tpu.core import profiling
@@ -401,7 +486,7 @@ def _mesh_batched_krylov(sys, impl, bp_inv, bq_inv, v_free, v_set,
     n = sys.n_bus
     s1 = pmesh.lane_spec(mesh, 1, batch_spec=batch_spec)
     s2 = pmesh.lane_spec(mesh, 2, batch_spec=batch_spec)
-    out_specs = KrylovResult(
+    out_specs = out_type(
         v=s2, theta=s2, p=s2, q=s2,
         iterations=s1, converged=s1, mismatch=s1,
     )
@@ -414,7 +499,7 @@ def _mesh_batched_krylov(sys, impl, bp_inv, bq_inv, v_free, v_set,
         out_specs=out_specs,
     )
     profiling.PROFILER.record_mesh(
-        "krylov", pmesh.lane_shards(mesh, batch_spec)
+        name, pmesh.lane_shards(mesh, batch_spec)
     )
     flat_v = jnp.where(v_free > 0, 1.0, v_set).astype(rdtype)
     status1 = jnp.ones(sys.n_branch, rdtype)
@@ -427,11 +512,11 @@ def _mesh_batched_krylov(sys, impl, bp_inv, bq_inv, v_free, v_set,
         )
         if lanes is None:
             raise ValueError(
-                "mesh-batched krylov solver needs at least one "
-                "argument with a leading lane axis"
+                f"mesh-batched {name} solver needs at least one "
+                f"argument with a leading lane axis"
             )
         pmesh.validate_lane_count(
-            mesh, lanes, what="krylov lane", batch_spec=batch_spec
+            mesh, lanes, what=f"{name} lane", batch_spec=batch_spec
         )
 
         def fill(a, f):

@@ -42,7 +42,7 @@ only solver is a 9-bus radial ladder inside a 3000 ms round budget
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -83,6 +83,41 @@ def secure_outages(sys: BusSystem) -> list:
     return out
 
 
+class N1Prefiltered(NamedTuple):
+    """Output of a DC-prefiltered screen: the AC-verified shortlist
+    (DC-worst first) plus the full DC severity ranking, so a caller can
+    see both what was verified and why the rest was skipped.  Bridge
+    outages (``islanded``) never enter the shortlist — the AC lanes
+    assume connectivity, and their +inf DC severity would otherwise
+    displace a legitimately severe outage with a garbage lane."""
+
+    outages: "np.ndarray"  # [top_k] AC-verified branch indices
+    dc_severity: "np.ndarray"  # [top_k] their DC post-outage max |flow|, pu
+    dc_severity_all: "np.ndarray"  # [k] severity of EVERY requested outage
+    islanded: "np.ndarray"  # [k] bool per requested outage: bridge, skipped
+    result: NewtonResult  # lane-batched AC result for ``outages``
+
+
+def _pad_lanes(screen_fn, d: int):
+    """Pad a ragged outage-lane axis up to a multiple of ``d`` with
+    replicas of the last lane and slice the pad back off — lanes are
+    independent, so visible rows are unaffected (the mesh and the
+    sparse-backend screens share this discipline)."""
+
+    def padded(outages):
+        ks = jnp.asarray(outages)
+        k = int(ks.shape[0])
+        pad = (-k) % d
+        if pad:
+            ks = jnp.concatenate([ks, jnp.broadcast_to(ks[-1:], (pad,))])
+        r = screen_fn(ks)
+        if pad:
+            r = jax.tree_util.tree_map(lambda x: x[:k], r)
+        return r
+
+    return padded
+
+
 def make_n1_screen(
     sys: BusSystem,
     tol: Optional[float] = None,
@@ -90,8 +125,10 @@ def make_n1_screen(
     dtype: Optional[jnp.dtype] = None,
     mesh=None,
     batch_spec=None,
+    backend: str = "dense",
+    dc_prefilter: Optional[int] = None,
 ):
-    """Compile the SMW fast-decoupled N-1 screen.
+    """Compile the batched N-1 screen.
 
     Returns ``screen(outages)``: ``outages`` is an ``[k]`` int array of
     branch indices (each lane removes exactly that branch); the result
@@ -106,7 +143,156 @@ def make_n1_screen(
     pad lanes sliced off the result — every lane is independent, so the
     visible rows are unaffected.  ``batch_spec`` optionally names the
     mesh axis (or axis tuple) the lane axis shards over.
+
+    ``backend`` (the ``--pf-backend`` key): ``"dense"`` is this module's
+    SMW fast-decoupled screen; ``"sparse"`` screens through the BCSR
+    sparse Newton path instead — the base case solved once, every
+    outage lane a status-traced warm-started sparse solve sharing ONE
+    Jacobian pattern and preconditioner (the per-lane O(n²) SMW
+    corrections stop paying off once n² dwarfs the O(n + m) sparse
+    iteration); ``"auto"`` picks by case size
+    (:func:`freedm_tpu.pf.sparse.resolve_backend`).
+
+    ``dc_prefilter=k``: run the batched DC loadflow screen
+    (:mod:`freedm_tpu.pf.dc`) over ALL requested outages first — one
+    B′ factorization, Sherman–Morrison per lane, thousands of lanes per
+    AC-lane-equivalent — AC-verify only the ``k`` DC-worst, and return
+    an :class:`N1Prefiltered` instead of a bare result.  Bridge
+    (islanding) outages are flagged in ``N1Prefiltered.islanded`` and
+    excluded from the AC shortlist; without the prefilter, callers must
+    filter them (``secure_outages``) — the AC lanes assume
+    connectivity.
     """
+    from freedm_tpu.pf.sparse import resolve_backend
+
+    if resolve_backend(backend, sys.n_bus) == "sparse":
+        screen = _make_sparse_n1_screen(
+            sys, tol=tol, max_iter=max_iter, dtype=dtype,
+            mesh=mesh, batch_spec=batch_spec,
+        )
+    else:
+        screen = _make_smw_n1_screen(
+            sys, tol=tol, max_iter=max_iter, dtype=dtype,
+            mesh=mesh, batch_spec=batch_spec,
+        )
+    if dc_prefilter is None:
+        return screen
+    return _with_dc_prefilter(sys, screen, int(dc_prefilter), dtype)
+
+
+def _with_dc_prefilter(sys, ac_screen, top_k: int, dtype):
+    """Wrap an AC screen with the DC first pass (see make_n1_screen)."""
+    from freedm_tpu.pf.dc import make_dc_solver
+
+    if top_k < 1:
+        raise ValueError(f"dc_prefilter must be >= 1, got {top_k}")
+    dc = make_dc_solver(sys, dtype=dtype)
+
+    def screen(outages) -> N1Prefiltered:
+        ks = np.asarray(outages)
+        dc_r = dc.screen_outages(jnp.asarray(ks))
+        sev = np.asarray(dc_r.severity)
+        isl = np.asarray(dc_r.islanded)
+        # Bridge outages are flagged, not verified: the DC screen IS
+        # the islanding filter the AC lanes require.
+        cand = np.flatnonzero(~isl)
+        if cand.size == 0:
+            raise ValueError(
+                "dc_prefilter: every requested outage islands the "
+                "network (all lanes flagged islanded by the DC screen)"
+            )
+        # DC-worst first; stable so equal-severity ties keep request
+        # order (determinism the tests pin).
+        order = cand[np.argsort(-sev[cand], kind="stable")]
+        order = order[: min(top_k, cand.size)]
+        short = ks[order]
+        return N1Prefiltered(
+            outages=short,
+            dc_severity=sev[order],
+            dc_severity_all=sev,
+            islanded=isl,
+            result=ac_screen(jnp.asarray(short)),
+        )
+
+    return screen
+
+
+def _make_sparse_n1_screen(sys, tol, max_iter, dtype, mesh, batch_spec):
+    """The sparse-backend screen: base case once, outage lanes as
+    status-traced warm-started sparse Newton solves (one pattern, one
+    preconditioner, shared by every lane)."""
+    from freedm_tpu.pf.sparse import make_sparse_newton_solver
+
+    m = sys.n_branch
+    rdtype = cplx.default_rdtype(dtype)
+    # The mesh path needs TWO solvers (lane-sharded + the unsharded
+    # base-case solve) — build the expensive FDLF preconditioner pair
+    # ONCE and share it, preserving the one-build-per-(case, topology)
+    # contract the host timer observes.
+    precond = None
+    if mesh is not None:
+        import time as _time
+
+        from freedm_tpu.core import profiling
+        from freedm_tpu.pf.krylov import build_fdlf_precond
+
+        t0 = _time.monotonic()
+        precond = build_fdlf_precond(sys, dtype=rdtype)
+        profiling.PROFILER.record_host(
+            "sparse.precond_build", _time.monotonic() - t0
+        )
+    solve, _ = make_sparse_newton_solver(
+        sys, tol=tol, max_iter=max_iter, dtype=dtype,
+        mesh=mesh, batch_spec=batch_spec, precond=precond,
+    )
+    base_solve, _ = (
+        (solve, None) if mesh is None
+        else make_sparse_newton_solver(
+            sys, tol=tol, max_iter=max_iter, dtype=dtype, precond=precond
+        )
+    )
+    base = base_solve()
+    base_v, base_th = base.v, base.theta
+
+    if mesh is not None:
+        from freedm_tpu.parallel import mesh as pmesh
+
+        d = pmesh.lane_shards(mesh, batch_spec)
+
+        def screen_lanes(ks):
+            k = int(jnp.shape(ks)[0])
+            status = jnp.ones((k, m), rdtype)
+            status = status.at[jnp.arange(k), ks].set(0.0)
+            return solve(
+                status=status,
+                v0=jnp.broadcast_to(base_v, (k,) + base_v.shape),
+                theta0=jnp.broadcast_to(base_th, (k,) + base_th.shape),
+            )
+
+        return _pad_lanes(screen_lanes, d)
+
+    @jax.jit
+    def screen(outages):
+        ks = jnp.asarray(outages)
+
+        def lane(k):
+            status = jnp.ones(m, rdtype).at[k].set(0.0)
+            return solve(status=status, v0=base_v, theta0=base_th)
+
+        return jax.vmap(lane)(ks)
+
+    return screen
+
+
+def _make_smw_n1_screen(
+    sys: BusSystem,
+    tol: Optional[float] = None,
+    max_iter: int = 40,
+    dtype: Optional[jnp.dtype] = None,
+    mesh=None,
+    batch_spec=None,
+):
+    """The SMW fast-decoupled screen (the ``backend="dense"`` path)."""
     rdtype = cplx.default_rdtype(dtype)
     if tol is None:
         tol = 1e-8 if rdtype == jnp.float64 else 3e-5
@@ -239,19 +425,7 @@ def make_n1_screen(
         )
         d = pmesh.lane_shards(mesh, batch_spec)
         profiling.PROFILER.record_mesh("n1", d)
-
-        def screen_mesh(outages):
-            ks = jnp.asarray(outages)
-            k = int(ks.shape[0])
-            pad = (-k) % d
-            if pad:
-                ks = jnp.concatenate([ks, jnp.broadcast_to(ks[-1:], (pad,))])
-            r = prog(ks)
-            if pad:
-                r = jax.tree_util.tree_map(lambda x: x[:k], r)
-            return r
-
-        return screen_mesh
+        return _pad_lanes(prog, d)
 
     @jax.jit
     def screen(outages):

@@ -167,6 +167,7 @@ def make_newton_solver(
     dtype: Optional[jnp.dtype] = None,
     mesh=None,
     batch_spec=None,
+    backend: str = "dense",
 ):
     """Compile NR solvers for a bus system.
 
@@ -196,7 +197,23 @@ def make_newton_solver(
     program (lanes never communicate), byte-identical to the unsharded
     ``vmap``.  ``batch_spec`` optionally names the mesh axis (or axis
     tuple) the lane axis shards over; default: all of them.
+
+    ``backend`` selects the Jacobian path (the ``--pf-backend`` config
+    key): ``"dense"`` (default — this module's hand-assembled [2n, 2n]
+    LU), ``"sparse"`` (BCSR/segment-sum assembly + pattern-reuse Krylov
+    solves, :mod:`freedm_tpu.pf.sparse` — same signatures, same
+    :class:`NewtonResult`, no dense Jacobian ever materialized), or
+    ``"auto"`` (sparse at and above
+    :data:`~freedm_tpu.pf.sparse.SPARSE_AUTO_MIN_BUSES` buses, dense
+    below — the measured crossover, see docs/solvers.md).
     """
+    from freedm_tpu.pf import sparse as _sparse
+
+    if _sparse.resolve_backend(backend, sys.n_bus) == "sparse":
+        return _sparse.make_sparse_newton_solver(
+            sys, tol=tol, max_iter=max_iter, dtype=dtype,
+            mesh=mesh, batch_spec=batch_spec,
+        )
     rdtype = cplx.default_rdtype(dtype)
     if tol is None:
         tol = 1e-8 if rdtype == jnp.float64 else 3e-5
@@ -321,17 +338,21 @@ def make_newton_solver(
         # attributable when --mesh-devices is on.
         return (
             tracing.traced_solver("newton", _mesh_batched(
-                solve, mesh, batch_spec, fills, out_specs, "newton")),
+                solve, mesh, batch_spec, fills, out_specs, "newton"),
+                tags={"pf_backend": "dense"}),
             tracing.traced_solver("newton", _mesh_batched(
-                solve_fixed, mesh, batch_spec, fills, out_specs, "newton")),
+                solve_fixed, mesh, batch_spec, fills, out_specs, "newton"),
+                tags={"pf_backend": "dense"}),
         )
 
     # Tracing (core.tracing, --trace-log): each call records a
-    # ``pf.solve`` span, the first one tagged with its jit-compile hit.
-    # Disabled tracing is one attribute check per call.
+    # ``pf.solve`` span, the first one tagged with its jit-compile hit
+    # and every one tagged with the Jacobian backend.  Disabled tracing
+    # is one attribute check per call.
     return (
-        tracing.traced_solver("newton", solve),
-        tracing.traced_solver("newton", solve_fixed),
+        tracing.traced_solver("newton", solve, tags={"pf_backend": "dense"}),
+        tracing.traced_solver("newton", solve_fixed,
+                              tags={"pf_backend": "dense"}),
     )
 
 

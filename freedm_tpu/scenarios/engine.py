@@ -112,6 +112,12 @@ class StudySpec:
     chunk_steps: int = 24
     warm_start: bool = True
     max_iter: int = 12
+    # Jacobian backend for bus-case Newton solves (the --pf-backend
+    # key): dense [2n,2n] LU, BCSR sparse (pf/sparse.py), or auto
+    # (sparse at/above the documented bus-count crossover).  Part of
+    # the study's identity — backends agree to solver tolerance, not
+    # bit-for-bit, so a checkpoint only resumes under its own backend.
+    pf_backend: str = "auto"
     # Execution placement (NOT part of the study's identity — see
     # MESH_SPEC_KEYS): shard the scenario axis over this many devices
     # via shard_map (0 = unsharded single device, -1 = all local
@@ -195,10 +201,17 @@ class QstsEngine:
     """
 
     def __init__(self, spec: StudySpec):
+        from freedm_tpu.pf.sparse import BACKENDS
+
         if spec.profile not in PROFILE_KINDS:
             raise ValueError(
                 f"unknown profile {spec.profile!r} "
                 f"(have: {', '.join(PROFILE_KINDS)})"
+            )
+        if spec.pf_backend not in BACKENDS:
+            raise ValueError(
+                f"unknown pf_backend {spec.pf_backend!r} "
+                f"(have: {', '.join(BACKENDS)})"
             )
         self.spec = spec
         self.kind, self._case = _resolve_case(spec.case)
@@ -268,8 +281,11 @@ class QstsEngine:
         from freedm_tpu.pf.newton import make_newton_solver
         from freedm_tpu.utils import cplx
 
+        from freedm_tpu.pf.sparse import resolve_backend
+
         sys_ = self._case
         self.solver_name = "newton"
+        self.pf_backend = resolve_backend(self.spec.pf_backend, sys_.n_bus)
         self.rdtype = np.dtype(cplx.default_rdtype(None))
         n = sys_.n_bus
         self._n_profile = n
@@ -282,7 +298,9 @@ class QstsEngine:
         self._v_flat = np.where(
             bt == PQ, 1.0, np.asarray(sys_.v_set, np.float64)
         ).astype(self.rdtype)
-        solve, _ = make_newton_solver(sys_, max_iter=self.spec.max_iter)
+        solve, _ = make_newton_solver(
+            sys_, max_iter=self.spec.max_iter, backend=self.pf_backend
+        )
         self._solve = solve
 
     def _build_bus_chunk(self, tc: int) -> Callable:
@@ -398,6 +416,7 @@ class QstsEngine:
 
         feeder = self._case
         self.solver_name = "ladder"
+        self.pf_backend = "sweep"  # the ladder has no Jacobian at all
         self.rdtype = np.dtype(cplx.default_rdtype(None))
         self._n_profile = feeder.n_branches
         s0 = cplx.as_c(np.asarray(feeder.s_load))
@@ -559,7 +578,8 @@ class QstsEngine:
             with tracing.TRACER.start(
                 f"pf.solve:{self.solver_name}", kind="solve",
                 tags={"solver": self.solver_name, "jit_compile": new_shape,
-                      "steps": tc, "mesh_devices": self.mesh_devices},
+                      "steps": tc, "mesh_devices": self.mesh_devices,
+                      "pf_backend": self.pf_backend},
             ):
                 out = self._fns[tc](state, *arrays)
                 out = jax.block_until_ready(out)
@@ -619,6 +639,7 @@ class QstsEngine:
             "lane_steps_not_converged": int(state.nonconv),
             "compiles": self.compiles,
             "mesh_devices": self.mesh_devices,
+            "pf_backend": self.pf_backend,
             "wall_s": round(float(wall_s), 3),
         }
         if self.kind == "bus":

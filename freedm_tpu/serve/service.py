@@ -301,7 +301,8 @@ def _mesh_lanes(mesh) -> int:
 class PowerFlowEngine(_Engine):
     workload = "pf"
 
-    def __init__(self, case: str, max_iter: int = 12, mesh=None):
+    def __init__(self, case: str, max_iter: int = 12, mesh=None,
+                 backend: str = "auto"):
         super().__init__(case)
         import jax
 
@@ -324,7 +325,8 @@ class PowerFlowEngine(_Engine):
         # iteration counts are real under vmap (converged lanes stop
         # updating), so the response's `iterations` and the pf metrics
         # actually show what a warm start saves.
-        solve, _ = make_newton_solver(sys_, max_iter=max_iter)
+        solve, _ = make_newton_solver(sys_, max_iter=max_iter,
+                                      backend=backend)
         self._batched = jax.jit(
             jax.vmap(lambda p, q, v0, th0: solve(
                 p_inj=p, q_inj=q, v0=v0, theta0=th0
@@ -335,7 +337,7 @@ class PowerFlowEngine(_Engine):
         self._mesh_lanes = _mesh_lanes(mesh)
         if self._mesh_lanes:
             self._batched_mesh, _ = make_newton_solver(
-                sys_, max_iter=max_iter, mesh=mesh
+                sys_, max_iter=max_iter, mesh=mesh, backend=backend
             )
 
     def solve(self, batch):
@@ -431,7 +433,8 @@ class N1Engine(_Engine):
     #: Validation cap on outages per request (also the largest bucket).
     MAX_OUTAGES = 256
 
-    def __init__(self, case: str, max_iter: int = 24, mesh=None):
+    def __init__(self, case: str, max_iter: int = 24, mesh=None,
+                 backend: str = "auto"):
         super().__init__(case)
         from freedm_tpu.pf.n1 import make_n1_screen, secure_outages
 
@@ -441,7 +444,8 @@ class N1Engine(_Engine):
         self._secure_set = frozenset(self._secure)
         # The mesh screen pads ragged lane counts internally, so it
         # serves every bucket; no fallback program needed.
-        self._screen = make_n1_screen(sys_, max_iter=max_iter, mesh=mesh)
+        self._screen = make_n1_screen(sys_, max_iter=max_iter, mesh=mesh,
+                                      backend=backend)
 
     def validate(self, req: N1Request):
         ks = list(req.outages)
@@ -514,7 +518,10 @@ class N1Engine(_Engine):
 class VVCEngine(_Engine):
     workload = "vvc"
 
-    def __init__(self, case: str, pf_iters: int = 20, mesh=None):
+    def __init__(self, case: str, pf_iters: int = 20, mesh=None,
+                 backend: str = "auto"):
+        # ``backend`` is accepted for engine-construction uniformity;
+        # the ladder sweep has no Jacobian, so it is a no-op here.
         super().__init__(case)
         import jax
         import jax.numpy as jnp
@@ -695,6 +702,12 @@ class ServeConfig(NamedTuple):
     # responses are byte-identical either way (docs/scaling.md).
     mesh_devices: int = 0
     mesh_batch_axis: str = "batch"
+    # Jacobian backend for the pf/N-1 engines (CLI: --pf-backend):
+    # dense [2n,2n] LU, BCSR sparse (pf/sparse.py), or auto — sparse
+    # at/above the documented bus-count crossover, which keeps the
+    # small recognized cases on the measured-faster dense path while
+    # client-named meshN scale tenants get the sparse one.
+    pf_backend: str = "auto"
 
     def bucket_table(self) -> Tuple[int, ...]:
         bs = self.buckets if self.buckets else default_buckets(self.max_batch)
@@ -719,8 +732,14 @@ class Service:
     MAX_ENGINES = 32
 
     def __init__(self, config: ServeConfig = ServeConfig(), start: bool = True):
+        from freedm_tpu.pf.sparse import BACKENDS
         from freedm_tpu.serve.batcher import MicroBatcher
 
+        if config.pf_backend not in BACKENDS:
+            raise ValueError(
+                f"unknown pf_backend {config.pf_backend!r} "
+                f"(have: {', '.join(BACKENDS)})"
+            )
         self.config = config
         # The solver-lane mesh every engine shards over (None =
         # unsharded); built once so all engines share one device set.
@@ -787,7 +806,9 @@ class Service:
                 "n1": {"max_iter": cfg.n1_max_iter},
                 "vvc": {"pf_iters": cfg.vvc_pf_iters},
             }[workload]
-            eng = _ENGINE_TYPES[workload](case, mesh=self.mesh, **kwargs)
+            eng = _ENGINE_TYPES[workload](
+                case, mesh=self.mesh, backend=cfg.pf_backend, **kwargs
+            )
             with self._engines_lock:
                 self._engines[key] = eng
             return eng
@@ -932,6 +953,7 @@ class Service:
             "max_batch": self.config.max_batch,
             "max_wait_ms": self.config.max_wait_ms,
             "mesh_devices": _mesh_lanes(self.mesh) or 1,
+            "pf_backend": self.config.pf_backend,
             "requests": metric("serve_requests_total"),
             "shed": metric("serve_shed_total"),
             "recompiles": metric("serve_recompiles_total"),

@@ -1,0 +1,27 @@
+"""Reference-data resolution for tests.
+
+Some tests exercise loaders against the reference checkout's own data
+files (``/root/reference/...``).  That checkout is not part of this
+repo, so each such file has a converted fixture committed under
+``tests/data/`` — the fixture wins when both exist (deterministic CI),
+the reference checkout is the fallback, and a clean skip (not an error)
+is the outcome when neither is present.
+"""
+
+import os
+
+import pytest
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+
+def resolve(fixture_name: str, reference_path: str) -> str:
+    """Fixture-first path resolution with a skip-with-reason fallback."""
+    fixture = os.path.join(DATA_DIR, fixture_name)
+    for path in (fixture, reference_path):
+        if os.path.exists(path):
+            return path
+    pytest.skip(
+        f"no {fixture_name}: neither the committed fixture ({fixture}) "
+        f"nor the reference checkout ({reference_path}) exists"
+    )

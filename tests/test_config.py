@@ -9,7 +9,9 @@ REF_TIMINGS = "/root/reference/Broker/config/timings.cfg"
 
 
 def test_parse_reference_timings_cfg():
-    t = Timings.from_file(REF_TIMINGS)
+    from refdata import resolve
+
+    t = Timings.from_file(resolve("timings.cfg", REF_TIMINGS))
     assert t.gm_phase_time == 530
     assert t.sc_phase_time == 320
     assert t.lb_phase_time == 4100

@@ -56,7 +56,9 @@ def test_dl_roundtrip():
 
 
 def test_load_reference_dl_new():
-    f = load_dl_mat("/root/reference/Broker/Dl_new.mat")
+    from refdata import resolve
+
+    f = load_dl_mat(resolve("Dl_new.mat", "/root/reference/Broker/Dl_new.mat"))
     assert f.n_branches == 33
     assert f.levels > 5  # deep feeder with laterals
     # Non-contiguous laterals relabeled: every parent valid.

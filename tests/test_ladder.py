@@ -22,7 +22,7 @@ from freedm_tpu.pf import (
 from freedm_tpu.utils import cplx
 from freedm_tpu.utils.cplx import C
 
-REF_DL_MAT = "/root/reference/Broker/Dl_new.mat"
+REF_DL_MAT = "/root/reference/Broker/Dl_new.mat"  # fixture-first via refdata
 
 
 def test_9bus_converges_within_reference_envelope():
@@ -105,7 +105,9 @@ def test_reference_dl_new_mat_loads_and_converges():
     (see load_dl_mat), so this checks loader + solver plumbing on the
     reference's own saved table, at a loading feasible for the synthesized
     generic line codes."""
-    feeder = load_dl_mat(REF_DL_MAT)
+    from refdata import resolve
+
+    feeder = load_dl_mat(resolve("Dl_new.mat", REF_DL_MAT))
     assert feeder.n_branches == 33  # 33 real branches among the 41 rows
     solve, _ = make_ladder_solver(feeder, max_iter=60)
     res = solve(0.5 * feeder.s_load)

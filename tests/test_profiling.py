@@ -139,10 +139,11 @@ def test_disabled_profiler_records_nothing():
     profiling.PROFILER.record_compile("off", 1, 9.9)
     profiling.PROFILER.record_host("off.path", 9.9)
     profiling.PROFILER.record_mesh("off", 4)
+    profiling.PROFILER.record_pf_pattern("off", nnz=5, blocks=4)
     assert profiling.PROFILER.sample_memory("off") is None
     snap = profiling.PROFILER.snapshot()
     assert snap == {"enabled": False, "compiles": {}, "memory": {},
-                    "host": {}, "mesh_devices": {}}
+                    "host": {}, "mesh_devices": {}, "pf_patterns": {}}
     assert profiling.PROFILE_COMPILES.labels("off", "1").value == before
 
 

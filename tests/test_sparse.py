@@ -1,0 +1,485 @@
+"""Sparsity-aware power-flow path (pf/sparse.py, pf/dc.py): dense-vs-
+sparse equivalence, pattern reuse, the DC screen's oracles, and the
+mesh-sharded forms.
+
+Tolerance semantics (docs/solvers.md): both backends iterate the SAME
+masked power-mismatch test to the same ``tol``, so convergence flags
+must agree exactly; the converged *solutions* agree to solver-tolerance
+level (inexact Newton vs direct LU), pinned here at 1e-6 pu in the
+float64 test dtype — measured agreement is ~1e-15, so a failure at
+1e-6 means the math broke, not the tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from freedm_tpu.grid.bus import PQ, SLACK, BusSystem
+from freedm_tpu.grid.cases import synthetic_mesh
+from freedm_tpu.pf import dc as dc_mod
+from freedm_tpu.pf import sparse as sparse_mod
+from freedm_tpu.pf.fdlf import decoupled_parts
+from freedm_tpu.pf.n1 import make_n1_screen
+from freedm_tpu.pf.newton import make_newton_solver
+from freedm_tpu.pf.sparse import (
+    SPARSE_AUTO_MIN_BUSES,
+    jacobian_pattern,
+    make_sparse_newton_solver,
+    resolve_backend,
+)
+
+D = jax.local_device_count()
+D2 = max(d for d in (1, 2, 4) if d <= D and D % d == 0)
+needs_mesh = pytest.mark.skipif(D2 < 2, reason="single-device host")
+
+ATOL_V = 1e-6  # pu; see module docstring
+
+
+@pytest.fixture(scope="module")
+def mesh118():
+    return synthetic_mesh(118, seed=1, load_mw=10.0, chord_frac=1.0)
+
+
+@pytest.fixture(scope="module")
+def solvers118(mesh118):
+    dense, dense_fixed = make_newton_solver(mesh118, max_iter=10)
+    sp, sp_fixed = make_sparse_newton_solver(
+        mesh118, max_iter=12, inner_iters=16
+    )
+    return dense, dense_fixed, sp, sp_fixed
+
+
+# ---------------------------------------------------------------------------
+# backend resolution
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_backend():
+    assert resolve_backend("dense", 10_000) == "dense"
+    assert resolve_backend("sparse", 14) == "sparse"
+    assert resolve_backend("auto", SPARSE_AUTO_MIN_BUSES - 1) == "dense"
+    assert resolve_backend("auto", SPARSE_AUTO_MIN_BUSES) == "sparse"
+    with pytest.raises(ValueError, match="unknown pf backend"):
+        resolve_backend("bogus", 100)
+
+
+def test_make_newton_solver_dispatches_backend(mesh118):
+    # backend="sparse" through the dense entry point returns the sparse
+    # solvers — same signature, same NewtonResult, solutions matching.
+    solve, _ = make_newton_solver(mesh118, max_iter=12, backend="sparse")
+    dense, _ = make_newton_solver(mesh118, max_iter=10)
+    r_s, r_d = solve(), dense()
+    assert bool(r_s.converged) and bool(r_d.converged)
+    np.testing.assert_allclose(
+        np.asarray(r_s.v), np.asarray(r_d.v), atol=ATOL_V
+    )
+
+
+# ---------------------------------------------------------------------------
+# sparse-vs-dense equivalence: newton / N-1 / batched lanes
+# ---------------------------------------------------------------------------
+
+
+def test_sparse_matches_dense_base_case(solvers118):
+    dense, _, sp, _ = solvers118
+    r_d, r_s = dense(), sp()
+    assert bool(r_d.converged) == bool(r_s.converged) is True
+    assert float(r_s.mismatch) < 1e-8
+    np.testing.assert_allclose(
+        np.asarray(r_s.v), np.asarray(r_d.v), atol=ATOL_V
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_s.theta), np.asarray(r_d.theta), atol=ATOL_V
+    )
+    # Realized injections (the result's P/Q) agree too — the sparse
+    # assembly IS the Ybus power evaluation, written edge-wise.
+    np.testing.assert_allclose(
+        np.asarray(r_s.p), np.asarray(r_d.p), atol=1e-6
+    )
+
+
+def test_sparse_matches_dense_outage_lane(mesh118, solvers118):
+    dense, _, sp, _ = solvers118
+    status = np.ones(mesh118.n_branch)
+    status[mesh118.n_bus + 5] = 0.0  # a chord: never islands the ring
+    st = jnp.asarray(status)
+    r_d, r_s = dense(status=st), sp(status=st)
+    assert bool(r_d.converged) == bool(r_s.converged) is True
+    np.testing.assert_allclose(
+        np.asarray(r_s.v), np.asarray(r_d.v), atol=ATOL_V
+    )
+
+
+def test_sparse_matches_dense_vmapped_batch(mesh118, solvers118):
+    _, dense_fixed, _, sp_fixed = solvers118
+    rng = np.random.default_rng(0)
+    scale = rng.uniform(0.9, 1.1, (8, 1))
+    p = jnp.asarray(scale * mesh118.p_inj[None])
+    q = jnp.asarray(scale * mesh118.q_inj[None])
+    r_d = jax.jit(jax.vmap(
+        lambda pi, qi: dense_fixed(p_inj=pi, q_inj=qi)
+    ))(p, q)
+    r_s = jax.jit(jax.vmap(
+        lambda pi, qi: sp_fixed(p_inj=pi, q_inj=qi)
+    ))(p, q)
+    assert bool(jnp.all(r_d.converged)) and bool(jnp.all(r_s.converged))
+    np.testing.assert_allclose(
+        np.asarray(r_s.v), np.asarray(r_d.v), atol=ATOL_V
+    )
+
+
+def test_sparse_n1_screen_matches_smw(mesh118):
+    smw = make_n1_screen(mesh118, max_iter=24)  # backend="dense"
+    sp = make_n1_screen(mesh118, max_iter=24, backend="sparse")
+    ks = jnp.arange(118, 130)  # chord outages
+    r1, r2 = smw(ks), sp(ks)
+    assert bool(np.all(np.asarray(r1.converged)))
+    assert bool(np.all(np.asarray(r2.converged)))
+    np.testing.assert_allclose(
+        np.asarray(r2.v), np.asarray(r1.v), atol=ATOL_V
+    )
+
+
+def test_sparse_warm_start_seeds_iteration(mesh118, solvers118):
+    # v0/theta0 are traced on the sparse path too: restarting from the
+    # solution converges immediately (the QSTS warm-start contract).
+    _, _, sp, _ = solvers118
+    base = sp()
+    again = sp(v0=base.v, theta0=base.theta)
+    assert int(again.iterations) <= 1
+    assert bool(again.converged)
+
+
+# ---------------------------------------------------------------------------
+# pattern reuse: ONE symbolic build per (case, topology)
+# ---------------------------------------------------------------------------
+
+
+def test_pattern_built_once_per_topology():
+    sys_a = synthetic_mesh(97, seed=101, load_mw=5.0, chord_frac=0.5)
+    before = sparse_mod.pattern_builds
+    s1, _ = make_sparse_newton_solver(sys_a, max_iter=8)
+    s2, _ = make_sparse_newton_solver(sys_a, max_iter=12)  # same topology
+    screen = make_n1_screen(sys_a, max_iter=8, backend="sparse")
+    assert sparse_mod.pattern_builds == before + 1
+    # A different topology is a new pattern...
+    sys_b = synthetic_mesh(97, seed=102, load_mw=5.0, chord_frac=0.5)
+    make_sparse_newton_solver(sys_b, max_iter=8)
+    assert sparse_mod.pattern_builds == before + 2
+    # ...and solving (any number of times, any lane count) builds none.
+    r = s1()
+    jax.vmap(lambda k: s2(status=jnp.ones(sys_a.n_branch).at[k].set(0.0)))(
+        jnp.asarray([sys_a.n_bus + 1, sys_a.n_bus + 2])
+    )
+    screen(jnp.asarray([sys_a.n_bus + 1]))
+    assert sparse_mod.pattern_builds == before + 2
+    assert bool(r.converged)
+
+
+def test_pattern_nnz_bookkeeping(mesh118):
+    pat = jacobian_pattern(mesh118)
+    # 4 polar blocks, each with n diagonal + 2 entries per unique
+    # off-diagonal pair.
+    pairs = {
+        (min(f, t), max(f, t))
+        for f, t in zip(mesh118.from_bus, mesh118.to_bus) if f != t
+    }
+    assert pat.nnz == 4 * (mesh118.n_bus + 2 * len(pairs))
+    assert pat.blocks == 4
+    # >99% sparse at the 118-bus scale already.
+    assert pat.nnz < 0.1 * (2 * mesh118.n_bus) ** 2
+
+
+def test_pattern_gauge_recorded_when_profiling():
+    from freedm_tpu.core import profiling
+
+    profiling.PROFILER.configure(enabled=True)
+    try:
+        sys_c = synthetic_mesh(131, seed=7, load_mw=5.0, chord_frac=0.4)
+        make_sparse_newton_solver(sys_c, max_iter=6)
+        snap = profiling.PROFILER.snapshot()
+        # Label = bus count + topology digest, so two distinct 131-bus
+        # cases publish two gauges instead of overwriting one.
+        key = next(k for k in snap["pf_patterns"] if k.startswith("131bus-"))
+        ent = snap["pf_patterns"][key]
+        assert ent["blocks"] == 4 and ent["nnz"] > 0
+        sys_d = synthetic_mesh(131, seed=8, load_mw=5.0, chord_frac=0.4)
+        make_sparse_newton_solver(sys_d, max_iter=6)
+        snap2 = profiling.PROFILER.snapshot()
+        assert sum(
+            k.startswith("131bus-") for k in snap2["pf_patterns"]
+        ) == 2
+        host = snap["host"]
+        assert host["sparse.pattern_build"]["count"] >= 1
+        assert host["sparse.precond_build"]["count"] >= 1
+    finally:
+        profiling.PROFILER.reset()
+
+
+# ---------------------------------------------------------------------------
+# DC loadflow screen (pf/dc.py)
+# ---------------------------------------------------------------------------
+
+
+def _dc_oracle(sys_, rhs_mask_free=True, outage=None):
+    parts = decoupled_parts(sys_, jnp.float64)
+    b = np.asarray(parts.b_prime(None), np.float64)
+    tf = np.asarray(parts.th_free)
+    if outage is not None:
+        w = 1.0 / float(sys_.x[outage])
+        a = np.zeros(sys_.n_bus)
+        fb, tb = int(sys_.from_bus[outage]), int(sys_.to_bus[outage])
+        a[fb] += tf[fb]
+        a[tb] -= tf[tb]
+        b = b - w * np.outer(a, a)
+    rhs = np.where(tf > 0, sys_.p_inj, 0.0)
+    return np.linalg.solve(b, rhs)
+
+
+def test_dc_solve_matches_linear_oracle(mesh118):
+    dcs = dc_mod.make_dc_solver(mesh118)
+    r = dcs.solve()
+    np.testing.assert_allclose(
+        np.asarray(r.theta), _dc_oracle(mesh118), atol=1e-10
+    )
+    # Injection lanes: one multi-RHS solve, row i == solo solve of row i.
+    lanes = np.stack([mesh118.p_inj * s for s in (0.8, 1.0, 1.2)])
+    rl = dcs.solve(jnp.asarray(lanes))
+    assert rl.theta.shape == (3, mesh118.n_bus)
+    np.testing.assert_allclose(
+        np.asarray(rl.theta[1]), _dc_oracle(mesh118), atol=1e-10
+    )
+    # Flows are the branch angle differences over x.
+    flows = np.asarray(r.flows)
+    k = mesh118.n_bus + 3
+    f, t = int(mesh118.from_bus[k]), int(mesh118.to_bus[k])
+    th = np.asarray(r.theta)
+    assert flows[k] == pytest.approx((th[f] - th[t]) / mesh118.x[k])
+
+
+def test_dc_outage_screen_matches_refactorization(mesh118):
+    dcs = dc_mod.make_dc_solver(mesh118)
+    ks = np.array([120, 127, 140, 160])
+    r = dcs.screen_outages(jnp.asarray(ks))
+    assert not bool(np.any(np.asarray(r.islanded)))
+    for i, k in enumerate(ks):
+        np.testing.assert_allclose(
+            np.asarray(r.theta[i]), _dc_oracle(mesh118, outage=int(k)),
+            atol=1e-9,
+        )
+        # The outaged branch carries nothing in its own lane.
+        assert float(r.flows[i, k]) == 0.0
+    assert np.all(np.isfinite(np.asarray(r.severity)))
+
+
+def test_dc_bridge_outage_flagged_islanded():
+    bt = np.array([SLACK, PQ, PQ])
+    radial = BusSystem(
+        bus_type=bt,
+        p_inj=np.array([0.0, -0.5, -0.3]),
+        q_inj=np.zeros(3),
+        v_set=np.ones(3),
+        g_shunt=np.zeros(3),
+        b_shunt=np.zeros(3),
+        from_bus=np.array([0, 1]),
+        to_bus=np.array([1, 2]),
+        r=np.array([0.01, 0.01]),
+        x=np.array([0.1, 0.1]),
+        b_chg=np.zeros(2),
+        tap=np.ones(2),
+        shift=np.zeros(2),
+    ).validate()
+    r = dc_mod.make_dc_solver(radial).screen_outages(jnp.asarray([1]))
+    assert bool(r.islanded[0])
+    assert np.isinf(float(r.severity[0]))
+
+
+def test_dc_prefilter_excludes_islanding_bridges():
+    # buses 0-1 by a bridge, 1-2-3 a triangle: outage 0 islands, the
+    # triangle branches do not.
+    bt = np.array([SLACK, PQ, PQ, PQ])
+    sys_b = BusSystem(
+        bus_type=bt,
+        p_inj=np.array([0.0, -0.3, -0.4, -0.3]),
+        q_inj=np.array([0.0, -0.1, -0.1, -0.1]),
+        v_set=np.ones(4),
+        g_shunt=np.zeros(4),
+        b_shunt=np.zeros(4),
+        from_bus=np.array([0, 1, 2, 3]),
+        to_bus=np.array([1, 2, 3, 1]),
+        r=np.full(4, 0.01),
+        x=np.full(4, 0.1),
+        b_chg=np.zeros(4),
+        tap=np.ones(4),
+        shift=np.zeros(4),
+    ).validate()
+    screen = make_n1_screen(sys_b, max_iter=24, dc_prefilter=2)
+    out = screen(np.array([1, 2, 0]))
+    # The bridge is flagged and skipped; the shortlist holds only
+    # connectivity-preserving outages and its AC lanes all converge.
+    np.testing.assert_array_equal(out.islanded, [False, False, True])
+    assert 0 not in out.outages and out.outages.shape == (2,)
+    assert np.all(np.isfinite(out.dc_severity))
+    assert bool(np.all(np.asarray(out.result.converged)))
+    # All-islanding request: typed error, not garbage lanes.
+    with pytest.raises(ValueError, match="islands the network"):
+        screen(np.array([0]))
+
+
+def test_dc_prefilter_screens_top_k(mesh118):
+    screen = make_n1_screen(mesh118, max_iter=24, dc_prefilter=4)
+    ks = np.arange(118, 134)
+    out = screen(ks)
+    assert out.outages.shape == (4,)
+    assert out.dc_severity_all.shape == (16,)
+    # DC-worst first, drawn from the requested set, AC-verified.
+    assert np.all(np.diff(out.dc_severity) <= 1e-12)
+    assert set(out.outages) <= set(ks)
+    assert float(out.dc_severity[0]) == pytest.approx(
+        float(np.max(out.dc_severity_all))
+    )
+    assert bool(np.all(np.asarray(out.result.converged)))
+    assert out.result.v.shape == (4, mesh118.n_bus)
+    # The AC lanes really are the shortlisted outages: each matches the
+    # plain screen's lane for that branch.
+    plain = make_n1_screen(mesh118, max_iter=24)(jnp.asarray(out.outages))
+    np.testing.assert_allclose(
+        np.asarray(out.result.v), np.asarray(plain.v), atol=ATOL_V
+    )
+
+
+# ---------------------------------------------------------------------------
+# QSTS: sparse backend matches dense within tolerance
+# ---------------------------------------------------------------------------
+
+_QSTS_SUMMARY_NUMERIC = (
+    "violation_bus_minutes_mean", "violation_bus_minutes_max",
+    "v_min_pu", "v_max_pu", "energy_loss_mwh_mean", "energy_loss_mwh_max",
+    "peak_branch_mva",
+)
+
+
+def _qsts_summary(backend, mesh_devices=0, scenarios=4):
+    from freedm_tpu.scenarios.engine import StudySpec, run_study
+
+    return run_study(StudySpec(
+        case="case14", scenarios=scenarios, steps=12, dt_minutes=15.0,
+        chunk_steps=6, seed=3, pf_backend=backend,
+        mesh_devices=mesh_devices,
+    ))
+
+
+def test_qsts_sparse_matches_dense():
+    s_d = _qsts_summary("dense")
+    s_s = _qsts_summary("sparse")
+    assert s_d["pf_backend"] == "dense" and s_s["pf_backend"] == "sparse"
+    assert s_s["lane_steps_not_converged"] == 0
+    assert s_d["lane_steps_not_converged"] == 0
+    for key in _QSTS_SUMMARY_NUMERIC:
+        assert s_s[key] == pytest.approx(s_d[key], abs=1e-4), key
+
+
+def test_qsts_backend_validated():
+    from freedm_tpu.scenarios.engine import QstsEngine, StudySpec
+
+    with pytest.raises(ValueError, match="unknown pf_backend"):
+        QstsEngine(StudySpec(case="case14", pf_backend="bogus"))
+
+
+# ---------------------------------------------------------------------------
+# mesh composition: sparse lanes shard, pattern/preconditioner replicate
+# ---------------------------------------------------------------------------
+
+
+@needs_mesh
+def test_sparse_mesh_matches_vmap(mesh118):
+    from freedm_tpu.parallel.mesh import make_mesh
+
+    lanes = 2 * D2
+    rng = np.random.default_rng(1)
+    scale = rng.uniform(0.9, 1.1, (lanes, 1))
+    p = jnp.asarray(scale * mesh118.p_inj[None])
+    q = jnp.asarray(scale * mesh118.q_inj[None])
+    _, sp_fixed = make_sparse_newton_solver(mesh118, max_iter=8)
+    r_ref = jax.jit(jax.vmap(
+        lambda pi, qi: sp_fixed(p_inj=pi, q_inj=qi)
+    ))(p, q)
+    mesh = make_mesh(D2, axes=("batch",))
+    _, sp_mesh = make_sparse_newton_solver(mesh118, max_iter=8, mesh=mesh)
+    r_m = sp_mesh(p_inj=p, q_inj=q)
+    # Sharded GEMM re-tiling moves Krylov iterates by ~eps (see
+    # tests/test_mesh.py's module docstring); converged solutions stay
+    # within solver tolerance.
+    np.testing.assert_allclose(
+        np.asarray(r_m.v), np.asarray(r_ref.v), atol=ATOL_V
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_m.theta), np.asarray(r_ref.theta), atol=ATOL_V
+    )
+    # Lane-count validation stays typed.
+    with pytest.raises(ValueError, match="lane"):
+        sp_mesh(p_inj=p[: D2 + 1])
+
+
+@needs_mesh
+def test_sparse_n1_mesh_screen_pads_ragged_lanes(mesh118):
+    from freedm_tpu.core import profiling
+    from freedm_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(D2, axes=("batch",))
+    # The mesh path needs two solvers (sharded lanes + unsharded base
+    # solve) but must build the preconditioner pair ONCE.
+    profiling.PROFILER.configure(enabled=True)
+    try:
+        sp_mesh = make_n1_screen(mesh118, max_iter=24, backend="sparse",
+                                 mesh=mesh)
+        builds = profiling.PROFILER.snapshot()["host"].get(
+            "sparse.precond_build", {"count": 0})["count"]
+        assert builds == 1
+    finally:
+        profiling.PROFILER.reset()
+    sp_ref = make_n1_screen(mesh118, max_iter=24, backend="sparse")
+    ks = jnp.arange(118, 118 + 2 * D2 + 1)  # ragged: pads internally
+    r_m, r_ref = sp_mesh(ks), sp_ref(ks)
+    assert r_m.v.shape == r_ref.v.shape
+    assert bool(np.all(np.asarray(r_m.converged)))
+    np.testing.assert_allclose(
+        np.asarray(r_m.v), np.asarray(r_ref.v), atol=ATOL_V
+    )
+
+
+@needs_mesh
+def test_qsts_sparse_mesh_matches_unsharded():
+    s_ref = _qsts_summary("sparse", scenarios=2 * D2)
+    s_m = _qsts_summary("sparse", mesh_devices=D2, scenarios=2 * D2)
+    assert s_m["mesh_devices"] == D2
+    assert s_m["lane_steps_not_converged"] == 0
+    for key in _QSTS_SUMMARY_NUMERIC:
+        assert s_m[key] == pytest.approx(s_ref[key], abs=1e-4), key
+
+
+# ---------------------------------------------------------------------------
+# serve threading
+# ---------------------------------------------------------------------------
+
+
+def test_serve_rejects_unknown_backend():
+    from freedm_tpu.serve import ServeConfig, Service
+
+    with pytest.raises(ValueError, match="unknown pf_backend"):
+        Service(ServeConfig(pf_backend="bogus"), start=False)
+
+
+def test_serve_pf_engine_sparse_backend_answers():
+    from freedm_tpu.serve import ServeConfig, Service
+    from freedm_tpu.serve.service import PowerFlowRequest
+
+    svc = Service(ServeConfig(max_batch=8, max_wait_ms=0.0,
+                              pf_backend="sparse"))
+    try:
+        r = svc.request("pf", PowerFlowRequest(case="case14", scale=1.0))
+        assert r.converged and r.residual_pu < 1e-6
+        assert svc.stats()["pf_backend"] == "sparse"
+    finally:
+        svc.stop()
