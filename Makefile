@@ -1,11 +1,14 @@
 # Developer/CI entry points.
 #
-#   make check   - static pass: byte-compile everything + pyflakes lint
+#   make check   - static pass: byte-compile + pyflakes + gridlint
 #   make test    - the tier-1 pytest line from ROADMAP.md
 #
 # `check` degrades gracefully when pyflakes is not installed (the
 # runtime container does not ship it); CI installs it and gets the full
-# lint.
+# lint.  gridlint (freedm_tpu/tools/gridlint.py) is stdlib-only, so it
+# always runs — it enforces the project invariants pyflakes cannot see
+# (jit purity, hot-path syncs, config/doc threading, lock order; see
+# docs/static_analysis.md).
 
 # `make test` uses `set -o pipefail`, which dash (the default /bin/sh on
 # Debian-family systems) rejects.
@@ -13,9 +16,9 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: check compile lint test
+.PHONY: check compile lint gridlint test
 
-check: compile lint
+check: compile lint gridlint
 
 compile:
 	$(PY) -m compileall -q freedm_tpu tests bench.py
@@ -26,6 +29,9 @@ lint:
 	else \
 		echo "pyflakes not installed; skipping lint (compileall still ran)"; \
 	fi
+
+gridlint:
+	$(PY) -m freedm_tpu.tools.gridlint freedm_tpu tests bench.py
 
 test:
 	set -o pipefail; rm -f /tmp/_t1.log; \

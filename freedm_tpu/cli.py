@@ -29,7 +29,7 @@ import dataclasses
 import json
 import os
 import sys
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from freedm_tpu.core import logging as dgilog
 from freedm_tpu.core.config import GlobalConfig, Timings
@@ -108,10 +108,18 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("-c", "--config", help="freedm.cfg path")
     ap.add_argument("-H", "--add-host", action="append", default=None,
                     metavar="HOST:PORT", help="uuid of a peer node (repeatable)")
+    ap.add_argument("--hostname", default=None,
+                    help="this node's hostname (uuid = hostname:port)")
     ap.add_argument("--address", default=None, help="IP interface to listen on")
     ap.add_argument("-p", "--port", type=int, default=None, help="DCN listen port")
     ap.add_argument("--factory-port", type=int, default=None,
                     help="port for the plug-and-play session protocol")
+    ap.add_argument("--devices-endpoint", default=None, metavar="HOST:PORT",
+                    help="device transport endpoint hint passed through to "
+                         "adapters (reference devices-endpoint flag)")
+    ap.add_argument("--clock-skew-us", type=int, default=None, metavar="US",
+                    help="base clock skew applied to phase alignment "
+                         "(composed with the clock synchronizer's offset)")
     ap.add_argument("--device-config", default=None, help="device.xml path")
     ap.add_argument("--adapter-config", default=None, help="adapter.xml path")
     ap.add_argument("--logger-config", default=None, help="logger.cfg path")
@@ -203,6 +211,15 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--qsts-checkpoint-dir", default=None, metavar="DIR",
                     help="directory for QSTS chunk-boundary checkpoints "
                          "(keyed jobs resume across restarts; unset = none)")
+    ap.add_argument("--mqtt-id", default=None, metavar="ID",
+                    help="MQTT plug-and-play client id "
+                         "(docs/mqtt_discovery.md)")
+    ap.add_argument("--mqtt-address", default=None, metavar="URI",
+                    help="MQTT broker address "
+                         "(default tcp://localhost:1883)")
+    ap.add_argument("--mqtt-subscribe", action="append", default=None,
+                    metavar="TOPIC", help="extra MQTT topic to subscribe "
+                                          "(repeatable)")
     ap.add_argument("--migration-step", type=float, default=None,
                     help="size of LB power migrations")
     ap.add_argument("--malicious-behavior", action="store_true", default=None,
@@ -229,8 +246,14 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
 def _load_config(args: argparse.Namespace) -> GlobalConfig:
     overrides = {}
     for field, key in [
-        ("add_host", "add_host"), ("address", "address"), ("port", "port"),
-        ("factory_port", "factory_port"), ("device_config", "device_config"),
+        ("add_host", "add_host"), ("hostname", "hostname"),
+        ("address", "address"), ("port", "port"),
+        ("factory_port", "factory_port"),
+        ("devices_endpoint", "devices_endpoint"),
+        ("clock_skew_us", "clock_skew_us"),
+        ("mqtt_id", "mqtt_id"), ("mqtt_address", "mqtt_address"),
+        ("mqtt_subscribe", "mqtt_subscribe"),
+        ("device_config", "device_config"),
         ("adapter_config", "adapter_config"), ("logger_config", "logger_config"),
         ("timings_config", "timings_config"), ("topology_config", "topology_config"),
         ("network_config", "network_config"), ("federate", "federate"),

@@ -1,0 +1,125 @@
+"""DebugLock: opt-in runtime recorder of lock-acquisition order.
+
+gridlint's GL006 builds the *static* lock-acquisition graph
+(:mod:`freedm_tpu.tools.lint_rules.lock_order`); this module is its
+runtime counterpart for tests: wrap a lock in :class:`DebugLock` (or
+hand one to ``threading.Condition(lock=...)``) and every nested
+acquisition records an ordered edge ``held -> acquired`` into a
+:class:`LockOrderRecorder`.  The concurrency tests
+(``tests/test_serve.py``, ``tests/test_scenarios.py``) then assert
+that the union of the observed edges with GL006's static edges is
+still acyclic — the observed interleavings confirm the static graph
+instead of contradicting it.
+
+Name locks with the same identity scheme GL006 uses
+(``<repo-relative-file>:<Class>.<attr>``) so the two edge sets compose
+directly.
+
+This is test instrumentation, not production machinery: acquisition
+recording takes the recorder's own lock, so wrap hot locks only in
+tests.  It is intentionally dependency-free and import-cheap.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderRecorder:
+    """Collects ordered (held, acquired) edges across all DebugLocks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._held = threading.local()
+        self.edges: Set[Tuple[str, str]] = set()
+        self.acquisitions = 0
+
+    # -- DebugLock callbacks -------------------------------------------------
+    def _stack(self) -> List[str]:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def note_acquire(self, name: str) -> None:
+        st = self._stack()
+        with self._lock:
+            self.acquisitions += 1
+            for held in st:
+                if held != name:
+                    self.edges.add((held, name))
+        st.append(name)
+
+    def note_release(self, name: str) -> None:
+        st = self._stack()
+        # Remove the most recent occurrence (Condition.wait release/
+        # reacquire and RLock reentry keep this non-strictly-LIFO).
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == name:
+                del st[i]
+                break
+
+    # -- verdicts ------------------------------------------------------------
+    def snapshot_edges(self) -> Set[Tuple[str, str]]:
+        with self._lock:
+            return set(self.edges)
+
+    @staticmethod
+    def find_cycle(edges: Set[Tuple[str, str]]) -> Optional[List[str]]:
+        """A cycle in the edge set, or None.  Use with the union of
+        observed and GL006 static edges: order is consistent iff the
+        combined graph stays acyclic.  Delegates to the SAME DFS the
+        static rule uses (``lint_rules.base.find_cycles``) so the two
+        verdicts cannot drift."""
+        from freedm_tpu.tools.lint_rules.base import find_cycles
+
+        adj: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+        cycles = find_cycles(adj)
+        return cycles[0] if cycles else None
+
+
+#: Process-wide default recorder (tests may build their own for
+#: isolation; everything here is opt-in).
+RECORDER = LockOrderRecorder()
+
+
+class DebugLock:
+    """A ``threading.Lock``/``RLock`` wrapper recording acquisition
+    order.  API-compatible where the framework uses locks: context
+    manager, ``acquire``/``release``/``locked``, and usable as the
+    backing lock of a ``threading.Condition`` (whose ``wait`` uses
+    plain acquire/release on a non-recursive lock).
+    """
+
+    def __init__(self, name: str, recursive: bool = False,
+                 recorder: Optional[LockOrderRecorder] = None):
+        self.name = name
+        self._inner = threading.RLock() if recursive else threading.Lock()
+        self._recorder = recorder if recorder is not None else RECORDER
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._recorder.note_acquire(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._recorder.note_release(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._inner, "locked", None)
+        return bool(inner_locked()) if inner_locked is not None else False
+
+    def __enter__(self) -> "DebugLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"DebugLock({self.name!r})"

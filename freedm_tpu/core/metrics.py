@@ -770,6 +770,13 @@ QSTS_SCENARIO_RATE = REGISTRY.gauge(
 QSTS_RESUMES = REGISTRY.counter(
     "qsts_resumes_total", "QSTS jobs resumed from a chunk checkpoint")
 
+# -- static analysis (freedm_tpu.tools.gridlint) ----------------------------
+GRIDLINT_FINDINGS = REGISTRY.counter(
+    "gridlint_findings_total",
+    "gridlint findings by rule id, recorded when the linter runs "
+    "in-process (CI static step, self-lint test)",
+    labels=("rule",))
+
 
 def observe_pf_result(solver: str, result) -> None:
     """Record a solver result's iteration count and final residual.

@@ -186,7 +186,7 @@ class UdpEndpoint:
                 self._peers[src] = st
             elif st.addr is None:
                 st.addr = addr
-            accepted = st.channel.on_frames(frames, now)
+            accepted = st.channel.accept_frames(frames, now)
             self._flush(st, now)  # OnReceive: flush window + acks
         for m in accepted:
             if self.sink is not None:

@@ -253,7 +253,7 @@ class SrChannel:
             sp.end()
 
     # -- receiver side -------------------------------------------------------
-    def on_frames(self, frames: List[Frame], now: float) -> List[ModuleMessage]:
+    def accept_frames(self, frames: List[Frame], now: float) -> List[ModuleMessage]:
         """Process an incoming window; return messages accepted for
         dispatch, in order, each exactly once."""
         out: List[ModuleMessage] = []

@@ -1,0 +1,267 @@
+"""gridlint: AST-level invariant checker for the freedm_tpu codebase.
+
+``compileall + pyflakes`` catch syntax and name errors; the invariants
+this framework actually runs on — trace purity of jitted solver bodies,
+no device syncs in the serving/QSTS hot loops, chunk functions pure in
+the timestep index, config keys threaded through CLI + docs, metric /
+event / span names matching ``docs/observability.md``, and lock-order
+discipline across the threaded modules — are enforced by nothing.
+gridlint turns those contracts (pinned in prose in ``docs/solvers.md``,
+``docs/scenarios.md``, ``docs/observability.md``) into machine-checked
+rules, the correctness-tooling analogue of ``tools/perf_gate.py``.
+
+Zero third-party dependencies (stdlib ``ast``/``tokenize`` only), so it
+runs in a bare container before ``pip install`` — the same graceful
+posture as the Makefile's pyflakes step.  Each file's tree is walked
+once into a shared index (:mod:`freedm_tpu.tools.lint_rules.base`);
+rules visit the indexes.
+
+Usage::
+
+    python -m freedm_tpu.tools.gridlint freedm_tpu tests bench.py
+    python -m freedm_tpu.tools.gridlint --format=json freedm_tpu
+    python -m freedm_tpu.tools.gridlint --list-rules
+
+Exit codes: 0 clean, 1 findings, 2 bad invocation/internal error.
+
+Suppression: ``# gridlint: disable=GL001`` (comma-separated ids, or no
+``=RULE`` for all rules) on the flagged line, or on a standalone
+comment line directly above it.  Policy: docs/static_analysis.md.
+
+See the rule catalogue in :mod:`freedm_tpu.tools.lint_rules` and
+``docs/static_analysis.md`` for the invariant behind each rule ID.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from freedm_tpu.tools.lint_rules import all_rules
+from freedm_tpu.tools.lint_rules.base import FileIndex, Finding, ProjectIndex
+
+SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", ".claude"}
+
+
+class LintResult:
+    """Findings plus rule artifacts (e.g. GL006's lock graph)."""
+
+    def __init__(self, findings: List[Finding], files: List[str],
+                 artifacts: Optional[Dict[str, object]] = None):
+        self.findings = findings
+        self.files = files
+        self.artifacts = artifacts or {}
+
+    @property
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "findings": [f.to_dict() for f in self.findings],
+            "stats": {
+                "files": len(self.files),
+                "findings_total": len(self.findings),
+                "findings_by_rule": self.by_rule,
+                **{k: v for k, v in self.artifacts.items()},
+            },
+        }
+
+
+def iter_py_files(paths: Sequence[str]):
+    for p in paths:
+        path = Path(p)
+        if path.is_file():
+            yield path
+        elif path.is_dir():
+            for sub in sorted(path.rglob("*.py")):
+                if not any(part in SKIP_DIRS for part in sub.parts):
+                    yield sub
+
+
+def find_root(paths: Sequence[str], explicit: Optional[str] = None) -> Path:
+    """The project root cross-file rules read docs from: ``--root`` if
+    given, else the first ancestor of a scanned path containing a
+    ``docs`` directory, else the current directory."""
+    if explicit:
+        return Path(explicit).resolve()
+    for p in paths:
+        cur = Path(p).resolve()
+        if cur.is_file():
+            cur = cur.parent
+        for cand in (cur, *cur.parents):
+            if (cand / "docs").is_dir() and (
+                (cand / "freedm_tpu").is_dir() or (cand / "core").is_dir()
+                or (cand / "cli.py").is_file()
+            ):
+                return cand
+    return Path.cwd().resolve()
+
+
+def run_lint(paths: Sequence[str], root: Optional[str] = None,
+             rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Programmatic entry: lint ``paths``, return a :class:`LintResult`.
+
+    ``rules`` restricts to a subset of rule ids (default: all).
+    """
+    root_path = find_root(paths, root)
+    project = ProjectIndex(root_path)
+    findings: List[Finding] = []
+    files: List[str] = []
+    for path in iter_py_files(paths):
+        resolved = path.resolve()
+        try:
+            rel = resolved.relative_to(root_path).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        try:
+            source = resolved.read_text(encoding="utf-8")
+            fi = FileIndex(resolved, rel, source)
+        except (OSError, SyntaxError, ValueError) as e:
+            findings.append(Finding(
+                "GL000", rel, getattr(e, "lineno", 1) or 1, 0,
+                f"file could not be parsed: {e!r}",
+                "fix the syntax error (compileall would also reject this)",
+            ))
+            continue
+        project.add(fi)
+        files.append(rel)
+
+    artifacts: Dict[str, object] = {}
+    selected = all_rules()
+    if rules:
+        wanted = set(rules)
+        selected = [r for r in selected if r.id in wanted]
+    for rule in selected:
+        for f in rule.check(project):
+            fi = project.files.get(f.path)
+            if fi is not None and fi.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+        extra = getattr(rule, "artifacts", None)
+        if extra:
+            artifacts.update(extra)
+
+    findings.sort(key=Finding.sort_key)
+    # Dedupe (a node reachable through two traced roots, say).
+    seen = set()
+    unique: List[Finding] = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return LintResult(unique, files, artifacts)
+
+
+def record_metrics(result: LintResult) -> None:
+    """Record finding counts on the process-wide registry
+    (``gridlint_findings_total{rule=...}``) when the runtime metrics
+    stack is importable — optional, so the linter itself stays
+    dependency-free in bare containers."""
+    try:
+        from freedm_tpu.core import metrics as obs
+    except Exception:  # numpy missing in a bare container: stay silent
+        return
+    for rule_id, count in sorted(result.by_rule.items()):
+        obs.GRIDLINT_FINDINGS.labels(rule_id).inc(count)
+
+
+def render_text(result: LintResult) -> str:
+    lines = []
+    for f in result.findings:
+        loc = f"{f.path}:{f.line}:{f.col}"
+        lines.append(f"{loc}: {f.rule} {f.message}")
+        if f.hint:
+            lines.append(f"    hint: {f.hint}")
+    by_rule = ", ".join(f"{k}={v}" for k, v in sorted(result.by_rule.items()))
+    if result.findings:
+        lines.append(
+            f"gridlint: {len(result.findings)} finding(s) in "
+            f"{len(result.files)} file(s) [{by_rule}]"
+        )
+    else:
+        lines.append(f"gridlint: clean ({len(result.files)} file(s))")
+    return "\n".join(lines)
+
+
+def render_github(result: LintResult) -> str:
+    lines = []
+    for f in result.findings:
+        msg = f.message + (f" (hint: {f.hint})" if f.hint else "")
+        msg = msg.replace("%", "%25").replace("\n", "%0A")
+        lines.append(
+            f"::error file={f.path},line={f.line},col={f.col},"
+            f"title={f.rule}::{msg}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gridlint",
+        description="AST-level invariant checker (GL001-GL006) for freedm_tpu",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (default: freedm_tpu "
+                         "tests bench.py, where present)")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text", help="output format (default text)")
+    ap.add_argument("--rules", default=None, metavar="IDS",
+                    help="comma-separated rule ids to run (default all)")
+    ap.add_argument("--root", default=None, metavar="DIR",
+                    help="project root for cross-file rules (docs/ lookup; "
+                         "default: auto-detected from the scanned paths)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name}")
+            if rule.hint:
+                print(f"    {rule.hint}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        paths = [p for p in ("freedm_tpu", "tests", "bench.py")
+                 if Path(p).exists()]
+        if not paths:
+            print("gridlint: no paths given and no default targets found",
+                  file=sys.stderr)
+            return 2
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    try:
+        result = run_lint(paths, root=args.root, rules=rules)
+    except Exception as e:  # internal error must not masquerade as clean
+        print(f"gridlint: internal error: {e!r}", file=sys.stderr)
+        return 2
+    record_metrics(result)
+    if args.format == "json":
+        print(json.dumps(result.to_dict(), indent=2, sort_keys=True))
+    elif args.format == "github":
+        out = render_github(result)
+        if out:
+            print(out)
+        print(render_text(result), file=sys.stderr)
+    else:
+        print(render_text(result))
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. `gridlint ... | head` — not an error
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

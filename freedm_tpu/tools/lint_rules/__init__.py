@@ -1,0 +1,44 @@
+"""gridlint rule catalogue.
+
+| ID    | Invariant                                                      |
+|-------|----------------------------------------------------------------|
+| GL001 | jit purity: no host-impure calls inside traced functions       |
+| GL002 | hot-path syncs: no implicit device syncs in dispatch/chunk loops |
+| GL003 | chunk purity: RNG/time never feed chunk windows or checkpoint identity |
+| GL004 | config threading: every config key in cli.py AND docs/configuration.md |
+| GL005 | metric/event/span drift vs docs/observability.md               |
+| GL006 | lock order: static acquisition graph acyclic, no callbacks under locks |
+
+Each rule lives in its own module and visits the shared per-file
+indexes built by the engine (:mod:`freedm_tpu.tools.gridlint`).
+Adding a rule: subclass :class:`~freedm_tpu.tools.lint_rules.base.Rule`,
+give it an ``id``/``name``/``hint``, implement ``check(project)``, and
+append it to :func:`all_rules` — docs/static_analysis.md walks through
+a full example.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from freedm_tpu.tools.lint_rules.base import Rule
+
+
+def all_rules() -> List[Rule]:
+    """Fresh rule instances, in reporting order (stateful rules like
+    GL006 carry per-run artifacts, so instances are not shared)."""
+    from freedm_tpu.tools.lint_rules.chunk_purity import ChunkPurity
+    from freedm_tpu.tools.lint_rules.config_threading import ConfigThreading
+    from freedm_tpu.tools.lint_rules.doc_drift import DocDrift
+    from freedm_tpu.tools.lint_rules.hot_path import HotPathSync
+    from freedm_tpu.tools.lint_rules.jit_purity import JitPurity
+    from freedm_tpu.tools.lint_rules.lock_order import LockOrder
+
+    return [
+        JitPurity(),
+        HotPathSync(),
+        ChunkPurity(),
+        ConfigThreading(),
+        DocDrift(),
+        LockOrder(),
+    ]

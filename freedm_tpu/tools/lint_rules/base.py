@@ -1,0 +1,415 @@
+"""Shared engine types for gridlint: per-file AST indexes and findings.
+
+The engine (:mod:`freedm_tpu.tools.gridlint`) walks every file's tree
+ONCE and records what the rules need into a :class:`FileIndex` — import
+aliases, function definitions with qualified names, every call with its
+resolved dotted callee, class lock attributes, module-level singleton
+assignments, and ``# gridlint: disable=`` suppressions.  Rules then
+visit these shared indexes (plus targeted sub-walks of individual
+function bodies for flow-sensitive checks) instead of re-walking whole
+trees.
+
+Everything here is stdlib-only (``ast`` + ``tokenize``): gridlint must
+run in a bare CI container before any dependency is installed.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*gridlint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\s]+))?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, pointing at a repo-relative location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+
+    def sort_key(self) -> Tuple[str, int, int, str]:
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "hint": self.hint,
+        }
+
+
+class FuncInfo:
+    """One function/method/lambda definition."""
+
+    __slots__ = ("node", "name", "qualname", "class_name", "file", "params")
+
+    def __init__(self, node, name: str, qualname: str,
+                 class_name: Optional[str], file: "FileIndex"):
+        self.node = node
+        self.name = name
+        self.qualname = qualname  # dotted: "Class.meth", "outer.inner"
+        self.class_name = class_name  # nearest enclosing class, if any
+        self.file = file
+        params: List[str] = []
+        args = getattr(node, "args", None)
+        if args is not None:
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                params.append(a.arg)
+            if args.vararg:
+                params.append(args.vararg.arg)
+            if args.kwarg:
+                params.append(args.kwarg.arg)
+        self.params = tuple(params)
+
+
+class CallInfo:
+    """One call site with its (best-effort) resolved callee."""
+
+    __slots__ = ("node", "chain", "dotted", "tail", "func", "lineno", "col")
+
+    def __init__(self, node: ast.Call, chain: Optional[Tuple[str, ...]],
+                 dotted: Optional[str], func: Optional[FuncInfo]):
+        self.node = node
+        #: Raw attribute chain, e.g. ("obs", "EVENTS", "emit"); None when
+        #: the base is not a plain name (a call result, a subscript...).
+        self.chain = chain
+        #: Chain with the head import alias resolved, joined with dots
+        #: (e.g. "freedm_tpu.core.metrics.EVENTS.emit", "numpy.asarray").
+        self.dotted = dotted
+        #: Terminal callee name — always available, even when the chain
+        #: is unresolvable (e.g. ".item" on a subscript).
+        self.tail = (
+            chain[-1] if chain
+            else getattr(node.func, "attr", None)
+            or getattr(node.func, "id", None)
+        )
+        self.func = func  # innermost enclosing FuncInfo (None at module level)
+        self.lineno = node.lineno
+        self.col = node.col_offset
+
+    def arg_str(self, i: int = 0) -> Optional[str]:
+        """The ``i``-th positional argument if it is a string literal."""
+        if len(self.node.args) > i:
+            a = self.node.args[i]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                return a.value
+        return None
+
+    def arg_fstring_prefix(self, i: int = 0) -> Optional[str]:
+        """Leading constant text of an f-string positional argument."""
+        if len(self.node.args) > i:
+            a = self.node.args[i]
+            if isinstance(a, ast.JoinedStr) and a.values:
+                head = a.values[0]
+                if isinstance(head, ast.Constant) and isinstance(head.value, str):
+                    return head.value
+        return None
+
+    def kwarg_str(self, name: str) -> Optional[str]:
+        for kw in self.node.keywords:
+            if kw.arg == name and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+        return None
+
+
+class ClassInfo:
+    __slots__ = ("node", "name", "methods", "lock_attrs", "file")
+
+    def __init__(self, node: ast.ClassDef, name: str, file: "FileIndex"):
+        self.node = node
+        self.name = name
+        self.file = file
+        self.methods: Dict[str, FuncInfo] = {}
+        #: attr name -> lineno of a ``self.X = threading.Lock()`` style
+        #: assignment anywhere in the class body.
+        self.lock_attrs: Dict[str, int] = {}
+
+
+def attr_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """``a.b.c`` -> ("a", "b", "c"); None when the base is not a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    """All Name identifiers appearing in an expression subtree."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def find_cycles(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Cycles in a directed graph (iterative white/grey/black DFS with
+    parent-chain reconstruction; one cycle reported per distinct node
+    set).  Shared by GL006's static lock graph and the runtime
+    ``DebugLock`` recorder (:mod:`freedm_tpu.core.debuglock`), so the
+    two verdicts cannot drift."""
+    cycles: List[List[str]] = []
+    color: Dict[str, int] = {}
+    parent: Dict[str, Optional[str]] = {}
+    reported: Set[frozenset] = set()
+
+    for root in sorted(adj):
+        if color.get(root):
+            continue
+        stack: List[Tuple[str, List[str]]] = [
+            (root, sorted(adj.get(root, ())))
+        ]
+        color[root] = 1
+        parent[root] = None
+        while stack:
+            node, nxts = stack[-1]
+            if nxts:
+                nxt = nxts.pop(0)
+                if color.get(nxt, 0) == 0:
+                    color[nxt] = 1
+                    parent[nxt] = node
+                    stack.append((nxt, sorted(adj.get(nxt, ()))))
+                elif color.get(nxt) == 1:  # back edge: a cycle
+                    cyc = [nxt]
+                    cur = node
+                    while cur is not None and cur != nxt:
+                        cyc.append(cur)
+                        cur = parent.get(cur)
+                    cyc.reverse()
+                    key = frozenset(cyc)
+                    if key not in reported:
+                        reported.add(key)
+                        cycles.append(cyc)
+            else:
+                color[node] = 2
+                stack.pop()
+    return cycles
+
+
+class FileIndex:
+    """Everything gridlint knows about one parsed source file."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        #: local name -> dotted import target ("np" -> "numpy",
+        #: "obs" -> "freedm_tpu.core.metrics", "jit" -> "jax.jit").
+        self.alias: Dict[str, str] = {}
+        self.funcs: List[FuncInfo] = []
+        self.calls: List[CallInfo] = []
+        self.classes: Dict[str, ClassInfo] = {}
+        #: module-level NAME = <Call> assignments (singleton typing).
+        self.module_assigns: Dict[str, CallInfo] = {}
+        #: module-level NAME = threading.Lock()/RLock()/Condition().
+        self.module_locks: Dict[str, int] = {}
+        #: lineno -> set of suppressed rule ids, or {"*"} for all.
+        self.suppress: Dict[int, Set[str]] = {}
+        self._index_suppressions()
+        self._index_tree()
+
+    # -- suppression comments ------------------------------------------------
+    def _index_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                raw = m.group("rules")
+                rules = (
+                    {r.strip() for r in raw.split(",") if r.strip()}
+                    if raw else {"*"}
+                )
+                line = tok.start[0]
+                self.suppress.setdefault(line, set()).update(rules)
+                # A standalone suppression comment covers the next line
+                # too (handy above long expressions).
+                text_before = tok.line[: tok.start[1]].strip()
+                if not text_before:
+                    self.suppress.setdefault(line + 1, set()).update(rules)
+        except (tokenize.TokenError, IndentationError):
+            pass
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppress.get(line)
+        return bool(rules) and ("*" in rules or rule in rules)
+
+    # -- the single tree walk ------------------------------------------------
+    def resolve(self, chain: Tuple[str, ...]) -> str:
+        head = self.alias.get(chain[0], chain[0])
+        return ".".join((head,) + chain[1:])
+
+    def _index_tree(self) -> None:
+        self._walk(self.tree.body, func=None, cls=None, qual=())
+
+    def _walk(self, stmts: Iterable[ast.stmt], func: Optional[FuncInfo],
+              cls: Optional[ClassInfo], qual: Tuple[str, ...]) -> None:
+        for stmt in stmts:
+            self._walk_stmt(stmt, func, cls, qual)
+
+    def _walk_stmt(self, stmt: ast.stmt, func: Optional[FuncInfo],
+                   cls: Optional[ClassInfo], qual: Tuple[str, ...]) -> None:
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            self._index_import(stmt)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qn = qual + (stmt.name,)
+            fi = FuncInfo(stmt, stmt.name, ".".join(qn),
+                          cls.name if cls else None, self)
+            self.funcs.append(fi)
+            if cls is not None and len(qual) == 1 and qual[0] == cls.name:
+                cls.methods[stmt.name] = fi
+            for deco in stmt.decorator_list:
+                self._visit_expr(deco, func, cls)
+            self._walk(stmt.body, fi, cls, qn)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            ci = ClassInfo(stmt, stmt.name, self)
+            # Top-level classes only go in the by-name table; nested
+            # classes still get their bodies walked.
+            if cls is None and func is None:
+                self.classes[stmt.name] = ci
+            for deco in stmt.decorator_list:
+                self._visit_expr(deco, func, cls)
+            self._walk(stmt.body, func, ci, qual + (stmt.name,))
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._index_assign(stmt, func, cls)
+        # Generic: visit all child expressions, recurse into child
+        # statement lists (if/for/while/with/try bodies).
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._walk_stmt(child, func, cls, qual)
+            elif isinstance(child, ast.expr):
+                self._visit_expr(child, func, cls)
+            elif isinstance(child, (ast.withitem, ast.excepthandler,
+                                    ast.keyword)):
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.stmt):
+                        self._walk_stmt(sub, func, cls, qual)
+                    elif isinstance(sub, ast.expr):
+                        self._visit_expr(sub, func, cls)
+
+    def _index_import(self, stmt) -> None:
+        if isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                if a.asname:  # import jax.numpy as jnp -> jnp: jax.numpy
+                    self.alias[a.asname] = a.name
+                else:  # import numpy / import a.b -> first segment binds
+                    head = a.name.split(".")[0]
+                    self.alias.setdefault(head, head)
+        else:  # ImportFrom
+            if stmt.module is None or stmt.level:
+                return  # relative imports: leave unresolved
+            for a in stmt.names:
+                if a.name == "*":
+                    continue
+                local = a.asname or a.name
+                self.alias[local] = f"{stmt.module}.{a.name}"
+
+    def _index_assign(self, stmt, func: Optional[FuncInfo],
+                      cls: Optional[ClassInfo]) -> None:
+        value = getattr(stmt, "value", None)
+        if value is None:
+            return
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        is_lock_ctor = False
+        ctor_dotted = None
+        if isinstance(value, ast.Call):
+            ch = attr_chain(value.func)
+            if ch:
+                ctor_dotted = self.resolve(ch)
+                is_lock_ctor = ctor_dotted in (
+                    "threading.Lock", "threading.RLock", "threading.Condition",
+                )
+        for t in targets:
+            if isinstance(t, ast.Name) and func is None and cls is None:
+                if isinstance(value, ast.Call):
+                    ch = attr_chain(value.func)
+                    self.module_assigns[t.id] = CallInfo(
+                        value, ch, self.resolve(ch) if ch else None, None
+                    )
+                if is_lock_ctor:
+                    self.module_locks[t.id] = stmt.lineno
+            if (is_lock_ctor and cls is not None
+                    and isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                cls.lock_attrs.setdefault(t.attr, stmt.lineno)
+
+    def _visit_expr(self, expr: ast.expr, func: Optional[FuncInfo],
+                    cls: Optional[ClassInfo]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                ch = attr_chain(node.func)
+                self.calls.append(CallInfo(
+                    node, ch, self.resolve(ch) if ch else None, func
+                ))
+            elif isinstance(node, ast.Lambda):
+                qn = ((func.qualname + ".<lambda>") if func else "<lambda>")
+                self.funcs.append(FuncInfo(
+                    node, "<lambda>", qn, cls.name if cls else None, self
+                ))
+
+
+class ProjectIndex:
+    """All indexed files plus the repo root for cross-file rules."""
+
+    def __init__(self, root: Path):
+        self.root = root
+        self.files: Dict[str, FileIndex] = {}
+
+    def add(self, fi: FileIndex) -> None:
+        self.files[fi.rel] = fi
+
+    def by_suffix(self, suffix: str) -> Optional[FileIndex]:
+        for rel, fi in sorted(self.files.items()):
+            if rel.endswith(suffix):
+                return fi
+        return None
+
+    def read_doc(self, rel: str) -> Optional[str]:
+        p = self.root / rel
+        try:
+            return p.read_text(encoding="utf-8")
+        except OSError:
+            return None
+
+
+class Rule:
+    """Base: one invariant with an ID, a one-line hint, and a check."""
+
+    id = "GL000"
+    name = "base"
+    hint = ""
+
+    def check(self, project: ProjectIndex) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, path: str, line: int, col: int, message: str,
+                hint: Optional[str] = None) -> Finding:
+        return Finding(self.id, path, line, col, message,
+                       self.hint if hint is None else hint)

@@ -1,0 +1,156 @@
+"""GL003 — chunk purity: the QSTS resume-correctness bedrock.
+
+Bit-for-bit chunk-checkpoint resume (docs/scenarios.md) rests on two
+statically checkable facts:
+
+1. **All randomness in ``scenarios/profiles.py`` is drawn at
+   construction.**  ``ProfileSet.chunk(t0, t1)`` must be a pure
+   function of the timestep index; an RNG draw in any method other
+   than ``__init__`` makes the profile depend on chunking order and
+   silently breaks byte-identical resume.
+2. **Nothing feeding checkpoint identity reads clocks or RNG.**  The
+   functions that serialize specs/state or name checkpoint files
+   (``to_dict``/``from_dict``, ``state_to_jsonable``,
+   ``placement_free_spec``, ``*checkpoint*``...) — and everything they
+   reach through same-package calls — must not call ``time.*``,
+   ``random.*``, ``np.random.*``, ``datetime.*``, ``uuid.*`` or
+   ``os.urandom``: a timestamp in a spec digest means an identical
+   resubmission no longer matches its own checkpoint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from freedm_tpu.tools.lint_rules.base import (
+    FileIndex,
+    Finding,
+    FuncInfo,
+    ProjectIndex,
+    Rule,
+)
+
+#: Function names that (de)serialize specs/state or name checkpoints —
+#: the roots of the checkpoint-identity reachability walk.
+SEED_NAMES = {
+    "to_dict", "from_dict", "state_to_jsonable", "state_from_jsonable",
+    "placement_free_spec", "strip_timing", "profile_spec",
+}
+SEED_SUBSTRINGS = ("checkpoint", "ckpt", "identity", "digest")
+
+IMPURE_PREFIX = (
+    "time.", "random.", "numpy.random.", "datetime.", "uuid.",
+)
+IMPURE_EXACT = {"os.urandom"}
+
+
+def _is_scenarios(rel: str) -> bool:
+    return rel.startswith("scenarios/") or "/scenarios/" in rel
+
+
+class ChunkPurity(Rule):
+    id = "GL003"
+    name = "chunk-purity"
+    hint = ("chunk windows and checkpoint identity must be pure "
+            "functions of the spec and timestep index: draw randomness "
+            "once in __init__, and keep clocks/RNG out of anything a "
+            "spec digest or checkpoint file name reaches")
+
+    def check(self, project: ProjectIndex) -> Iterable[Finding]:
+        scen_files = [project.files[r] for r in sorted(project.files)
+                      if _is_scenarios(project.files[r].rel)]
+        for fi in scen_files:
+            if fi.rel.endswith("profiles.py"):
+                yield from self._check_rng_in_profiles(fi)
+        yield from self._check_checkpoint_identity(scen_files)
+
+    # -- rule 1: construction-only RNG in profiles.py ------------------------
+    def _check_rng_in_profiles(self, fi: FileIndex) -> Iterable[Finding]:
+        # Names bound from np.random.default_rng(...) anywhere in the file.
+        rng_names: Set[str] = set()       # rng = np.random.default_rng(...)
+        rng_attrs: Set[str] = set()       # self.rng = np.random.default_rng(...)
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                f = node.value.func
+                if isinstance(f, ast.Attribute) and f.attr == "default_rng":
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            rng_names.add(t.id)
+                        elif isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            rng_attrs.add(t.attr)
+        for call in fi.calls:
+            in_init = call.func is not None and call.func.name == "__init__"
+            if in_init:
+                continue
+            is_draw = False
+            if call.dotted is not None and call.dotted.startswith("numpy.random."):
+                is_draw = True
+            elif call.chain and call.chain[0] in rng_names and len(call.chain) > 1:
+                is_draw = True
+            elif call.chain and len(call.chain) == 3 and \
+                    call.chain[0] == "self" and call.chain[1] in rng_attrs:
+                is_draw = True
+            if is_draw:
+                where = call.func.qualname if call.func else "module level"
+                yield self.finding(
+                    fi.rel, call.lineno, call.col,
+                    f"RNG draw `{'.'.join(call.chain or ('np.random',))}` "
+                    f"outside __init__ (in `{where}`): profile chunks must "
+                    f"be pure in the timestep index — draw once at "
+                    f"construction",
+                )
+
+    # -- rule 2: checkpoint identity reaches no clock/RNG --------------------
+    def _check_checkpoint_identity(
+            self, files: List[FileIndex]) -> Iterable[Finding]:
+        # Name-based call graph over the scenarios package.
+        funcs_by_name: Dict[str, List[FuncInfo]] = {}
+        for fi in files:
+            for f in fi.funcs:
+                funcs_by_name.setdefault(f.name, []).append(f)
+
+        def is_seed(f: FuncInfo) -> bool:
+            low = f.qualname.lower()
+            return f.name in SEED_NAMES or any(
+                s in low for s in SEED_SUBSTRINGS
+            )
+
+        seeds = [f for fi in files for f in fi.funcs if is_seed(f)]
+        reachable: Set[int] = set()
+        labels: Dict[int, str] = {}
+        stack = list(seeds)
+        for f in seeds:
+            labels[id(f)] = f.qualname
+        while stack:
+            f = stack.pop()
+            if id(f) in reachable:
+                continue
+            reachable.add(id(f))
+            for call in f.file.calls:
+                if call.func is not f or call.tail is None:
+                    continue
+                for g in funcs_by_name.get(call.tail, []):
+                    if id(g) not in reachable:
+                        labels[id(g)] = labels.get(id(f), f.qualname)
+                        stack.append(g)
+
+        for fi in files:
+            for call in fi.calls:
+                f = call.func
+                if f is None or id(f) not in reachable:
+                    continue
+                d = call.dotted
+                if d is None:
+                    continue
+                if d in IMPURE_EXACT or any(
+                        d.startswith(p) for p in IMPURE_PREFIX):
+                    yield self.finding(
+                        fi.rel, call.lineno, call.col,
+                        f"`{d}` reachable from checkpoint identity "
+                        f"(via `{labels.get(id(f), f.qualname)}` -> "
+                        f"`{f.qualname}`): identical respecs must map to "
+                        f"identical checkpoints",
+                    )

@@ -1,0 +1,146 @@
+"""GL004 — config threading: every config key reaches the CLI and docs.
+
+``GlobalConfig`` (core/config.py) is the single source of truth for
+process settings; the contract since PR 3 is that every key threads
+through **three** surfaces: the dataclass field, a ``--key`` flag in
+``cli.py``, and a row in ``docs/configuration.md``.  A key missing from
+any surface is a knob operators cannot discover or set — exactly the
+drift this rule pins:
+
+- field without a ``--field-dashed`` CLI flag,
+- field not mentioned in docs/configuration.md (dashed or underscored),
+- CLI long flag that maps to no field (minus the declared runtime-only
+  flags: ``--rounds``, ``--realtime``...),
+- ``key = value`` row in the docs' ``freedm.cfg`` block that is not a
+  field (a doc row for a removed key).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from freedm_tpu.tools.lint_rules.base import (
+    FileIndex,
+    Finding,
+    ProjectIndex,
+    Rule,
+)
+
+#: CLI flags that are deliberately runtime-only (not persisted config):
+#: run-shape and introspection switches.
+RUNTIME_ONLY_FLAGS = {
+    "config", "list_loggers", "uuid", "rounds", "realtime",
+    "summary_every", "profile_dir",
+}
+
+
+class ConfigThreading(Rule):
+    id = "GL004"
+    name = "config-threading"
+    hint = ("thread the key through all three surfaces: the GlobalConfig "
+            "field, an add_argument('--key') flag + _load_config mapping "
+            "in cli.py, and the freedm.cfg block in docs/configuration.md")
+
+    def check(self, project: ProjectIndex) -> Iterable[Finding]:
+        cfg = project.by_suffix("core/config.py")
+        if cfg is None:
+            return
+        fields = self._config_fields(cfg)
+        if not fields:
+            return
+        cli = project.by_suffix("cli.py")
+        cli_flags = self._cli_flags(cli) if cli is not None else None
+        doc_text = project.read_doc("docs/configuration.md")
+
+        for name, lineno in sorted(fields.items()):
+            if cli_flags is not None and name not in cli_flags:
+                yield self.finding(
+                    cfg.rel, lineno, 4,
+                    f"config key `{name}` has no `--{name.replace('_', '-')}`"
+                    f" flag in cli.py",
+                )
+            if doc_text is not None and not self._in_doc(name, doc_text):
+                yield self.finding(
+                    cfg.rel, lineno, 4,
+                    f"config key `{name}` is not documented in "
+                    f"docs/configuration.md",
+                )
+
+        if cli_flags is not None:
+            for name, lineno in sorted(cli_flags.items()):
+                if name not in fields and name not in RUNTIME_ONLY_FLAGS:
+                    yield self.finding(
+                        cli.rel, lineno, 4,
+                        f"CLI flag `--{name.replace('_', '-')}` corresponds "
+                        f"to no GlobalConfig key (add the field or list it "
+                        f"in RUNTIME_ONLY_FLAGS)",
+                    )
+
+        if doc_text is not None:
+            for key, lineno in self._doc_cfg_keys(doc_text):
+                if key.replace("-", "_") not in fields:
+                    yield self.finding(
+                        "docs/configuration.md", lineno, 0,
+                        f"documented freedm.cfg key `{key}` is not a "
+                        f"GlobalConfig field (stale doc row?)",
+                    )
+
+    # -- surface extraction ---------------------------------------------------
+    def _config_fields(self, cfg: FileIndex) -> Dict[str, int]:
+        ci = cfg.classes.get("GlobalConfig")
+        if ci is None:
+            return {}
+        out: Dict[str, int] = {}
+        for stmt in ci.node.body:
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                name = stmt.target.id
+                if not name.startswith("_"):
+                    out[name] = stmt.lineno
+        return out
+
+    def _cli_flags(self, cli: FileIndex) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for call in cli.calls:
+            if call.tail != "add_argument":
+                continue
+            for a in call.node.args:
+                if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+                        and a.value.startswith("--"):
+                    out[a.value[2:].replace("-", "_")] = call.lineno
+        return out
+
+    def _in_doc(self, field: str, text: str) -> bool:
+        dashed = re.escape(field.replace("_", "-"))
+        under = re.escape(field)
+        return re.search(
+            rf"(?<![\w-])(?:{dashed}|{under})(?![\w-])", text
+        ) is not None
+
+    # -- docs freedm.cfg block ------------------------------------------------
+    def _doc_cfg_keys(self, text: str) -> List[Tuple[str, int]]:
+        """``key = value`` rows of the first fenced block following the
+        ``## freedm.cfg`` heading."""
+        lines = text.splitlines()
+        out: List[Tuple[str, int]] = []
+        in_section = False
+        in_fence = False
+        for i, line in enumerate(lines, start=1):
+            if line.strip().startswith("## "):
+                in_section = line.strip().lower() == "## freedm.cfg"
+                continue
+            if not in_section:
+                continue
+            if line.strip().startswith("```"):
+                if in_fence:
+                    break  # end of the block: done
+                in_fence = True
+                continue
+            if not in_fence:
+                continue
+            m = re.match(r"^\s*([a-z][a-z0-9-]*)\s*=", line)
+            if m:
+                out.append((m.group(1), i))
+        return out

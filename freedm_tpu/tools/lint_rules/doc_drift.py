@@ -1,0 +1,215 @@
+"""GL005 — metric/event/span drift against docs/observability.md.
+
+docs/observability.md promises "a scrape exposes every name below";
+since PR 1 the metric catalogue, the event schema, and the span-kind
+table have been kept in sync by hand.  This rule pins the sync in both
+directions:
+
+- every metric registered on the process registry
+  (``REGISTRY.counter/gauge/histogram("name", ...)``) must appear in
+  docs/observability.md; every row of a ``| Metric |`` table must be a
+  registered metric (no orphan rows for deleted metrics);
+- every journaled event name (``EVENTS.emit("name", ...)`` /
+  ``self.journal.emit``) must be documented; every ``| Event |`` table
+  row must be emitted somewhere (f-string event names match by their
+  static prefix);
+- every span ``kind=`` passed to ``TRACER.start`` must appear in the
+  tracing kind table, and vice versa.
+
+Scope: library code only (``tests/`` and ``bench.py`` may register
+scratch metrics for assertions; those are not part of the documented
+vocabulary).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from freedm_tpu.tools.lint_rules.base import (
+    FileIndex,
+    Finding,
+    ProjectIndex,
+    Rule,
+)
+
+_CODE_SPAN = re.compile(r"`([^`]+)`")
+
+DOC_PATH = "docs/observability.md"
+
+
+def _is_library(rel: str) -> bool:
+    parts = rel.split("/")
+    return "tests" not in parts and not rel.endswith("bench.py") \
+        and not rel.startswith("tests")
+
+
+def _doc_tokens(text: str) -> Set[str]:
+    """All code-span tokens in the doc, normalized: ``{labels}``
+    stripped, split on ``/``, commas and whitespace.  Parsed line by
+    line (code spans never wrap) so ``` fences cannot desync the
+    backtick pairing."""
+    out: Set[str] = set()
+    for line in text.splitlines():
+        if line.lstrip().startswith("```"):
+            continue
+        for span in _CODE_SPAN.findall(line):
+            for piece in re.split(r"[\s,/]+", span):
+                piece = piece.split("{")[0].strip().strip("\\|")
+                if piece:
+                    out.add(piece)
+    return out
+
+
+def _doc_table_rows(text: str, header_cell: str) -> List[Tuple[str, int]]:
+    """(first-cell token, lineno) for every row of tables whose header's
+    first cell is ``header_cell`` (e.g. "Metric", "Event", "kind")."""
+    rows: List[Tuple[str, int]] = []
+    lines = text.splitlines()
+    mode = False
+    for i, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            mode = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        first = cells[0]
+        if first == header_cell:
+            mode = True
+            continue
+        if set(first) <= {"-", " ", ":"}:
+            continue  # separator row
+        if mode:
+            for span in _CODE_SPAN.findall(first):
+                for piece in re.split(r"[\s,/]+", span):
+                    # Escaped pipes inside label sets: name{a\|b}
+                    piece = piece.split("{")[0].strip().strip("\\|")
+                    if piece:
+                        rows.append((piece, i))
+    return rows
+
+
+class DocDrift(Rule):
+    id = "GL005"
+    name = "doc-drift"
+    hint = ("docs/observability.md is the metric/event/span contract: add "
+            "the row when registering a name, delete the row when removing "
+            "one — a scrape must expose exactly the documented vocabulary")
+
+    def check(self, project: ProjectIndex) -> Iterable[Finding]:
+        lib_files = [project.files[r] for r in sorted(project.files)
+                     if _is_library(project.files[r].rel)]
+        metrics = self._registered_metrics(lib_files)
+        events, event_prefixes = self._emitted_events(lib_files)
+        kinds = self._span_kinds(lib_files)
+        if not metrics and not events and not kinds:
+            return  # nothing instrumented in this scan
+        text = project.read_doc(DOC_PATH)
+        if text is None:
+            return
+        documented = _doc_tokens(text)
+
+        for name, (rel, lineno) in sorted(metrics.items()):
+            if name not in documented:
+                yield self.finding(
+                    rel, lineno, 0,
+                    f"metric `{name}` is registered but has no row/mention "
+                    f"in {DOC_PATH}",
+                )
+        for name, (rel, lineno) in sorted(events.items()):
+            if name not in documented:
+                yield self.finding(
+                    rel, lineno, 0,
+                    f"journal event `{name}` is emitted but undocumented "
+                    f"in {DOC_PATH}",
+                )
+        for kind, (rel, lineno) in sorted(kinds.items()):
+            if kind not in documented:
+                yield self.finding(
+                    rel, lineno, 0,
+                    f"span kind `{kind}` is recorded but missing from the "
+                    f"tracing kind table in {DOC_PATH}",
+                )
+
+        for token, lineno in _doc_table_rows(text, "Metric"):
+            if not re.fullmatch(r"[a-z][a-z0-9_]+", token):
+                continue
+            if token not in metrics:
+                yield self.finding(
+                    DOC_PATH, lineno, 0,
+                    f"orphan doc row: metric `{token}` is documented but "
+                    f"registered nowhere",
+                )
+        for token, lineno in _doc_table_rows(text, "Event"):
+            if not re.fullmatch(r"[a-z][a-z0-9_.]+", token):
+                continue
+            if token in events:
+                continue
+            if any(token.startswith(p) for p in event_prefixes):
+                continue
+            yield self.finding(
+                DOC_PATH, lineno, 0,
+                f"orphan doc row: event `{token}` is documented but "
+                f"emitted nowhere",
+            )
+        for token, lineno in _doc_table_rows(text, "kind"):
+            if not re.fullmatch(r"[a-z][a-z0-9_]+", token):
+                continue
+            if token not in kinds:
+                yield self.finding(
+                    DOC_PATH, lineno, 0,
+                    f"orphan doc row: span kind `{token}` is documented "
+                    f"but recorded nowhere",
+                )
+
+    # -- code-side indexes ----------------------------------------------------
+    def _registered_metrics(
+            self, files: List[FileIndex]) -> Dict[str, Tuple[str, int]]:
+        out: Dict[str, Tuple[str, int]] = {}
+        for fi in files:
+            for call in fi.calls:
+                if call.tail not in ("counter", "gauge", "histogram"):
+                    continue
+                if not call.chain or "REGISTRY" not in call.chain:
+                    continue
+                name = call.arg_str(0)
+                if name is not None:
+                    out.setdefault(name, (fi.rel, call.lineno))
+        return out
+
+    def _emitted_events(
+            self, files: List[FileIndex],
+    ) -> Tuple[Dict[str, Tuple[str, int]], Set[str]]:
+        out: Dict[str, Tuple[str, int]] = {}
+        prefixes: Set[str] = set()
+        for fi in files:
+            for call in fi.calls:
+                if call.tail != "emit" or not call.chain:
+                    continue
+                holder = call.chain[-2] if len(call.chain) >= 2 else ""
+                if holder not in ("EVENTS", "journal", "_journal", "events"):
+                    continue
+                name = call.arg_str(0)
+                if name is not None:
+                    out.setdefault(name, (fi.rel, call.lineno))
+                    continue
+                prefix = call.arg_fstring_prefix(0)
+                if prefix:
+                    prefixes.add(prefix)
+        return out, prefixes
+
+    def _span_kinds(
+            self, files: List[FileIndex]) -> Dict[str, Tuple[str, int]]:
+        out: Dict[str, Tuple[str, int]] = {}
+        for fi in files:
+            for call in fi.calls:
+                if call.tail != "start" or not call.chain:
+                    continue
+                if "TRACER" not in call.chain:
+                    continue
+                kind = call.kwarg_str("kind")
+                if kind is not None:
+                    out.setdefault(kind, (fi.rel, call.lineno))
+        return out

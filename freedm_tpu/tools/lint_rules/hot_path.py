@@ -1,0 +1,265 @@
+"""GL002 — hot-path syncs: implicit device syncs in the serving and
+QSTS dispatch loops.
+
+The serve dispatch thread, the QSTS chunk loop, and the broker round
+loop are the paths where one stray ``float(result[...])`` or
+``.item()`` turns an async device dispatch into a synchronous
+round-trip — the latency cliff the micro-batcher exists to avoid.
+These paths are *declared* in :data:`HOT_PATHS` (the hot-path
+registry): each entry names a function, where device values enter it
+(parameters and/or ``.solve()``-style calls), and which sync
+primitives it is *allowed* to use because it IS the designed
+measurement/pull point (``engine.solve``'s ``block_until_ready`` is
+how ``serve_solve_seconds`` stays honest; ``scatter``'s one
+``np.asarray`` per result field is the designed single device→host
+transfer).
+
+Within a registered function the rule walks statements in source
+order, tracking which names are device-derived ("tainted"): sources
+taint, an *allowed* ``np.asarray`` pull untaints its target, and any
+``float()`` / ``int()`` / ``np.asarray`` / ``np.array`` applied to a
+tainted expression — or any unallowed ``block_until_ready`` /
+``.item()`` — is a finding.
+
+The registry is also self-checking: an entry whose function no longer
+exists (a rename) is itself a finding, so the declaration cannot rot.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from freedm_tpu.tools.lint_rules.base import (
+    FileIndex,
+    Finding,
+    FuncInfo,
+    ProjectIndex,
+    Rule,
+    attr_chain,
+    names_in,
+)
+
+
+@dataclass(frozen=True)
+class HotPath:
+    """One declared hot path.
+
+    ``path_suffix``/``qualname`` locate the function (closures defined
+    inside it are covered too).  ``sources`` are parameter names that
+    carry device arrays; ``source_calls`` are method tails whose return
+    value is a device array (``solve``).  ``allow`` lists permitted
+    sync primitives at this designed boundary: ``"block_until_ready"``
+    and/or ``"asarray"``.
+    """
+
+    path_suffix: str
+    qualname: str
+    sources: Tuple[str, ...] = ()
+    source_calls: Tuple[str, ...] = ()
+    allow: FrozenSet[str] = frozenset()
+
+
+HOT_PATHS: Tuple[HotPath, ...] = (
+    # serve dispatch loop: device results flow out of engine.solve and
+    # must reach the engine's scatter untouched.
+    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._run",
+            source_calls=("solve",)),
+    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._dispatch",
+            source_calls=("solve",)),
+    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._dispatch_inner",
+            source_calls=("solve",)),
+    # Engine solve(): the one designed block_until_ready (the batcher
+    # times it as serve_solve_seconds / the compile account).
+    HotPath("freedm_tpu/serve/service.py", "PowerFlowEngine.solve",
+            allow=frozenset({"block_until_ready"})),
+    HotPath("freedm_tpu/serve/service.py", "N1Engine.solve",
+            allow=frozenset({"block_until_ready"})),
+    HotPath("freedm_tpu/serve/service.py", "VVCEngine.solve",
+            allow=frozenset({"block_until_ready"})),
+    # Engine scatter(): the one designed device->host pull per result
+    # field; everything after the np.asarray is host numpy.
+    HotPath("freedm_tpu/serve/service.py", "PowerFlowEngine.scatter",
+            sources=("r", "results"), allow=frozenset({"asarray"})),
+    HotPath("freedm_tpu/serve/service.py", "N1Engine.scatter",
+            sources=("r", "results"), allow=frozenset({"asarray"})),
+    HotPath("freedm_tpu/serve/service.py", "VVCEngine.scatter",
+            sources=("out", "results"), allow=frozenset({"asarray"})),
+    # QSTS chunk loop: run_chunk owns the designed chunk-exit sync +
+    # host pull (checkpoint state must be host numpy); the outer study
+    # loop and the job workers must not sync at all.
+    HotPath("freedm_tpu/scenarios/engine.py", "QstsEngine.run_chunk",
+            allow=frozenset({"block_until_ready", "asarray"})),
+    HotPath("freedm_tpu/scenarios/engine.py", "run_study"),
+    HotPath("freedm_tpu/scenarios/jobs.py", "JobManager._run"),
+    HotPath("freedm_tpu/scenarios/jobs.py", "JobManager._execute"),
+    # Broker phase handlers: the round loop itself.
+    HotPath("freedm_tpu/runtime/broker.py", "Broker.run_round"),
+    HotPath("freedm_tpu/runtime/broker.py", "Broker.run"),
+)
+
+#: numpy coercions that force a device transfer when fed a jax array.
+_NP_COERCIONS = {
+    "numpy.asarray", "numpy.array", "numpy.float64", "numpy.float32",
+    "numpy.int32", "numpy.int64", "numpy.ravel", "numpy.copy",
+}
+
+
+class HotPathSync(Rule):
+    id = "GL002"
+    name = "hot-path-sync"
+    hint = ("implicit device syncs stall the dispatch pipeline: pull "
+            "results once at the engine's designed scatter/asarray "
+            "boundary; if this site IS a new designed sync point, "
+            "declare it in lint_rules/hot_path.py HOT_PATHS")
+
+    def check(self, project: ProjectIndex) -> Iterable[Finding]:
+        for hp in HOT_PATHS:
+            fi = self._file_for(project, hp)
+            if fi is None:
+                continue  # module not in this scan — nothing to check
+            owner = self._owner_func(fi, hp)
+            if owner is None:
+                yield self.finding(
+                    fi.rel, 1, 0,
+                    f"hot-path registry entry `{hp.qualname}` matches no "
+                    f"function in {fi.rel} — update HOT_PATHS in "
+                    f"lint_rules/hot_path.py after the rename",
+                )
+                continue
+            yield from self._check_func(fi, owner, hp)
+
+    def _file_for(self, project: ProjectIndex, hp: HotPath) -> Optional[FileIndex]:
+        for rel in sorted(project.files):
+            if rel.endswith(hp.path_suffix):
+                return project.files[rel]
+        return None
+
+    def _owner_func(self, fi: FileIndex, hp: HotPath) -> Optional[FuncInfo]:
+        for f in fi.funcs:
+            if f.qualname == hp.qualname:
+                return f
+        return None
+
+    # -- order-sensitive taint walk ------------------------------------------
+    def _check_func(self, fi: FileIndex, owner: FuncInfo,
+                    hp: HotPath) -> Iterable[Finding]:
+        tainted: Set[str] = set(hp.sources)
+        findings: List[Finding] = []
+
+        def is_source_call(call: ast.Call) -> bool:
+            tail = getattr(call.func, "attr", None) or \
+                getattr(call.func, "id", None)
+            return tail in hp.source_calls
+
+        def dotted(node: ast.expr) -> Optional[str]:
+            ch = attr_chain(node)
+            return fi.resolve(ch) if ch else None
+
+        def expr_tainted(node: ast.expr) -> bool:
+            if tainted and (names_in(node) & tainted):
+                return True
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and is_source_call(sub):
+                    return True
+            return False
+
+        def flag(node: ast.AST, what: str) -> None:
+            findings.append(self.finding(
+                fi.rel, node.lineno, node.col_offset,
+                f"{what} in hot path `{hp.qualname}` "
+                f"(declared in the GL002 hot-path registry)",
+            ))
+
+        def visit_call(call: ast.Call) -> bool:
+            """Check one call; returns True if it is an *allowed pull*
+            (np.asarray under an `asarray` allowance)."""
+            d = dotted(call.func)
+            tail = getattr(call.func, "attr", None) or \
+                getattr(call.func, "id", None)
+            if tail == "block_until_ready":
+                if "block_until_ready" not in hp.allow:
+                    flag(call, "unguarded `block_until_ready` device sync")
+                return False
+            if tail == "item" and isinstance(call.func, ast.Attribute) \
+                    and not call.args:
+                flag(call, "`.item()` device sync")
+                return False
+            arg_bad = any(expr_tainted(a) for a in call.args)
+            if d in _NP_COERCIONS:
+                if "asarray" in hp.allow:
+                    return True  # designed pull: untaints its target
+                if arg_bad:
+                    flag(call, f"`{d}` host coercion of a device result")
+                return False
+            if d in ("float", "int", "bool") and arg_bad:
+                flag(call, f"`{d}()` host coercion of a device result")
+            return False
+
+        def scan_expr(node: ast.expr) -> None:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    visit_call(sub)
+
+        def handle_assign(targets: List[ast.expr], value: ast.expr) -> None:
+            # RHS first: flag syncs inside it, then propagate taint.
+            pulled = False
+            if isinstance(value, ast.Call):
+                pulled = visit_call(value)
+                for a in value.args:
+                    scan_expr(a)
+                for kw in value.keywords:
+                    scan_expr(kw.value)
+            else:
+                scan_expr(value)
+            rhs_tainted = (not pulled) and expr_tainted(value)
+            names: Set[str] = set()
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+            if rhs_tainted:
+                tainted.update(names)
+            else:
+                tainted.difference_update(names)
+
+        def walk_stmts(stmts: Iterable[ast.stmt]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, ast.Assign):
+                    handle_assign(stmt.targets, stmt.value)
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    handle_assign([stmt.target], stmt.value)
+                elif isinstance(stmt, ast.AugAssign):
+                    handle_assign([stmt.target], stmt.value)
+                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk_stmts(stmt.body)  # closures share the hot path
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    # Iterating a device result taints the loop variable
+                    # (`for row in results: float(row)` is a per-lane sync).
+                    scan_expr(stmt.iter)
+                    names = {n.id for n in ast.walk(stmt.target)
+                             if isinstance(n, ast.Name)}
+                    if expr_tainted(stmt.iter):
+                        tainted.update(names)
+                    else:
+                        tainted.difference_update(names)
+                    walk_stmts(stmt.body)
+                    walk_stmts(stmt.orelse)
+                else:
+                    for child in ast.iter_child_nodes(stmt):
+                        if isinstance(child, ast.stmt):
+                            walk_stmts([child])
+                        elif isinstance(child, ast.expr):
+                            scan_expr(child)
+                        elif isinstance(child, (ast.withitem,
+                                                ast.excepthandler,
+                                                ast.keyword)):
+                            for sub in ast.iter_child_nodes(child):
+                                if isinstance(sub, ast.stmt):
+                                    walk_stmts([sub])
+                                elif isinstance(sub, ast.expr):
+                                    scan_expr(sub)
+
+        walk_stmts(owner.node.body)
+        return findings

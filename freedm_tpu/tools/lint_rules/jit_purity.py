@@ -1,0 +1,177 @@
+"""GL001 — jit purity: host-impure calls inside traced functions.
+
+A function handed to ``jax.jit`` / ``vmap`` / ``shard_map`` or used as
+a ``lax.scan`` / ``while_loop`` / ``cond`` body executes its Python
+exactly once per trace.  A ``time.time()`` or ``np.random`` draw inside
+one silently freezes into the compiled program (the value the first
+trace saw, forever), ``print`` runs only at trace time, ``.item()`` /
+``np.asarray`` force a device sync mid-trace or fail under vmap — the
+exact bug class behind the PR 6 ``stop_gradient`` / vmap-span fixes.
+
+Detection is lexical, matching the contract's wording: any listed
+impure call *lexically inside* a traced function (including nested
+defs) is flagged.  Trace-time constants computed with numpy on static
+arguments are legitimate in rare factory patterns — suppress those
+sites explicitly with ``# gridlint: disable=GL001`` so the exception
+is visible in review.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from freedm_tpu.tools.lint_rules.base import (
+    FileIndex,
+    Finding,
+    ProjectIndex,
+    Rule,
+    attr_chain,
+)
+
+#: Resolved dotted callables whose function-valued arguments are traced
+#: (argument positions that become traced bodies).
+TRACING_CALLS: Dict[str, Tuple[int, ...]] = {
+    "jax.jit": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    # cond(pred, true_fn, false_fn, *operands) / switch(i, branches, *ops):
+    # ONLY the function positions — operands are data, and a Name operand
+    # matching a module-level def must not be dragged in as a traced root.
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.experimental.shard_map.shard_map": (0,),
+    # The repo's own wrapper: jit(shard_map(fn)) over the lane mesh.
+    "freedm_tpu.parallel.mesh.shard_batched": (0,),
+}
+
+#: Decorators that make the decorated function a traced body.  Matched
+#: on the resolved dotted name of the decorator (or of ``partial``'s
+#: first argument).
+TRACING_DECOS = {
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.checkpoint", "jax.remat",
+    "jax.experimental.shard_map.shard_map",
+}
+
+#: Impure callees: exact resolved dotted names.
+IMPURE_EXACT = {
+    "print",
+    "numpy.asarray", "numpy.array",
+    "os.urandom",
+    "uuid.uuid1", "uuid.uuid4",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+#: Impure callees: resolved dotted-name prefixes (module families).
+IMPURE_PREFIX = ("time.", "random.", "numpy.random.")
+
+
+def _dotted_of(fi: FileIndex, node: ast.expr):
+    ch = attr_chain(node)
+    return fi.resolve(ch) if ch else None
+
+
+class JitPurity(Rule):
+    id = "GL001"
+    name = "jit-purity"
+    hint = ("traced bodies run their Python once per trace: hoist host "
+            "work (clocks, RNG, prints, numpy coercions, .item()) out of "
+            "the jit/vmap/scan body; a deliberate trace-time constant "
+            "gets an explicit `# gridlint: disable=GL001`")
+
+    def check(self, project: ProjectIndex) -> Iterable[Finding]:
+        for rel in sorted(project.files):
+            fi = project.files[rel]
+            yield from self._check_file(fi)
+
+    # -- traced-root discovery ----------------------------------------------
+    def _traced_roots(self, fi: FileIndex) -> List[Tuple[ast.AST, str]]:
+        roots: List[Tuple[ast.AST, str]] = []
+        seen: Set[int] = set()
+
+        def add(node: ast.AST, label: str) -> None:
+            if id(node) not in seen:
+                seen.add(id(node))
+                roots.append((node, label))
+
+        by_name: Dict[str, List] = {}
+        for f in fi.funcs:
+            by_name.setdefault(f.name, []).append(f)
+
+        # Decorated definitions.
+        for f in fi.funcs:
+            deco_list = getattr(f.node, "decorator_list", [])
+            for deco in deco_list:
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                dotted = _dotted_of(fi, target)
+                if dotted in TRACING_DECOS:
+                    add(f.node, f.qualname)
+                elif dotted in ("functools.partial", "partial") and \
+                        isinstance(deco, ast.Call) and deco.args:
+                    inner = _dotted_of(fi, deco.args[0])
+                    if inner in TRACING_DECOS:
+                        add(f.node, f.qualname)
+
+        # Call-site arguments of tracing transforms.
+        for call in fi.calls:
+            if call.dotted is None:
+                continue
+            positions = TRACING_CALLS.get(call.dotted)
+            if positions is None:
+                continue
+            for pos in positions:
+                if pos >= len(call.node.args):
+                    continue
+                arg = call.node.args[pos]
+                # lax.switch takes its branches as a sequence.
+                elems = (
+                    arg.elts if isinstance(arg, (ast.List, ast.Tuple))
+                    else [arg]
+                )
+                for el in elems:
+                    if isinstance(el, ast.Lambda):
+                        add(el, f"<lambda>@{call.lineno}")
+                    elif isinstance(el, ast.Name):
+                        for f in by_name.get(el.id, []):
+                            add(f.node, f.qualname)
+                    elif isinstance(el, ast.Attribute):
+                        for f in by_name.get(el.attr, []):
+                            if f.class_name is not None:
+                                add(f.node, f.qualname)
+        return roots
+
+    # -- the lexical purity walk --------------------------------------------
+    def _check_file(self, fi: FileIndex) -> Iterable[Finding]:
+        for root, label in self._traced_roots(fi):
+            for node in ast.walk(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted_of(fi, node.func)
+                bad = None
+                if dotted is not None:
+                    if dotted in IMPURE_EXACT:
+                        bad = dotted
+                    else:
+                        for pre in IMPURE_PREFIX:
+                            if dotted.startswith(pre):
+                                bad = dotted
+                                break
+                if bad is None and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" and not node.args:
+                    bad = ".item()"
+                if bad is not None:
+                    yield self.finding(
+                        fi.rel, node.lineno, node.col_offset,
+                        f"host-impure call `{bad}` inside traced "
+                        f"function `{label}` (jit/vmap/scan bodies must "
+                        f"be trace-pure)",
+                    )

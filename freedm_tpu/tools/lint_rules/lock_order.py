@@ -1,0 +1,359 @@
+"""GL006 — lock-order discipline across the threaded modules.
+
+Sixteen modules now hold ``threading.Lock``/``RLock``/``Condition``
+state (serve, jobs, broker, dcn, devices, core/*), with cross-module
+calls made while holding them (a queue updates a metrics gauge under
+its condition; a job worker bumps counters under its lock).  Nothing
+pins an acquisition order — a new call edge closing a cycle would be a
+deadlock that only fires under production interleavings.
+
+This rule builds the **static lock-acquisition graph**:
+
+- lock identities: ``<file>:<Class>.<attr>`` for ``self.X =
+  threading.Lock()`` (and RLock/Condition) declarations, ``<file>:<name>``
+  for module-level locks, ``<file>:<qualname>.<name>`` for locals;
+- per-function acquired-lock sets (``with self._lock:`` /
+  ``.acquire()``), transitively closed over resolvable calls
+  (``self.method``, module-level singletons — including cross-module
+  ``obs.EVENTS.emit`` / ``tracing.TRACER.start`` style access and
+  ``REGISTRY.counter(...)``-typed metric constants);
+- an edge A→B whenever B is acquired (directly or via a resolvable
+  callee) while A is held.
+
+Findings: cycles in the graph (potential deadlocks), and
+callback-shaped calls (``on_*``, ``*_cb``, ``*callback``, ``sink``)
+invoked while holding a lock — the classic re-entrancy trap (snapshot
+under the lock, call after releasing).  The full graph is exported as
+the ``lock_graph`` artifact (JSON stats / ``run_lint`` API), which the
+``DebugLock`` runtime recorder in the concurrency tests cross-checks
+against observed acquisition order.  Scope: library code (``tests/``
+excluded).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from freedm_tpu.tools.lint_rules.base import (
+    FileIndex,
+    Finding,
+    FuncInfo,
+    ProjectIndex,
+    Rule,
+    attr_chain,
+    find_cycles,
+)
+
+_CALLBACK_SAFE = {"notify", "notify_all", "wait", "set", "clear"}
+
+
+def _is_library(rel: str) -> bool:
+    parts = rel.split("/")
+    return "tests" not in parts and not rel.endswith("bench.py")
+
+
+def _module_dotted(rel: str) -> str:
+    base = rel[:-3] if rel.endswith(".py") else rel
+    if base.endswith("/__init__"):
+        base = base[: -len("/__init__")]
+    return base.replace("/", ".")
+
+
+def _is_callbackish(tail: str) -> bool:
+    bare = tail.lstrip("_")
+    return (bare.startswith("on_") or bare.endswith("_cb")
+            or bare.endswith("callback") or bare == "sink")
+
+
+class LockOrder(Rule):
+    id = "GL006"
+    name = "lock-order"
+    hint = ("pick one global acquisition order and keep it: restructure "
+            "so the inner call happens after releasing (snapshot under "
+            "the lock, act outside it)")
+
+    def __init__(self):
+        self.artifacts: Dict[str, object] = {}
+
+    def check(self, project: ProjectIndex) -> Iterable[Finding]:
+        files = [project.files[r] for r in sorted(project.files)
+                 if _is_library(project.files[r].rel)]
+        if not files:
+            self.artifacts["lock_graph"] = {
+                "locks": [], "modules": [], "edges": [], "cycles": [],
+            }
+            return []
+
+        # -- lock declarations ------------------------------------------------
+        # (file rel, Class, attr) -> lock id; module-level by (rel, name).
+        class_locks: Dict[Tuple[str, str], Dict[str, str]] = {}
+        lock_sites: Dict[str, Tuple[str, int]] = {}
+        for fi in files:
+            for cname, ci in fi.classes.items():
+                for attr, lineno in ci.lock_attrs.items():
+                    lid = f"{fi.rel}:{cname}.{attr}"
+                    class_locks.setdefault((fi.rel, cname), {})[attr] = lid
+                    lock_sites[lid] = (fi.rel, lineno)
+            for name, lineno in fi.module_locks.items():
+                lid = f"{fi.rel}:{name}"
+                lock_sites[lid] = (fi.rel, lineno)
+
+        # -- singleton typing -------------------------------------------------
+        # (file rel, global name) -> (file rel, class name)
+        singleton: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        by_module: Dict[str, FileIndex] = {
+            _module_dotted(fi.rel): fi for fi in files
+        }
+        metrics_fi = None
+        for fi in files:
+            if fi.rel.endswith("core/metrics.py"):
+                metrics_fi = fi
+        for fi in files:
+            for name, call in fi.module_assigns.items():
+                if call.chain is None:
+                    continue
+                if len(call.chain) == 1 and call.chain[0] in fi.classes:
+                    singleton[(fi.rel, name)] = (fi.rel, call.chain[0])
+                elif (metrics_fi is not None and "REGISTRY" in call.chain
+                      and call.tail in ("counter", "gauge", "histogram")):
+                    singleton[(fi.rel, name)] = (
+                        metrics_fi.rel, call.tail.capitalize()
+                    )
+
+        def method_of(file_rel: str, cname: str,
+                      mname: str) -> Optional[FuncInfo]:
+            """Resolve a method, climbing same-file base classes."""
+            fi = project.files.get(file_rel)
+            if fi is None:
+                return None
+            seen: Set[str] = set()
+            stack = [cname]
+            while stack:
+                cn = stack.pop()
+                if cn in seen:
+                    continue
+                seen.add(cn)
+                ci = fi.classes.get(cn)
+                if ci is None:
+                    continue
+                if mname in ci.methods:
+                    return ci.methods[mname]
+                for b in ci.node.bases:
+                    if isinstance(b, ast.Name):
+                        stack.append(b.id)
+            return None
+
+        def resolve_callee(fi: FileIndex, owner: Optional[FuncInfo],
+                           chain: Tuple[str, ...]) -> Optional[FuncInfo]:
+            if not chain:
+                return None
+            if chain[0] == "self" and owner is not None \
+                    and owner.class_name is not None and len(chain) == 2:
+                return method_of(fi.rel, owner.class_name, chain[1])
+            if len(chain) == 1:  # bare call: same-file class constructor
+                ci = fi.classes.get(chain[0])
+                if ci is not None:
+                    return ci.methods.get("__init__")
+                return None
+            # GLOBAL.meth where GLOBAL is a typed singleton of this file
+            # or of an imported module (obs.EVENTS.emit, TRACER.start).
+            if len(chain) == 2:
+                target = singleton.get((fi.rel, chain[0]))
+                if target is None:
+                    dotted = fi.alias.get(chain[0])
+                    if dotted is not None and "." in dotted:
+                        mod, _, gname = dotted.rpartition(".")
+                        mfi = by_module.get(mod)
+                        if mfi is not None:
+                            target = singleton.get((mfi.rel, gname))
+                if target is not None:
+                    return method_of(target[0], target[1], chain[1])
+                return None
+            if len(chain) == 3:
+                mod = fi.alias.get(chain[0], chain[0])
+                mfi = by_module.get(mod)
+                if mfi is not None:
+                    target = singleton.get((mfi.rel, chain[1]))
+                    if target is not None:
+                        return method_of(target[0], target[1], chain[2])
+            return None
+
+        # -- per-function walk: direct locks, calls, held-calls ---------------
+        direct: Dict[int, Set[str]] = {}
+        calls_all: Dict[int, List[FuncInfo]] = {}
+        held_calls: List[Tuple[FuncInfo, Tuple[str, ...], FuncInfo]] = []
+        edges: Set[Tuple[str, str]] = set()
+        findings: List[Finding] = []
+
+        def class_lock_attr(fi: FileIndex, cname: str,
+                            attr: str) -> Optional[str]:
+            """Resolve a ``self.<attr>`` lock, climbing same-file base
+            classes (a subclass method acquiring an inherited lock must
+            land on the declaring class's lock id)."""
+            seen: Set[str] = set()
+            stack = [cname]
+            while stack:
+                cn = stack.pop()
+                if cn in seen:
+                    continue
+                seen.add(cn)
+                lid = class_locks.get((fi.rel, cn), {}).get(attr)
+                if lid is not None:
+                    return lid
+                ci = fi.classes.get(cn)
+                if ci is not None:
+                    for b in ci.node.bases:
+                        if isinstance(b, ast.Name):
+                            stack.append(b.id)
+            return None
+
+        def lock_of(fi: FileIndex, owner: FuncInfo, expr: ast.expr,
+                    locals_: Dict[str, str]) -> Optional[str]:
+            ch = attr_chain(expr)
+            if ch is None:
+                return None
+            if len(ch) == 2 and ch[0] == "self" and owner.class_name:
+                return class_lock_attr(fi, owner.class_name, ch[1])
+            if len(ch) == 1:
+                if ch[0] in locals_:
+                    return locals_[ch[0]]
+                if ch[0] in fi.module_locks:
+                    return f"{fi.rel}:{ch[0]}"
+            return None
+
+        def walk_func(fi: FileIndex, owner: FuncInfo) -> None:
+            locals_: Dict[str, str] = {}
+            my_direct: Set[str] = set()
+            my_calls: List[FuncInfo] = []
+
+            def note_call(node: ast.Call, held: Tuple[str, ...]) -> None:
+                ch = attr_chain(node.func)
+                tail = (ch[-1] if ch else
+                        getattr(node.func, "attr", None)
+                        or getattr(node.func, "id", None))
+                callee = resolve_callee(fi, owner, ch) if ch else None
+                if callee is not None:
+                    my_calls.append(callee)
+                    if held:
+                        held_calls.append((owner, held, callee))
+                if held and tail and tail not in _CALLBACK_SAFE \
+                        and _is_callbackish(tail):
+                    findings.append(self.finding(
+                        fi.rel, node.lineno, node.col_offset,
+                        f"callback-shaped call `{tail}` invoked while "
+                        f"holding {held[-1]} — re-entrancy/deadlock trap; "
+                        f"snapshot under the lock, invoke after release",
+                    ))
+                # .acquire() counts as taking the lock for the edge set.
+                if ch and tail == "acquire":
+                    lid = lock_of(fi, owner, node.func.value, locals_)
+                    if lid is not None:
+                        my_direct.add(lid)
+                        for h in held:
+                            if h != lid:
+                                edges.add((h, lid))
+
+            def scan_expr(node: ast.expr, held: Tuple[str, ...]) -> None:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        note_call(sub, held)
+
+            def walk(stmts, held: Tuple[str, ...]) -> None:
+                for stmt in stmts:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        continue  # nested defs walked as their own funcs
+                    if isinstance(stmt, ast.Assign) and \
+                            isinstance(stmt.value, ast.Call):
+                        ch = attr_chain(stmt.value.func)
+                        d = fi.resolve(ch) if ch else None
+                        if d in ("threading.Lock", "threading.RLock",
+                                 "threading.Condition"):
+                            for t in stmt.targets:
+                                if isinstance(t, ast.Name):
+                                    lid = (f"{fi.rel}:{owner.qualname}"
+                                           f".{t.id}")
+                                    locals_[t.id] = lid
+                                    lock_sites[lid] = (fi.rel, stmt.lineno)
+                    if isinstance(stmt, ast.With):
+                        new_held = held
+                        for item in stmt.items:
+                            scan_expr(item.context_expr, held)
+                            lid = lock_of(fi, owner, item.context_expr,
+                                          locals_)
+                            if lid is not None:
+                                my_direct.add(lid)
+                                for h in new_held:
+                                    if h != lid:
+                                        edges.add((h, lid))
+                                new_held = new_held + (lid,)
+                        walk(stmt.body, new_held)
+                        continue
+                    for child in ast.iter_child_nodes(stmt):
+                        if isinstance(child, ast.stmt):
+                            walk([child], held)
+                        elif isinstance(child, ast.expr):
+                            scan_expr(child, held)
+                        elif isinstance(child, (ast.withitem,
+                                                ast.excepthandler,
+                                                ast.keyword)):
+                            for sub in ast.iter_child_nodes(child):
+                                if isinstance(sub, ast.stmt):
+                                    walk([sub], held)
+                                elif isinstance(sub, ast.expr):
+                                    scan_expr(sub, held)
+
+            walk(owner.node.body, ())
+            direct[id(owner)] = my_direct
+            calls_all[id(owner)] = my_calls
+
+        for fi in files:
+            for f in fi.funcs:
+                if isinstance(f.node, ast.Lambda):
+                    continue
+                walk_func(fi, f)
+
+        # -- transitive acquired-lock sets (bounded fixpoint) -----------------
+        trans: Dict[int, Set[str]] = {
+            k: set(v) for k, v in direct.items()
+        }
+        for _ in range(12):
+            changed = False
+            for k, callees in calls_all.items():
+                cur = trans[k]
+                before = len(cur)
+                for c in callees:
+                    cur |= trans.get(id(c), set())
+                if len(cur) != before:
+                    changed = True
+            if not changed:
+                break
+
+        for owner, held, callee in held_calls:
+            for lid in trans.get(id(callee), ()):
+                for h in held:
+                    if h != lid:
+                        edges.add((h, lid))
+
+        # -- cycles -----------------------------------------------------------
+        adj: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+        cycles = find_cycles(adj)
+        for cyc in cycles:
+            rel, lineno = lock_sites.get(cyc[0], (files[0].rel, 1))
+            findings.append(self.finding(
+                rel, lineno, 0,
+                "lock-order cycle (potential deadlock): "
+                + " -> ".join(cyc + [cyc[0]]),
+            ))
+
+        modules = sorted({lock_sites[lid][0] for lid in lock_sites})
+        self.artifacts["lock_graph"] = {
+            "locks": sorted(lock_sites),
+            "modules": modules,
+            "edges": sorted([list(e) for e in edges]),
+            "cycles": [list(c) for c in cycles],
+        }
+        return findings

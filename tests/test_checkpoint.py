@@ -7,7 +7,6 @@ required addition".  A fleet killed mid-run and restarted with
 
 import json
 import os
-import signal
 import subprocess
 import sys
 import time

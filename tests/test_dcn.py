@@ -138,7 +138,7 @@ class VirtualLink:
         self.in_flight = [x for x in self.in_flight if x[0] > now]
         self.rng.shuffle(due)
         for _, dst, frames in due:
-            self.delivered[dst].extend(self.ends[dst].on_frames(frames, now))
+            self.delivered[dst].extend(self.ends[dst].accept_frames(frames, now))
 
     def run(self, until: float, step=0.01, start=0.0):
         t = start
@@ -224,12 +224,12 @@ def test_unsynced_receiver_triggers_bad_request_resync():
     # Hand-craft a MESSAGE frame arriving before any SYN.
     f = wire.Frame(status=wire.MESSAGE, seq=7, hash="h",
                    msg=wire.pack_message(msg(0)))
-    assert b.on_frames([f], 0.0) == []
+    assert b.accept_frames([f], 0.0) == []
     reply = b.poll(0.0)
     assert any(fr.status == wire.BAD_REQUEST for fr in reply)
     # Sender reacts to BAD_REQUEST with a SYN at the window front.
     a.send(msg(1), 0.0)
-    a.on_frames([fr for fr in reply if fr.status == wire.BAD_REQUEST], 0.0)
+    a.accept_frames([fr for fr in reply if fr.status == wire.BAD_REQUEST], 0.0)
     out = a.poll(0.0)
     assert out[0].status == wire.CREATED
 
@@ -401,16 +401,16 @@ def test_lost_syn_ack_recovers_via_duplicate_reack():
     # resent window clears on the next exchange.
     a, b = SrChannel("b"), SrChannel("a")
     a.send(msg(0), 0.0)
-    b.on_frames(a.poll(0.0), 0.0)
+    b.accept_frames(a.poll(0.0), 0.0)
     b.poll(0.0)  # ACKs generated here are "lost"
     assert a.outstanding == 2  # SYN + message still queued
-    redelivered = b.on_frames(a.poll(0.1), 0.1)  # resent SYN + msg0
+    redelivered = b.accept_frames(a.poll(0.1), 0.1)  # resent SYN + msg0
     assert redelivered == []  # duplicates are not re-delivered...
-    a.on_frames(b.poll(0.1), 0.1)  # ...but they are re-ACKed
+    a.accept_frames(b.poll(0.1), 0.1)  # ...but they are re-ACKed
     assert a.outstanding == 0
     # And the channel keeps working afterwards.
     a.send(msg(1), 0.2)
-    delivered = b.on_frames(a.poll(0.2), 0.2)
+    delivered = b.accept_frames(a.poll(0.2), 0.2)
     assert [m.payload["i"] for m in delivered] == [1]
 
 
@@ -449,9 +449,9 @@ def test_oversize_send_burns_no_sequence_number():
     delivered = []
     for _ in range(4):
         for f in a.poll(0.01):
-            delivered.extend(b.on_frames([f], 0.01))
+            delivered.extend(b.accept_frames([f], 0.01))
         for f in b.poll(0.01):
-            a.on_frames([f], 0.01)
+            a.accept_frames([f], 0.01)
     assert [m.type for m in delivered] == ["ok"]
 
 

@@ -11,7 +11,6 @@ controller re-forms groups with **no** manual ``set_alive`` call.
 import time
 
 import numpy as np
-import pytest
 
 from freedm_tpu.devices.adapters.plant import PlantAdapter
 from freedm_tpu.devices.adapters.pnp import PnpServer

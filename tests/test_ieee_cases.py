@@ -22,7 +22,6 @@ that a single solver's convergence test would miss.
 """
 
 import numpy as np
-import pytest
 
 from freedm_tpu.grid.matpower import (
     builtin_case_names,

@@ -9,7 +9,6 @@ external oracle.
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from freedm_tpu.grid.cases import synthetic_mesh
 from freedm_tpu.grid.matpower import load_builtin

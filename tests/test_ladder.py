@@ -12,7 +12,6 @@ import pytest
 
 from freedm_tpu.grid import cases, from_branch_table, load_dl_mat
 from freedm_tpu.pf import (
-    branch_power_kva,
     load_power_kva,
     make_ladder_solver,
     substation_power_kva,

@@ -24,7 +24,6 @@ hold at these shapes (GEMM tiling is deterministic per shape, so this
 is stable, not flaky).
 """
 
-import tempfile
 
 import jax
 import jax.numpy as jnp

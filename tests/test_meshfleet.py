@@ -2,7 +2,6 @@
 sharded superstep (VERDICT r4 weak #4 — cli.py gains a mesh mode and it
 is the same module the driver dryrun validates)."""
 
-import dataclasses
 import json
 import os
 import subprocess

@@ -214,10 +214,10 @@ def test_sr_channel_counters_under_lossy_link():
             # Deliver only on odd steps: every even-step emission
             # (including the very first) is a datagram the "wire" ate —
             # the sender must retransmit before anything arrives.
-            delivered += b.on_frames(frames, now)
+            delivered += b.accept_frames(frames, now)
             # Duplicate delivery exercises the out-of-window drop path.
-            b.on_frames([f for f in frames if f.msg is not None], now)
-            a.on_frames(b.poll(now), now)
+            b.accept_frames([f for f in frames if f.msg is not None], now)
+            a.accept_frames(b.poll(now), now)
         now += 0.06
         if len(delivered) == 5 and a.outstanding == 0:
             break

@@ -25,7 +25,6 @@ from freedm_tpu.devices.adapters.mqtt import (
     SUBSCRIBE,
     MqttAdapter,
     MqttClient,
-    encode_remaining_length,
     encode_string,
     packet,
     topic_matches,

@@ -21,7 +21,7 @@ from freedm_tpu.devices.adapters.rtds import RtdsAdapter
 from freedm_tpu.devices.factory import AdapterFactory
 from freedm_tpu.devices.manager import DeviceManager
 from freedm_tpu.grid import cases
-from freedm_tpu.sim.plantserver import PlantServer, load_rig
+from freedm_tpu.sim.plantserver import PlantServer
 
 
 def wait_for(cond, timeout=10.0, step=0.01):

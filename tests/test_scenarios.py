@@ -244,3 +244,62 @@ def test_jobs_http_roundtrip(tmp_path):
         srv.stop()
         jm.stop()
         svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# GL006 confirmation: observed lock order vs the static lock graph
+# ---------------------------------------------------------------------------
+
+
+def test_debuglock_jobmanager_order_confirms_gl006_static_graph():
+    # The jobs table bumps qsts_jobs_total{cancelled} while holding its
+    # condition (cancel of a still-queued job).  Instrument both locks
+    # with GL006-named DebugLocks, exercise the path, and assert the
+    # observed order composes acyclically with the static lock graph.
+    import pathlib
+    import threading
+
+    from freedm_tpu.core import metrics as obs
+    from freedm_tpu.core.debuglock import DebugLock, LockOrderRecorder
+    from freedm_tpu.scenarios.jobs import JobManager
+    from freedm_tpu.tools.gridlint import run_lint
+
+    rec = LockOrderRecorder()
+    cond_name = "freedm_tpu/scenarios/jobs.py:JobManager._cond"
+    metric_name = "freedm_tpu/core/metrics.py:_Metric._lock"
+    counter = obs.QSTS_JOBS
+    old_lock = counter._lock
+    dbg_metric = DebugLock(metric_name, recursive=True, recorder=rec)
+    # Deliberately NOT started: the submitted job stays queued, so
+    # cancel() settles it inline — under the instrumented condition.
+    jm = JobManager(workers=1)
+    jm._cond = threading.Condition(lock=DebugLock(cond_name, recorder=rec))
+    try:
+        counter._lock = dbg_metric
+        for child in counter._children.values():
+            child._lock = dbg_metric
+        job = jm.submit({"case": "case14", "scenarios": 2, "steps": 4})
+        out = jm.cancel(job["job_id"])
+        assert out["state"] == "cancelled"
+    finally:
+        counter._lock = old_lock
+        for child in counter._children.values():
+            child._lock = old_lock
+
+    observed = rec.snapshot_edges()
+    assert (cond_name, metric_name) in observed
+    assert (metric_name, cond_name) not in observed
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    # The modules holding every lock these edges can touch (scanning
+    # the subset keeps the static pass fast inside tier-1).
+    static = run_lint(
+        [str(root / "freedm_tpu" / d) for d in ("serve", "scenarios", "core")],
+        root=str(root),
+    )
+    static_edges = {
+        tuple(e) for e in static.artifacts["lock_graph"]["edges"]
+    }
+    # The cancel-path edge is exactly what GL006 derives statically.
+    assert (cond_name, metric_name) in static_edges
+    assert LockOrderRecorder.find_cycle(observed | static_edges) is None

@@ -485,3 +485,75 @@ def test_http_overload_sheds_with_429():
             svc2.submit("pf", {"case": "case14"})
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# GL006 confirmation: observed lock order vs the static lock graph
+# ---------------------------------------------------------------------------
+
+
+def test_debuglock_order_confirms_gl006_static_graph():
+    # Instrument the admission queue's condition and the depth gauge's
+    # metric-family lock with DebugLocks named by GL006's identity
+    # scheme, drive real concurrent traffic, and assert the OBSERVED
+    # acquisition order composes acyclically with gridlint's STATIC
+    # lock graph — the runtime cross-check of the GL006 analysis.
+    import pathlib
+
+    from freedm_tpu.core.debuglock import DebugLock, LockOrderRecorder
+    from freedm_tpu.tools.gridlint import run_lint
+
+    rec = LockOrderRecorder()
+    gauge = M.SERVE_QUEUE_DEPTH
+    old_lock = gauge._lock
+    svc2 = Service(ServeConfig(max_batch=4, max_wait_ms=2.0, queue_depth=64,
+                               buckets=(1, 2, 4)), start=False)
+    cond_name = "freedm_tpu/serve/queue.py:AdmissionQueue._cond"
+    metric_name = "freedm_tpu/core/metrics.py:_Metric._lock"
+    svc2.queue._cond = threading.Condition(
+        lock=DebugLock(cond_name, recorder=rec)
+    )
+    dbg_metric = DebugLock(metric_name, recursive=True, recorder=rec)
+    try:
+        gauge._lock = dbg_metric
+        for child in gauge._children.values():
+            child._lock = dbg_metric
+        svc2.start()
+        threads = [
+            threading.Thread(
+                target=lambda: svc2.request("pf", {"case": "case14"})
+            )
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        svc2.stop()
+        gauge._lock = old_lock
+        for child in gauge._children.values():
+            child._lock = old_lock
+
+    observed = rec.snapshot_edges()
+    assert rec.acquisitions > 0
+    # put()/pop() update the depth gauge UNDER the queue condition:
+    # that nesting must have been observed...
+    assert (cond_name, metric_name) in observed
+    # ...and never the reverse (metrics code calling back into serve).
+    assert (metric_name, cond_name) not in observed
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    # The modules holding every lock these edges can touch (scanning
+    # the subset keeps the static pass fast inside tier-1).
+    static = run_lint(
+        [str(root / "freedm_tpu" / d) for d in ("serve", "scenarios", "core")],
+        root=str(root),
+    )
+    static_edges = {
+        tuple(e) for e in static.artifacts["lock_graph"]["edges"]
+    }
+    union = observed | static_edges
+    assert LockOrderRecorder.find_cycle(union) is None, (
+        "observed lock order contradicts the GL006 static graph"
+    )

@@ -10,7 +10,7 @@ import sys
 import numpy as np
 import pytest
 
-from freedm_tpu.runtime.telemetry import COLUMNS, Telemetry
+from freedm_tpu.runtime.telemetry import Telemetry
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

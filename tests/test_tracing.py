@@ -114,11 +114,11 @@ def test_retransmitted_frame_yields_one_recv_span_linked_to_send(traced):
     a.send(msg(0), 0.0)
     a.poll(0.0)  # first transmission: eaten by the wire
     frames = a.poll(0.1)  # retransmission
-    delivered = b.on_frames(frames, 0.1)
+    delivered = b.accept_frames(frames, 0.1)
     assert [m.payload["i"] for m in delivered] == [0]
     # The same frames arrive again (duplicate datagram): no new span.
-    assert b.on_frames([f for f in frames if f.msg is not None], 0.1) == []
-    a.on_frames(b.poll(0.1), 0.1)  # ACKs retire the window
+    assert b.accept_frames([f for f in frames if f.msg is not None], 0.1) == []
+    a.accept_frames(b.poll(0.1), 0.1)  # ACKs retire the window
     recs = traced.tail()
     sends = [r for r in recs if r["kind"] == "send"]
     recvs = [r for r in recs if r["kind"] == "recv"]
@@ -138,8 +138,8 @@ def test_expired_send_span_is_tagged(traced):
     a = SrChannel("hostB:2", resend_time_s=0.05, ttl_s=0.2, src_uuid="hostA:1")
     b = SrChannel("hostA:1", resend_time_s=0.05, ttl_s=0.2, src_uuid="hostB:2")
     a.send(msg(0), 0.0)
-    b.on_frames(a.poll(0.0), 0.0)  # SYN + msg 0 delivered...
-    a.on_frames(b.poll(0.0), 0.0)  # ...and ACKed: channel synced
+    b.accept_frames(a.poll(0.0), 0.0)  # SYN + msg 0 delivered...
+    a.accept_frames(b.poll(0.0), 0.0)  # ...and ACKed: channel synced
     a.send(msg(1), 0.1)
     a.poll(0.1)  # transmitted once, eaten by the wire
     a.poll(1.0)  # long past the TTL: the message dies at the sender
