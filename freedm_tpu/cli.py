@@ -193,6 +193,18 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--serve-queue-depth", type=int, default=None, metavar="N",
                     help="admission bound in lanes; beyond it requests shed "
                          "with a typed overloaded error (default 512)")
+    ap.add_argument("--serve-pipeline-depth", type=int, default=None,
+                    metavar="N",
+                    help="assembled batches buffered per device-executor "
+                         "lane (pipelined serving: batch N+1 pads while "
+                         "batch N solves; 0 = legacy single-thread "
+                         "dispatch; default 1 = double buffering)")
+    ap.add_argument("--serve-prewarm", action="append", default=None,
+                    metavar="WORKLOAD/CASE",
+                    help="compile every shape bucket of this engine at "
+                         "startup (repeatable, e.g. pf/case14); prewarmed "
+                         "shapes are tagged in /stats and excluded from "
+                         "serve_recompiles_total")
     ap.add_argument("--pf-backend", default=None,
                     choices=("dense", "sparse", "auto"),
                     help="Jacobian backend for the Newton/N-1 power-flow "
@@ -275,6 +287,8 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("serve_port", "serve_port"), ("serve_max_batch", "serve_max_batch"),
         ("serve_max_wait_ms", "serve_max_wait_ms"),
         ("serve_queue_depth", "serve_queue_depth"),
+        ("serve_pipeline_depth", "serve_pipeline_depth"),
+        ("serve_prewarm", "serve_prewarm"),
         ("qsts_workers", "qsts_workers"), ("qsts_max_jobs", "qsts_max_jobs"),
         ("qsts_chunk_steps", "qsts_chunk_steps"),
         ("qsts_checkpoint_dir", "qsts_checkpoint_dir"),
@@ -554,6 +568,8 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             max_batch=cfg.serve_max_batch,
             max_wait_ms=cfg.serve_max_wait_ms,
             queue_depth=cfg.serve_queue_depth,
+            pipeline_depth=cfg.serve_pipeline_depth,
+            prewarm=tuple(cfg.serve_prewarm),
             pf_backend=cfg.pf_backend,
             # --mesh-devices also shards the engines' solver lanes
             # (docs/scaling.md); 0 keeps every engine single-device.
@@ -596,6 +612,13 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         if serve_service is not None:
             b = serve_service.batcher
             slo_monitor.watch("serve.batcher", b.busy, b.progress_age)
+            # Pipelined serving: each device-executor lane beats on its
+            # own, so a stall is attributable to the lane that wedged
+            # (a cold-compiling vvc lane vs a healthy pf lane).
+            for w, lane in sorted(b.lanes.items()):
+                slo_monitor.watch(
+                    f"serve.lane.{w}", lane.busy, lane.progress_age
+                )
         if qsts_jobs is not None:
             slo_monitor.watch(
                 "qsts.worker", qsts_jobs.busy, qsts_jobs.progress_age

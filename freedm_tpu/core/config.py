@@ -272,6 +272,17 @@ class GlobalConfig:
     serve_max_batch: int = 64
     serve_max_wait_ms: float = 2.0
     serve_queue_depth: int = 512
+    # Pipelined dispatch: assembled batches buffered per workload's
+    # device-executor lane (batch N+1 coalesces/pads while batch N
+    # solves; pf/N-1/VVC no longer serialize behind each other).
+    # 0 = the legacy single-thread dispatch path; 1 (default) =
+    # classic double buffering (docs/serving.md).
+    serve_pipeline_depth: int = 1
+    # Engines ("workload/case", repeatable) whose every shape bucket is
+    # compiled at startup, so first-request p99 is a solve rather than
+    # an XLA compile; prewarmed shapes are tagged in /stats and
+    # excluded from serve_recompiles_total.
+    serve_prewarm: List[str] = field(default_factory=list)
     # Jacobian backend for the batched Newton/N-1 power-flow paths
     # (pf/newton.py vs pf/sparse.py): "dense" (hand-assembled [2n,2n]
     # LU), "sparse" (BCSR/segment-sum assembly + pattern-reuse Krylov

@@ -729,6 +729,12 @@ SERVE_RECOMPILES = REGISTRY.counter(
     labels=("workload",))
 SERVE_QUEUE_DEPTH = REGISTRY.gauge(
     "serve_queue_depth", "Lanes admitted but not yet dispatched")
+SERVE_INFLIGHT = REGISTRY.gauge(
+    "serve_inflight_batches",
+    "Assembled batches handed to a device-executor lane but not yet "
+    "scattered (queued + executing, per workload lane; stays 0 on the "
+    "serialized --serve-pipeline-depth 0 path)",
+    labels=("workload",))
 SERVE_BATCH_LANES = REGISTRY.histogram(
     "serve_batch_lanes", "Real (pre-padding) lanes per dispatched batch",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256), labels=("workload",))

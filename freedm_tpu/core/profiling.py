@@ -67,9 +67,11 @@ PROFILE_DEVICE_PEAK = obs.REGISTRY.gauge(
     labels=("workload",))
 PROFILE_HOST_SECONDS = obs.REGISTRY.histogram(
     "profile_host_seconds",
-    "Host-side hot-path wall time between device work (serve.dispatch "
-    "overhead per batch, qsts.chunk_gap between device chunks, "
-    "mesh.shard_put/mesh.gather at the mesh host boundary)",
+    "Host-side hot-path wall time between device work (serve.assemble "
+    "per-batch coalesce/pad on the assembly lane, serve.execute "
+    "scatter overhead on the executor lanes, serve.dispatch per-batch "
+    "overhead on the serialized path, qsts.chunk_gap between device "
+    "chunks, mesh.shard_put/mesh.gather at the mesh host boundary)",
     buckets=(0.0001, 0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 30.0),
     labels=("path",))
 PROFILE_MESH_DEVICES = obs.REGISTRY.gauge(

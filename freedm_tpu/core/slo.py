@@ -33,7 +33,9 @@ against :data:`~freedm_tpu.core.metrics.REGISTRY`:
   events and counted on ``slo_breaches_total{slo=...}``.
 
 - **Watchdog** — registered progress sources (the ``MicroBatcher``
-  dispatch thread, ``JobManager`` workers) are checked for liveness:
+  assembly thread, its per-workload device-executor lanes
+  (``serve.lane.pf``/``n1``/``vvc``), ``JobManager`` workers) are
+  checked for liveness:
   busy with no progress beat for longer than ``--slo-watchdog-s``
   journals ``watchdog.stall`` (once per episode) and counts
   ``watchdog_stalls_total{target=...}``; progress resuming journals
@@ -209,10 +211,15 @@ class SloMonitor:
               age_fn: Callable[[], float]) -> None:
         """Register a progress source: ``busy_fn`` says whether the
         target has work it should be making progress on; ``age_fn``
-        returns seconds since its last progress beat."""
+        returns seconds since its last progress beat.  Re-registering a
+        name replaces its callables (a restarted service's new batcher
+        or executor lane takes over the old watch instead of leaving a
+        dead one alarming forever)."""
+        n = str(name)
         with self._lock:
-            self._watches.append((str(name), busy_fn, age_fn))
-            self._stalled.setdefault(str(name), False)
+            self._watches = [w for w in self._watches if w[0] != n]
+            self._watches.append((n, busy_fn, age_fn))
+            self._stalled.setdefault(n, False)
 
     # -- evaluation ----------------------------------------------------------
     def tick(self, now: Optional[float] = None) -> Dict[str, dict]:

@@ -135,6 +135,10 @@ class AdmissionQueue:
         self._cond = threading.Condition()
         self._fifo: deque = deque()
         self._by_key: Dict[Tuple[str, str], deque] = {}
+        # Round-robin rotation over keys for pop_fair: every key ever
+        # admitted, oldest-served first.  Bounded by the engine cache
+        # (MAX_ENGINES × workloads), so empty keys just get skipped.
+        self._rr: deque = deque()
         self._lanes = 0
         self._closed = False
         self._depth_gauge = depth_gauge
@@ -172,6 +176,7 @@ class AdmissionQueue:
             kq = self._by_key.get(ticket.key)
             if kq is None:
                 kq = self._by_key[ticket.key] = deque()
+                self._rr.append(ticket.key)
             kq.append(ticket)
             self._lanes += ticket.lanes
             self._set_gauge_locked()
@@ -213,6 +218,69 @@ class AdmissionQueue:
                     remaining = None if deadline is None else deadline - now
                     if remaining is not None and remaining <= 0:
                         return None
+                    self._cond.wait(remaining)
+                    continue
+            self._fail_expired(dead)
+            if took is not None:
+                return took
+
+    def pop_fair(self, timeout: Optional[float] = None,
+                 key_ok=None) -> Optional[Ticket]:
+        """Oldest live ticket of the least-recently-served (workload,
+        case) key — round-robin across keys, so one hot tenant cannot
+        starve the others' batch assembly.  ``key_ok(key)`` (optional)
+        gates keys for this pass: the pipelined batcher passes its
+        executor-lane capacity check, so a key whose lane is full is
+        skipped instead of blocking assembly for everyone.  Expired
+        tickets encountered on the way are completed with
+        :class:`DeadlineExceeded` and skipped."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            dead: List[Ticket] = []
+            took = None
+            skipped = False  # a live key was gated off by key_ok
+            with self._cond:
+                now = time.monotonic()
+                # Amortized cleanup of the global index: pop_fair never
+                # reads _fifo, so lazily drop the taken heads here to
+                # keep it from growing without bound.
+                while self._fifo and self._fifo[0].taken:
+                    self._fifo.popleft()
+                for _ in range(len(self._rr)):
+                    key = self._rr[0]
+                    self._rr.rotate(-1)
+                    kq = self._by_key.get(key)
+                    while kq:
+                        t = kq[0]
+                        if t.taken:
+                            kq.popleft()
+                            continue
+                        if t.expired(now):
+                            kq.popleft()
+                            self._take_locked(t)
+                            dead.append(t)
+                            continue
+                        break
+                    if not kq:
+                        continue
+                    if key_ok is not None and not key_ok(key):
+                        skipped = True
+                        continue
+                    t = kq.popleft()
+                    self._take_locked(t)
+                    took = t
+                    break
+                if took is None and not dead:
+                    if self._closed:
+                        return None
+                    remaining = None if deadline is None else deadline - now
+                    if remaining is not None and remaining <= 0:
+                        return None
+                    # A gated key's lane drains without notifying this
+                    # condition — wake on a short bound to re-check.
+                    if skipped:
+                        remaining = 0.05 if remaining is None \
+                            else min(remaining, 0.05)
                     self._cond.wait(remaining)
                     continue
             self._fail_expired(dead)

@@ -259,10 +259,13 @@ class _Engine:
     """Common engine shape the batcher drives.
 
     ``validate`` runs on the submitter's thread (before admission);
-    ``assemble``/``solve``/``scatter`` run on the batcher thread.
-    ``solve`` must block until the result is materialized — the batch's
-    latency accounting and the response's convergence stamps both need
-    host data.
+    ``assemble`` runs on the batch-assembly lane; ``solve``/``scatter``
+    run on the workload's executor lane (or inline on the dispatch
+    thread at ``--serve-pipeline-depth 0``).  ``solve`` must only
+    *dispatch* — it returns the async device result and never blocks;
+    the batcher performs the one deferred ``jax.block_until_ready`` at
+    its measurement boundary, which is what keeps device time honest
+    and lets batch N+1 assemble while batch N solves.
     """
 
     workload = ""
@@ -273,6 +276,11 @@ class _Engine:
         self.compiled_buckets: set = set()
 
     def validate(self, req):  # -> prepared payload (host arrays)
+        raise NotImplementedError
+
+    def example_request(self):
+        """A minimal valid request for this engine — what
+        :meth:`Service.prewarm` pushes through every bucket at startup."""
         raise NotImplementedError
 
     def lanes(self, prepared) -> int:
@@ -341,17 +349,17 @@ class PowerFlowEngine(_Engine):
             )
 
     def solve(self, batch):
-        import jax
-
+        # Dispatch only — the batcher blocks at its own measurement
+        # boundary, so assembly of the next batch overlaps this one.
         p = batch[0]
         if self._mesh_lanes and p.shape[0] % self._mesh_lanes == 0:
-            r = self._batched_mesh(
+            return self._batched_mesh(
                 p_inj=p, q_inj=batch[1], v0=batch[2], theta0=batch[3]
             )
-        else:
-            r = self._batched(*batch)
-        jax.block_until_ready(r.v)
-        return r
+        return self._batched(*batch)
+
+    def example_request(self):
+        return PowerFlowRequest(case=self.case)
 
     def validate(self, req: PowerFlowRequest):
         if not (math.isfinite(req.scale) and 0.0 < req.scale <= 10.0):
@@ -485,11 +493,10 @@ class N1Engine(_Engine):
         return ks
 
     def solve(self, batch):
-        import jax
+        return self._screen(batch)  # dispatch only; the batcher syncs
 
-        r = self._screen(batch)
-        jax.block_until_ready(r.v)
-        return r
+    def example_request(self):
+        return N1Request(case=self.case, outages=[self._secure[0]])
 
     def scatter(self, group: List[Ticket], r, info: BatchInfo) -> None:
         v = np.asarray(r.v)
@@ -584,14 +591,16 @@ class VVCEngine(_Engine):
         return _pad_rows(np.stack([t.prepared["q"] for t in group]), bucket)
 
     def solve(self, batch):
-        import jax
-
+        # Dispatch only; the batcher syncs at its measurement boundary.
         if self._mesh_lanes and batch.shape[0] % self._mesh_lanes == 0:
-            out = self._batched_mesh(jax.numpy.asarray(batch))
-        else:
-            out = self._batched(batch)
-        jax.block_until_ready(out[0])
-        return out
+            import jax
+
+            return self._batched_mesh(jax.numpy.asarray(batch))
+        return self._batched(batch)
+
+    def example_request(self):
+        return VVCRequest(case=self.case,
+                          q_ctrl_kvar=np.zeros((self.nb, 3)))
 
     def scatter(self, group: List[Ticket], out, info: BatchInfo) -> None:
         loss, vmag, conv, residual = out
@@ -682,9 +691,10 @@ class ServeConfig(NamedTuple):
 
     ``max_batch`` bounds lanes per dispatch; ``max_wait_ms`` is the
     coalescing window the batcher holds the first request of a batch
-    open for; ``queue_depth`` is the admission bound in lanes (beyond
-    it, requests shed with ``overloaded``); ``buckets`` defaults to the
-    powers of two up to ``max_batch``.
+    open for (adaptive: a lone request with an empty queue behind it
+    skips the window); ``queue_depth`` is the admission bound in lanes
+    (beyond it, requests shed with ``overloaded``); ``buckets``
+    defaults to the powers of two up to ``max_batch``.
     """
 
     max_batch: int = 64
@@ -708,6 +718,18 @@ class ServeConfig(NamedTuple):
     # small recognized cases on the measured-faster dense path while
     # client-named meshN scale tenants get the sparse one.
     pf_backend: str = "auto"
+    # Pipelined dispatch (CLI: --serve-pipeline-depth): assembled
+    # batches buffered per workload's device-executor lane, so batch
+    # N+1 coalesces/pads while batch N solves and pf/n1/vvc no longer
+    # serialize behind each other.  0 = legacy single-thread dispatch
+    # (the equivalence oracle; docs/serving.md).  1 = classic double
+    # buffering (one batch executing + one buffered), the default.
+    pipeline_depth: int = 1
+    # Engines to compile at startup (CLI: --serve-prewarm, repeatable):
+    # "workload/case" entries; every bucket of each named engine is
+    # compiled before the first request, tagged in /stats
+    # recompiles_by_bucket and excluded from serve_recompiles_total.
+    prewarm: Tuple[str, ...] = ()
 
     def bucket_table(self) -> Tuple[int, ...]:
         bs = self.buckets if self.buckets else default_buckets(self.max_batch)
@@ -740,6 +762,11 @@ class Service:
                 f"unknown pf_backend {config.pf_backend!r} "
                 f"(have: {', '.join(BACKENDS)})"
             )
+        if config.pipeline_depth < 0:
+            raise ValueError(
+                f"pipeline_depth must be >= 0 (0 = serialized dispatch), "
+                f"got {config.pipeline_depth}"
+            )
         self.config = config
         # The solver-lane mesh every engine shards over (None =
         # unsharded); built once so all engines share one device set.
@@ -770,6 +797,16 @@ class Service:
         self.batcher = MicroBatcher(self, config)
         if start:
             self.batcher.start()
+        if config.prewarm:
+            # Synchronous by design: startup pays the compile storm so
+            # the first request's p99 is a solve, not an XLA compile.
+            try:
+                self.prewarm(config.prewarm)
+            except BaseException:
+                # The constructor won't return, so nobody could call
+                # stop() — don't leak the assembly/executor threads.
+                self.batcher.stop()
+                raise
 
     # -- engine cache --------------------------------------------------------
     def engine(self, workload: str, case: str) -> _Engine:
@@ -812,6 +849,39 @@ class Service:
             with self._engines_lock:
                 self._engines[key] = eng
             return eng
+
+    # -- prewarm (startup compile of configured engines) ---------------------
+    def prewarm(self, specs: Sequence[str]) -> List[str]:
+        """Compile every bucket of each ``"workload/case"`` engine named
+        in ``specs`` before traffic arrives (CLI: ``--serve-prewarm``).
+
+        Each compiled shape is recorded via the batcher's prewarm table
+        (tagged in ``/stats`` ``recompiles_by_bucket`` with count 0) and
+        never counts on ``serve_recompiles_total`` — the recompile
+        counter stays a *steady-state surprise* signal.  Returns the
+        ``"workload/case:bucket"`` keys compiled."""
+        import jax
+
+        done: List[str] = []
+        for spec in specs:
+            workload, sep, case = str(spec).partition("/")
+            if not sep or not case:
+                raise InvalidRequest(
+                    f"prewarm spec must be 'workload/case', got {spec!r}"
+                )
+            eng = self.engine(workload, case)
+            req = eng.example_request()
+            prepared = eng.validate(req)
+            lanes = eng.lanes(prepared)
+            for bucket in self.config.bucket_table():
+                if bucket in eng.compiled_buckets or lanes > bucket:
+                    continue
+                t = Ticket(eng.key, req, prepared, lanes, None)
+                out = eng.solve(eng.assemble([t], bucket))
+                jax.block_until_ready(out)
+                self.batcher.note_prewarmed(eng, bucket)
+                done.append(f"{workload}/{case}:{bucket}")
+        return done
 
     # -- submission ----------------------------------------------------------
     def submit(self, workload: str, request):
@@ -954,17 +1024,27 @@ class Service:
             "max_wait_ms": self.config.max_wait_ms,
             "mesh_devices": _mesh_lanes(self.mesh) or 1,
             "pf_backend": self.config.pf_backend,
+            # Pipeline shape: buffered batches per executor lane (0 =
+            # the serialized single-thread path) + live lane state.
+            "pipeline_depth": self.config.pipeline_depth,
+            "executor_lanes": {
+                w: {"queued": lane.queued(), "busy": lane.busy()}
+                for w, lane in sorted(self.batcher.lanes.items())
+            },
+            # Shapes compiled at startup (--serve-prewarm): present in
+            # the recompiles table at count 0, excluded from the
+            # serve_recompiles_total counter.
+            "prewarmed": sorted(self.batcher.prewarmed),
             "requests": metric("serve_requests_total"),
             "shed": metric("serve_shed_total"),
             "recompiles": metric("serve_recompiles_total"),
             # Per-shape compile attribution ("workload/case:bucket" ->
             # first dispatches of that shape): the aggregate counter
-            # above says a storm happened, this table says WHO.
-            # .copy() first: the dispatch thread inserts keys while a
-            # /stats handler iterates, and a GIL-atomic snapshot beats
-            # a "dict changed size" 500 mid-recompile-storm.
+            # above says a storm happened, this table says WHO.  The
+            # snapshot is taken under the batcher's shapes lock, so a
+            # /stats read mid-recompile-storm sees a consistent table.
             "recompiles_by_bucket": dict(
-                sorted(self.batcher.recompiles_by_bucket.copy().items())
+                sorted(self.batcher.shape_table().items())
             ),
             "batch_lanes": metric("serve_batch_lanes"),
             "queue_wait_seconds": metric("serve_queue_wait_seconds"),

@@ -1,18 +1,19 @@
 """GL002 — hot-path syncs: implicit device syncs in the serving and
 QSTS dispatch loops.
 
-The serve dispatch thread, the QSTS chunk loop, and the broker round
-loop are the paths where one stray ``float(result[...])`` or
-``.item()`` turns an async device dispatch into a synchronous
-round-trip — the latency cliff the micro-batcher exists to avoid.
-These paths are *declared* in :data:`HOT_PATHS` (the hot-path
-registry): each entry names a function, where device values enter it
-(parameters and/or ``.solve()``-style calls), and which sync
-primitives it is *allowed* to use because it IS the designed
-measurement/pull point (``engine.solve``'s ``block_until_ready`` is
-how ``serve_solve_seconds`` stays honest; ``scatter``'s one
-``np.asarray`` per result field is the designed single device→host
-transfer).
+The serve pipeline's lanes (assembly + per-workload executors), the
+QSTS chunk loop, and the broker round loop are the paths where one
+stray ``float(result[...])`` or ``.item()`` turns an async device
+dispatch into a synchronous round-trip — the latency cliff the
+micro-batcher exists to avoid.  These paths are *declared* in
+:data:`HOT_PATHS` (the hot-path registry): each entry names a
+function, where device values enter it (parameters and/or
+``.solve()``-style calls), and which sync primitives it is *allowed*
+to use because it IS the designed measurement/pull point (the
+executor-side ``MicroBatcher._execute``'s deferred
+``block_until_ready`` is how ``serve_solve_seconds`` stays honest;
+``scatter``'s one ``np.asarray`` per result field is the designed
+single device→host transfer).
 
 Within a registered function the rule walks statements in source
 order, tracking which names are device-derived ("tainted"): sources
@@ -62,22 +63,30 @@ class HotPath:
 
 
 HOT_PATHS: Tuple[HotPath, ...] = (
-    # serve dispatch loop: device results flow out of engine.solve and
-    # must reach the engine's scatter untouched.
-    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._run",
-            source_calls=("solve",)),
-    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._dispatch",
-            source_calls=("solve",)),
-    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._dispatch_inner",
-            source_calls=("solve",)),
-    # Engine solve(): the one designed block_until_ready (the batcher
-    # times it as serve_solve_seconds / the compile account).
-    HotPath("freedm_tpu/serve/service.py", "PowerFlowEngine.solve",
+    # serve pipeline, stage 1 — the assembly lane: coalescing loop and
+    # host-side assemble.  Pure host work: NO device value may be
+    # pulled or synced here, ever (the whole point of the pipeline is
+    # that assembly overlaps device execution).
+    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._run"),
+    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._run_serial"),
+    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._run_pipelined"),
+    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._dispatch"),
+    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._assemble"),
+    # serve pipeline, stage 2 — the device-executor side: device
+    # results flow out of engine.solve; the ONE designed deferred
+    # jax.block_until_ready lives in MicroBatcher._execute (it is the
+    # serve_solve_seconds / compile-account measurement boundary, on
+    # both the pipelined and the --serve-pipeline-depth 0 path).
+    HotPath("freedm_tpu/serve/batcher.py", "ExecutorLane._run"),
+    HotPath("freedm_tpu/serve/batcher.py", "MicroBatcher._execute",
+            source_calls=("solve",),
             allow=frozenset({"block_until_ready"})),
-    HotPath("freedm_tpu/serve/service.py", "N1Engine.solve",
-            allow=frozenset({"block_until_ready"})),
-    HotPath("freedm_tpu/serve/service.py", "VVCEngine.solve",
-            allow=frozenset({"block_until_ready"})),
+    # Engine solve(): dispatch-only since the pipeline split — any
+    # block_until_ready inside an engine would serialize the assembly
+    # lane's overlap and is a finding.
+    HotPath("freedm_tpu/serve/service.py", "PowerFlowEngine.solve"),
+    HotPath("freedm_tpu/serve/service.py", "N1Engine.solve"),
+    HotPath("freedm_tpu/serve/service.py", "VVCEngine.solve"),
     # Engine scatter(): the one designed device->host pull per result
     # field; everything after the np.asarray is host numpy.
     HotPath("freedm_tpu/serve/service.py", "PowerFlowEngine.scatter",

@@ -42,8 +42,11 @@ def _post(port: int, path: str, payload: dict) -> Tuple[int, dict]:
 def main(argv: Optional[List[str]] = None) -> int:
     from freedm_tpu.serve import ServeConfig, ServeServer, Service
 
+    # Pipeline explicitly on (the default, double-buffered shape CI
+    # must exercise): an assembly lane feeding per-workload executor
+    # lanes.
     svc = Service(ServeConfig(max_batch=8, max_wait_ms=10.0,
-                              buckets=(1, 4, 8)))
+                              buckets=(1, 4, 8), pipeline_depth=1))
     srv = ServeServer(svc, port=0).start()
     print(f"[serve-smoke] server on port {srv.port}", flush=True)
     failures: List[str] = []
@@ -96,6 +99,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             stats = json.loads(r.read())
         ok("stats_served", bool(stats["engines"]),
            f"engines={stats['engines']}")
+        ok("stats_pipeline", stats["pipeline_depth"] == 1
+           and set(stats["executor_lanes"]) == {"pf", "n1", "vvc"},
+           f"depth={stats['pipeline_depth']} "
+           f"lanes={sorted(stats['executor_lanes'])}")
     finally:
         srv.stop()
         svc.stop()

@@ -95,31 +95,61 @@ def test_gl001_clean_fixture_passes(tmp_path):
 # ---------------------------------------------------------------------------
 
 GL002_BAD = """
+    class ExecutorLane:
+        def _run(self):
+            pass
+
     class MicroBatcher:
         def _run(self):
+            pass
+
+        def _run_serial(self):
+            pass
+
+        def _run_pipelined(self):
             pass
 
         def _dispatch(self, group, lanes):
             pass
 
-        def _dispatch_inner(self, group, lanes):
+        def _assemble(self, group, lanes):
+            batch.block_until_ready()       # assembly lane must not sync
+
+        def _execute(self, work):
             results = engine.solve(batch)
-            worst = float(results[0])       # device sync mid-dispatch
+            worst = float(results[0])       # device sync before the boundary
             x = results.item()              # device sync
-            results.block_until_ready()     # not an allowed sync point here
             return worst
 """
 
 GL002_CLEAN = """
+    import jax
+
+
+    class ExecutorLane:
+        def _run(self):
+            pass
+
     class MicroBatcher:
         def _run(self):
+            pass
+
+        def _run_serial(self):
+            pass
+
+        def _run_pipelined(self):
             pass
 
         def _dispatch(self, group, lanes):
             pass
 
-        def _dispatch_inner(self, group, lanes):
+        def _assemble(self, group, lanes):
+            batch = engine.assemble(group, bucket)  # host numpy only
+            return batch
+
+        def _execute(self, work):
             results = engine.solve(batch)
+            jax.block_until_ready(results)  # THE designed deferred sync
             engine.scatter(group, results, info)  # results stay on device
             queue_ms = float(123)  # host arithmetic is fine
 """
@@ -579,14 +609,27 @@ def test_gl006_inherited_lock_resolves_to_declaring_class(tmp_path):
 
 def test_gl002_for_loop_over_device_result_taints_target(tmp_path):
     _write(tmp_path, "freedm_tpu/serve/batcher.py", """
+        class ExecutorLane:
+            def _run(self):
+                pass
+
         class MicroBatcher:
             def _run(self):
+                pass
+
+            def _run_serial(self):
+                pass
+
+            def _run_pipelined(self):
                 pass
 
             def _dispatch(self, group, lanes):
                 pass
 
-            def _dispatch_inner(self, group, lanes):
+            def _assemble(self, group, lanes):
+                pass
+
+            def _execute(self, work):
                 results = engine.solve(batch)
                 out = []
                 for row in results:

@@ -27,7 +27,7 @@ from freedm_tpu.serve import (
     default_buckets,
     parse_request,
 )
-from freedm_tpu.serve.queue import AdmissionQueue, Ticket
+from freedm_tpu.serve.queue import AdmissionQueue, ServeError, Ticket
 from freedm_tpu.serve.service import (
     N1Request,
     PowerFlowRequest,
@@ -485,6 +485,361 @@ def test_http_overload_sheds_with_429():
             svc2.submit("pf", {"case": "case14"})
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# pipelined serving (ISSUE 9): executor lanes vs the serialized oracle
+# ---------------------------------------------------------------------------
+
+
+def _strip_batch(resp) -> str:
+    """Canonical JSON of a response minus the batch receipt (whose
+    queue/solve timings and coalescing-dependent lanes/bucket fields
+    legitimately differ between runs)."""
+    d = resp.to_dict()
+    d.pop("batch")
+    return json.dumps(d, sort_keys=True)
+
+
+def _mixed_jobs(svc):
+    """A deterministic mixed pf/n1/vvc job set (typed records)."""
+    eng = svc.engine("n1", "case14")
+    nb = svc.engine("vvc", "vvc_9bus").nb
+    sec = list(eng._secure)
+    return (
+        [("pf", PowerFlowRequest(case="case14", scale=s, return_state=True))
+         for s in (0.9, 1.0, 1.1, 1.05)]
+        + [("n1", N1Request(case="case14", outages=sec[:2])),
+           ("n1", N1Request(case="case14", outages=sec[2:3]))]
+        + [("vvc", VVCRequest(case="vvc_9bus",
+                              q_ctrl_kvar=np.full((nb, 3), q)))
+           for q in (0.0, 100.0, -150.0)]
+    )
+
+
+def _run_concurrent(svc, jobs, timeout_s=300):
+    barrier = threading.Barrier(len(jobs))
+    results = [None] * len(jobs)
+    errors = []
+
+    def worker(i, workload, req):
+        try:
+            barrier.wait(timeout=60)
+            results[i] = svc.request(workload, req, timeout_s=timeout_s)
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=worker, args=(i, w, r))
+               for i, (w, r) in enumerate(jobs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s)
+    assert not errors, errors
+    assert all(r is not None for r in results)
+    return results
+
+
+def test_pipeline_matches_serialized_byte_identical():
+    """The ISSUE 9 equivalence contract: concurrent mixed pf/n1/vvc
+    served by the pipelined path (per-engine executor lanes, depth 2)
+    and by ``--serve-pipeline-depth 0`` (the legacy single-thread
+    oracle) produce byte-identical responses, whatever batch
+    composition the two schedulers happened to coalesce (the single
+    fixed bucket keeps every batch at one compiled shape, so per-lane
+    results cannot depend on who shared the batch)."""
+    cfg = dict(max_batch=4, max_wait_ms=25.0, queue_depth=64, buckets=(4,))
+    svc_pipe = Service(ServeConfig(pipeline_depth=2, **cfg))
+    svc_ser = Service(ServeConfig(pipeline_depth=0, **cfg))
+    try:
+        assert set(svc_pipe.batcher.lanes) == {"pf", "n1", "vvc"}
+        assert svc_ser.batcher.lanes == {}
+        jobs = _mixed_jobs(svc_pipe)
+        got_pipe = [_strip_batch(r) for r in _run_concurrent(svc_pipe, jobs)]
+        got_ser = [_strip_batch(r) for r in _run_concurrent(svc_ser, jobs)]
+        assert got_pipe == got_ser
+        # And the pipelined service's stats surface names its lanes.
+        st = svc_pipe.stats()
+        assert st["pipeline_depth"] == 2
+        assert set(st["executor_lanes"]) == {"pf", "n1", "vvc"}
+    finally:
+        svc_pipe.stop()
+        svc_ser.stop()
+
+
+def test_pipeline_ordered_per_ticket_completion():
+    """Same-workload tickets complete in submission order: batches run
+    FIFO through the workload's single executor lane, and the scatter
+    loop resolves a batch's futures in group (= pop) order."""
+    svc2 = Service(ServeConfig(max_batch=2, max_wait_ms=5.0, queue_depth=64,
+                               buckets=(1, 2), pipeline_depth=2))
+    try:
+        order = []
+        lock = threading.Lock()
+
+        def tag(i):
+            def cb(fut):
+                if fut.exception() is None:
+                    with lock:
+                        order.append(i)
+            return cb
+
+        futs = []
+        for i in range(8):
+            f = svc2.submit("pf", {"case": "case14", "timeout_s": 300})
+            f.add_done_callback(tag(i))
+            futs.append(f)
+        for f in futs:
+            f.result(timeout=300)
+        assert order == sorted(order), order
+    finally:
+        svc2.stop()
+
+
+def test_executor_lane_crash_fails_only_its_batch():
+    """A solver exception on one executor lane fails only that batch's
+    tickets with the typed ``internal`` error; the assembly lane and
+    the other lanes keep serving."""
+    svc2 = Service(ServeConfig(max_batch=4, max_wait_ms=2.0, queue_depth=64,
+                               buckets=(1, 2, 4), pipeline_depth=2))
+    try:
+        nb = svc2.engine("vvc", "vvc_9bus").nb
+        veng = svc2.engine("vvc", "vvc_9bus")
+        real_solve = veng.solve
+        veng.solve = lambda batch: (_ for _ in ()).throw(
+            RuntimeError("injected lane crash")
+        )
+        with pytest.raises(ServeError) as ei:
+            svc2.request("vvc", {"case": "vvc_9bus",
+                                 "q_ctrl_kvar": np.zeros((nb, 3)).tolist()})
+        assert ei.value.code == "internal"
+        # The failed first dispatch must not mark its bucket compiled:
+        # the retry below re-claims the shape, so the real compile
+        # keeps its jit_compile tag and compile-account entry.
+        assert 1 not in veng.compiled_buckets
+        # The crash was contained: the vvc lane thread survived and the
+        # assembly lane still feeds the other lanes.
+        assert svc2.batcher.lanes["vvc"]._thread.is_alive()
+        r = svc2.request("pf", {"case": "case14"})
+        assert r.converged
+        veng.solve = real_solve
+        r2 = svc2.request("vvc", {"case": "vvc_9bus",
+                                  "q_ctrl_kvar": np.zeros((nb, 3)).tolist()})
+        assert r2.converged
+    finally:
+        svc2.stop()
+
+
+def test_watchdog_stall_detection_per_lane():
+    """Each executor lane is its own watchdog target: a pf solve wedged
+    on its lane trips ``watchdog.stall`` for serve.lane.pf (not for the
+    assembly thread or the idle lanes), and recovers once it beats."""
+    from freedm_tpu.core import metrics as obs
+    from freedm_tpu.core.slo import SloConfig, SloMonitor
+
+    journal = obs.JsonlEventJournal()
+    mon = SloMonitor(SloConfig(watchdog_s=0.05), journal=journal)
+    svc2 = Service(ServeConfig(max_batch=2, max_wait_ms=2.0, queue_depth=64,
+                               buckets=(1, 2), pipeline_depth=1))
+    try:
+        # Warm the engine/bucket first so the stall below is the gate,
+        # not an XLA compile.
+        svc2.request("pf", {"case": "case14"})
+        b = svc2.batcher
+        for w, lane in b.lanes.items():
+            mon.watch(f"serve.lane.{w}", lane.busy, lane.progress_age)
+
+        eng = svc2.engine("pf", "case14")
+        gate = threading.Event()
+        real_solve = eng.solve
+
+        def stuck_solve(batch):
+            gate.wait(timeout=30)
+            return real_solve(batch)
+
+        eng.solve = stuck_solve
+        fut = svc2.submit("pf", {"case": "case14", "timeout_s": 300})
+        deadline = time.monotonic() + 10
+        while not b.lanes["pf"].busy() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.2)  # past the 50 ms watchdog limit
+        mon.tick()
+        stalls = [e for e in journal.tail()
+                  if e["event"] == "watchdog.stall"]
+        assert [e["target"] for e in stalls] == ["serve.lane.pf"]
+        gate.set()
+        fut.result(timeout=300)
+        # The future resolves from scatter while the lane is still
+        # inside _execute's completion accounting — poll the monitor
+        # until the lane's fresh beat lands instead of racing it.
+        deadline = time.monotonic() + 10
+        rec = []
+        while not rec and time.monotonic() < deadline:
+            mon.tick()
+            rec = [e for e in journal.tail()
+                   if e["event"] == "watchdog.recovered"]
+            if not rec:
+                time.sleep(0.02)
+        assert [e["target"] for e in rec] == ["serve.lane.pf"]
+    finally:
+        svc2.stop()
+
+
+def test_adaptive_coalescing_skips_empty_window():
+    """ISSUE 9 satellite: a lone request with an empty queue behind it
+    dispatches immediately instead of sleeping out ``max_wait_ms`` —
+    the flat low-load latency tax is gone.  Window set far above any
+    solve time so the old behavior would be unmissable."""
+    svc2 = Service(ServeConfig(max_batch=4, max_wait_ms=400.0,
+                               queue_depth=64, buckets=(1, 2, 4),
+                               pipeline_depth=2))
+    try:
+        svc2.request("pf", {"case": "case14"})  # compile the shape
+        t0 = time.monotonic()
+        r = svc2.request("pf", {"case": "case14"})
+        latency = time.monotonic() - t0
+        assert r.converged
+        # Old loop: >= 0.4 s (the full window).  Adaptive: a warm solve
+        # plus scheduling noise, far under half the window.
+        assert latency < 0.2, f"lone ticket waited the window: {latency}"
+    finally:
+        svc2.stop()
+
+
+def test_prewarm_compiles_buckets_and_excludes_recompile_counter():
+    """ISSUE 9 satellite: ``--serve-prewarm`` compiles every bucket of
+    the named engine at startup; the shapes show up tagged (count 0) in
+    /stats ``recompiles_by_bucket`` + ``prewarmed`` and serving them
+    never moves ``serve_recompiles_total``."""
+    rec = M.REGISTRY.get("serve_recompiles_total")
+    before = rec.labels("pf").value
+    svc2 = Service(ServeConfig(max_batch=2, max_wait_ms=2.0, queue_depth=64,
+                               buckets=(1, 2), pipeline_depth=2,
+                               prewarm=("pf/case14",)))
+    try:
+        assert rec.labels("pf").value == before  # prewarm never counts
+        st = svc2.stats()
+        assert st["prewarmed"] == ["pf/case14:1", "pf/case14:2"]
+        assert st["recompiles_by_bucket"] == {"pf/case14:1": 0,
+                                              "pf/case14:2": 0}
+        r = svc2.request("pf", {"case": "case14"})
+        assert r.converged
+        # Serving a prewarmed shape is a cache hit, not a recompile.
+        assert rec.labels("pf").value == before
+        assert svc2.stats()["recompiles_by_bucket"]["pf/case14:1"] == 0
+        with pytest.raises(InvalidRequest):
+            svc2.prewarm(("bogus-spec",))
+    finally:
+        svc2.stop()
+    # A failing prewarm spec at CONSTRUCTION must not leak the already
+    # started assembly/executor threads (the constructor never returns,
+    # so nobody could stop them).
+    before = {t for t in threading.enumerate()}
+    with pytest.raises(InvalidRequest):
+        Service(ServeConfig(max_batch=2, buckets=(1, 2),
+                            prewarm=("pf/no_such_case",)))
+    leaked = [t for t in set(threading.enumerate()) - before
+              if t.is_alive()
+              and t.name.startswith(("serve-batcher", "serve-exec"))]
+    assert not leaked, leaked
+
+
+def test_trace_parentage_survives_thread_handoff():
+    """The serve.request → serve.batch → pf.solve span chain keeps its
+    parentage across the assembly→executor thread handoff: the batch
+    span opens on the assembly lane (parented to the request span's
+    wire context) and the solve span opens on the executor lane inside
+    the batch span's activation."""
+    from freedm_tpu.core import tracing
+
+    tracing.TRACER.configure(enabled=True, node="pipeline-test")
+    svc2 = Service(ServeConfig(max_batch=2, max_wait_ms=2.0, queue_depth=64,
+                               buckets=(1, 2), pipeline_depth=1))
+    try:
+        r = svc2.request("pf", {"case": "case14"})
+        assert r.converged
+        # The request span ends in _complete_ok AFTER the future
+        # resolves — poll the flight recorder briefly.
+        deadline = time.monotonic() + 10
+        req = None
+        while req is None and time.monotonic() < deadline:
+            recs = tracing.TRACER.tail(200)
+            reqs = [x for x in recs if x.get("name") == "serve.request"]
+            if reqs:
+                req = reqs[-1]
+            else:
+                time.sleep(0.01)
+        assert req is not None
+        chain = [x for x in tracing.TRACER.tail(200)
+                 if x.get("trace_id") == req["trace_id"]]
+        batch = next(x for x in chain if x["name"] == "serve.batch")
+        solve = next(x for x in chain if x["name"] == "pf.solve:pf")
+        assert batch["parent_id"] == req["span_id"]
+        assert solve["parent_id"] == batch["span_id"]
+        assert solve["tags"]["jit_compile"] in (True, False)
+    finally:
+        svc2.stop()
+        tracing.TRACER.reset()
+
+
+def test_debuglock_order_pipeline_shapes_lock():
+    """GL006 cross-check for the pipeline's new lock: the batcher's
+    ``_shapes_lock`` (shape claims from the assembly lane vs /stats
+    readers) composes acyclically with the observed admission-queue
+    condition edges and gridlint's static lock graph."""
+    import pathlib
+
+    from freedm_tpu.core.debuglock import DebugLock, LockOrderRecorder
+    from freedm_tpu.tools.gridlint import run_lint
+
+    rec = LockOrderRecorder()
+    cond_name = "freedm_tpu/serve/queue.py:AdmissionQueue._cond"
+    shapes_name = "freedm_tpu/serve/batcher.py:MicroBatcher._shapes_lock"
+    svc2 = Service(ServeConfig(max_batch=4, max_wait_ms=2.0, queue_depth=64,
+                               buckets=(1, 2, 4), pipeline_depth=2),
+                   start=False)
+    svc2.queue._cond = threading.Condition(
+        lock=DebugLock(cond_name, recorder=rec)
+    )
+    svc2.batcher._shapes_lock = DebugLock(shapes_name, recorder=rec)
+    try:
+        svc2.start()
+        threads = [
+            threading.Thread(
+                target=lambda: svc2.request("pf", {"case": "case14"})
+            )
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        # /stats takes the shapes lock from a reader thread while the
+        # pipeline claims shapes — the canonical concurrent access.
+        svc2.stats()
+    finally:
+        svc2.stop()
+
+    observed = rec.snapshot_edges()
+    assert rec.acquisitions > 0
+    # The shape claim happens OUTSIDE the queue condition and never
+    # takes it back: no edge in either direction may exist.
+    assert (shapes_name, cond_name) not in observed
+    assert (cond_name, shapes_name) not in observed
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    static = run_lint(
+        [str(root / "freedm_tpu" / d) for d in ("serve", "scenarios", "core")],
+        root=str(root),
+    )
+    static_edges = {
+        tuple(e) for e in static.artifacts["lock_graph"]["edges"]
+    }
+    union = observed | static_edges
+    from freedm_tpu.core.debuglock import LockOrderRecorder as _R
+    assert _R.find_cycle(union) is None, (
+        "observed pipeline lock order contradicts the GL006 static graph"
+    )
 
 
 # ---------------------------------------------------------------------------
