@@ -205,6 +205,23 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "startup (repeatable, e.g. pf/case14); prewarmed "
                          "shapes are tagged in /stats and excluded from "
                          "serve_recompiles_total")
+    ap.add_argument("--serve-cache-mb", type=float, default=None,
+                    metavar="MB",
+                    help="incremental serving tier budget: cached base-case "
+                         "solutions + reusable factorizations, byte-"
+                         "accounted with LRU+TTL eviction (0 disables; "
+                         "default 64). Identical pf injections answer from "
+                         "cache, small deltas via verified SMW correction, "
+                         "the rest warm-start (docs/serving.md)")
+    ap.add_argument("--serve-cache-ttl-s", type=float, default=None,
+                    metavar="S",
+                    help="age past which cached serving solutions are "
+                         "evicted at next touch (default 600)")
+    ap.add_argument("--serve-delta-max-rank", type=int, default=None,
+                    metavar="K",
+                    help="largest changed-bus count the serving delta tier "
+                         "attempts a rank-update correction for before "
+                         "falling back to warm-start seeding (default 16)")
     ap.add_argument("--pf-backend", default=None,
                     choices=("dense", "sparse", "auto"),
                     help="Jacobian backend for the Newton/N-1 power-flow "
@@ -289,6 +306,9 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("serve_queue_depth", "serve_queue_depth"),
         ("serve_pipeline_depth", "serve_pipeline_depth"),
         ("serve_prewarm", "serve_prewarm"),
+        ("serve_cache_mb", "serve_cache_mb"),
+        ("serve_cache_ttl_s", "serve_cache_ttl_s"),
+        ("serve_delta_max_rank", "serve_delta_max_rank"),
         ("qsts_workers", "qsts_workers"), ("qsts_max_jobs", "qsts_max_jobs"),
         ("qsts_chunk_steps", "qsts_chunk_steps"),
         ("qsts_checkpoint_dir", "qsts_checkpoint_dir"),
@@ -570,6 +590,9 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             queue_depth=cfg.serve_queue_depth,
             pipeline_depth=cfg.serve_pipeline_depth,
             prewarm=tuple(cfg.serve_prewarm),
+            cache_mb=cfg.serve_cache_mb,
+            cache_ttl_s=cfg.serve_cache_ttl_s,
+            delta_max_rank=cfg.serve_delta_max_rank,
             pf_backend=cfg.pf_backend,
             # --mesh-devices also shards the engines' solver lanes
             # (docs/scaling.md); 0 keeps every engine single-device.

@@ -283,6 +283,19 @@ class GlobalConfig:
     # an XLA compile; prewarmed shapes are tagged in /stats and
     # excluded from serve_recompiles_total.
     serve_prewarm: List[str] = field(default_factory=list)
+    # Incremental serving tier (serve/cache.py): byte budget (MB) of
+    # the per-(case, topology, backend) base-case cache — converged
+    # solutions plus the reusable artifacts (FDLF B'/B'' LU pair, BCSR
+    # pattern handle) — 0 disables the tier; identical pf injections
+    # answer from cache, small deltas answer via residual-verified SMW
+    # correction solves, everything else warm-starts off the nearest
+    # cached solution (docs/serving.md "Incremental tier").
+    serve_cache_mb: float = 64.0
+    # Cached solutions older than this are evicted at next touch.
+    serve_cache_ttl_s: float = 600.0
+    # Largest changed-bus count the delta tier attempts before falling
+    # back to warm-start seeding.
+    serve_delta_max_rank: int = 16
     # Jacobian backend for the batched Newton/N-1 power-flow paths
     # (pf/newton.py vs pf/sparse.py): "dense" (hand-assembled [2n,2n]
     # LU), "sparse" (BCSR/segment-sum assembly + pattern-reuse Krylov

@@ -82,7 +82,7 @@ class DcSolver(NamedTuple):
     n_branch: int
 
 
-def make_dc_solver(sys: BusSystem, dtype=None) -> DcSolver:
+def make_dc_solver(sys: BusSystem, dtype=None, lu=None) -> DcSolver:
     """Factorize B′ once and compile the DC lane operators.
 
     ``solve`` accepts a single ``[n]`` injection vector or a ``[L, n]``
@@ -91,6 +91,13 @@ def make_dc_solver(sys: BusSystem, dtype=None) -> DcSolver:
     Sherman–Morrison-corrected post-outage angles/flows/severity.
     Everything is jitted; the factorization and the free-row masks are
     trace constants shared by every call.
+
+    ``lu`` optionally passes an already-computed ``lu_factor`` pair of
+    this case's B′ — the serving cache's base-case entries hold exactly
+    that pair (the ``kind="lu"`` half of
+    :func:`freedm_tpu.pf.krylov.build_fdlf_precond`), so attaching a DC
+    screen to a cached case re-uses the factorization instead of paying
+    a second O(n³) build (and records no ``dc.factorize`` timer).
     """
     rdtype = cplx.default_rdtype(dtype)
     n = sys.n_bus
@@ -104,11 +111,12 @@ def make_dc_solver(sys: BusSystem, dtype=None) -> DcSolver:
     mask_f = th_free[f_idx]  # pinned endpoints drop out of the update
     mask_t = th_free[t_idx]
 
-    t0 = time.monotonic()
-    with jax.default_matmul_precision("highest"):
-        lu = jax.jit(jax.scipy.linalg.lu_factor)(parts.b_prime(None))
-        jax.block_until_ready(lu[0])
-    profiling.PROFILER.record_host("dc.factorize", time.monotonic() - t0)
+    if lu is None:
+        t0 = time.monotonic()
+        with jax.default_matmul_precision("highest"):
+            lu = jax.jit(jax.scipy.linalg.lu_factor)(parts.b_prime(None))
+            jax.block_until_ready(lu[0])
+        profiling.PROFILER.record_host("dc.factorize", time.monotonic() - t0)
 
     def _flows(theta):
         return (theta[..., f_idx] - theta[..., t_idx]) * w

@@ -546,25 +546,23 @@ def record_result(result: KrylovResult) -> None:
     metrics.observe_pf_result("krylov", result)
 
 
-def true_mismatch(sys: BusSystem, result: KrylovResult, status=None) -> float:
-    """Host float64 oracle: the max masked power-flow residual of a
-    solution, evaluated branch-wise in numpy double precision.
+def host_injections(sys: BusSystem, theta, v, status=None):
+    """Host float64 realized bus injections ``(p, q)`` at ``(θ, V)``.
 
-    Independent of every on-device dtype decision (admittances included
-    — ``branch_admittances`` would silently truncate to f32 on a
-    non-x64 backend), so it reports the REAL accuracy of a float32
-    solve.  Cost: O(n + m) on host.  ``status`` applies the same
-    per-branch in-service mask the solvers trace (ADVICE r5: N-1 outage
-    lanes are oracle-checkable, not just the base case).
+    The MATPOWER branch model evaluated branch-wise in numpy double
+    precision (mirrors ``grid.bus.branch_admittances``, status masking
+    included: an out-of-service branch contributes no series OR charging
+    terms).  O(n + m) on host, independent of every on-device dtype
+    decision — the single source for :func:`true_mismatch`'s oracle AND
+    the serving cache's delta-verify residual check
+    (:mod:`freedm_tpu.serve.cache`), so "verified" means the same thing
+    at both call sites.
     """
     import numpy as np
 
     n = sys.n_bus
-    theta = np.asarray(result.theta, np.float64)
-    v = np.asarray(result.v, np.float64)
-    # The MATPOWER branch model, in numpy double (mirrors
-    # grid.bus.branch_admittances, status masking included: an
-    # out-of-service branch contributes no series OR charging terms).
+    theta = np.asarray(theta, np.float64)
+    v = np.asarray(v, np.float64)
     ys = 1.0 / (sys.r.astype(np.float64) + 1j * sys.x.astype(np.float64))
     bc2 = 1j * sys.b_chg.astype(np.float64) / 2.0
     if status is not None:
@@ -593,6 +591,24 @@ def true_mismatch(sys: BusSystem, result: KrylovResult, status=None) -> float:
     v2 = v * v
     p += sys.g_shunt * v2
     q -= sys.b_shunt * v2
+    return p, q
+
+
+def true_mismatch(sys: BusSystem, result: KrylovResult, status=None) -> float:
+    """Host float64 oracle: the max masked power-flow residual of a
+    solution, evaluated branch-wise in numpy double precision.
+
+    Independent of every on-device dtype decision (admittances included
+    — ``branch_admittances`` would silently truncate to f32 on a
+    non-x64 backend), so it reports the REAL accuracy of a float32
+    solve.  Cost: O(n + m) on host (:func:`host_injections`).
+    ``status`` applies the same per-branch in-service mask the solvers
+    trace (ADVICE r5: N-1 outage lanes are oracle-checkable, not just
+    the base case).
+    """
+    import numpy as np
+
+    p, q = host_injections(sys, result.theta, result.v, status=status)
     th_free = sys.bus_type != SLACK
     v_free = sys.bus_type == PQ
     fp = np.where(th_free, p - sys.p_inj, 0.0)

@@ -83,6 +83,47 @@ def secure_outages(sys: BusSystem) -> list:
     return out
 
 
+def smw_delta_solve(lu, u, v, b, z=None, cap=None, vt=None):
+    """Solve ``(A + U Vᵀ) x = b`` through the Sherman–Morrison–Woodbury
+    identity, given the factorized base ``lu = lu_factor(A)``:
+
+        x = A⁻¹b − Z · (I_k + Vᵀ Z)⁻¹ · (Vᵀ A⁻¹ b),     Z = A⁻¹ U
+
+    — one base triangular solve plus O(n·k) correction work, instead of
+    re-factorizing the updated matrix.  This is THE correction solve of
+    the incremental machinery, with exactly two call sites:
+
+    - the N-1 screen (:func:`_make_smw_n1_screen`): per-outage rank-≤2
+      branch updates, with ``z``/``cap`` PRECOMPUTED for every branch at
+      build time (one multi-RHS solve) and passed in;
+    - the serving cache's injection-delta tier
+      (:mod:`freedm_tpu.serve.cache`): the matrix is *unchanged* (an
+      injection delta moves the right-hand side, not B′/B″), which is
+      the rank-0 degenerate case — ``u``/``v``/``z`` all ``None`` — and
+      the call is the bare base solve off the cached factorization.
+
+    ``u``/``v`` are ``[n, k]`` low-rank factors; ``u`` may be omitted
+    when ``z`` is supplied.  ``vt`` optionally replaces the dense
+    ``Vᵀ·`` application with a structured one — the N-1 screen's V
+    columns are masked endpoint one-hots, so ``Vᵀx`` is the two-element
+    gather ``x[idx] * mask``, O(1) per lane where the dense form would
+    materialize ``[lanes, n, 2]`` column matrices under ``vmap``.
+    With ``vt`` given it is applied to the ``[n]`` right-hand vector
+    (and to ``z`` only when ``cap`` is not precomputed); ``v`` may then
+    be ``None``.  Jit-safe (pure jax ops).
+    """
+    x0 = jax.scipy.linalg.lu_solve(lu, b)
+    if u is None and z is None:
+        return x0  # rank-0: the update is empty, A⁻¹b is the answer
+    if z is None:
+        z = jax.scipy.linalg.lu_solve(lu, u)
+    apply_vt = vt if vt is not None else (lambda x: v.T @ x)
+    if cap is None:
+        k = z.shape[-1]
+        cap = jnp.eye(k, dtype=z.dtype) + apply_vt(z)
+    return x0 - z @ jnp.linalg.solve(cap, apply_vt(x0))
+
+
 class N1Prefiltered(NamedTuple):
     """Output of a DC-prefiltered screen: the AC-verified shortlist
     (DC-worst first) plus the full DC severity ranking, so a caller can
@@ -351,14 +392,12 @@ def _make_smw_n1_screen(
     mask_q = jnp.asarray(mask_q, rdtype)
     eye2 = jnp.eye(2, dtype=rdtype)
 
-    def _corr_solve(lu, zmk, capk, idx, maskk, b):
-        """(A + P M Pᵀ)⁻¹ b given the lane's precomputed Z·M and cap."""
-        t0 = jax.scipy.linalg.lu_solve(lu, b)
-        pt = t0[idx] * maskk  # Pᵀ t0
-        return t0 - zmk @ jnp.linalg.solve(capk, pt)
-
     def _solve_lane(k):
-        """One outage lane: FDLF iteration with SMW-corrected solves."""
+        """One outage lane: FDLF iteration with SMW-corrected solves
+        (:func:`smw_delta_solve` with this lane's precomputed Z·M and
+        capacitance; V = the masked endpoint one-hot columns, applied
+        via the ``vt`` gather — ``Vᵀt = t[idx] * mask``, O(1) per lane,
+        no dense column matrices under the lane vmap)."""
         idx = idx_all[k]  # [2]
         mk_p, mk_q = mask_p[k], mask_q[k]
         zm_p = z_p[:, k, :] @ m_p[k]  # [n, 2] = A⁻¹ U for B′
@@ -384,9 +423,15 @@ def _make_smw_n1_screen(
 
         def body(carry, _):
             theta, v, dp, dq = carry
-            theta = theta + _corr_solve(lu_p, zm_p, cap_p, idx, mk_p, dp) * th_free
+            theta = theta + smw_delta_solve(
+                lu_p, None, None, dp, z=zm_p, cap=cap_p,
+                vt=lambda x: x[idx] * mk_p,
+            ) * th_free
             _, dq2 = mismatch(theta, v)
-            v = v + _corr_solve(lu_q, zm_q, cap_q, idx, mk_q, dq2) * v_free
+            v = v + smw_delta_solve(
+                lu_q, None, None, dq2, z=zm_q, cap=cap_q,
+                vt=lambda x: x[idx] * mk_q,
+            ) * v_free
             dp3, dq3 = mismatch(theta, v)
             return (theta, v, dp3, dq3), None
 

@@ -28,4 +28,11 @@ from freedm_tpu.serve.service import (  # noqa: F401
     default_buckets,
     parse_request,
 )
+from freedm_tpu.serve.cache import (  # noqa: F401
+    CachedSolution,
+    CaseEntry,
+    ServeCache,
+    injection_digest,
+    topology_digest,
+)
 from freedm_tpu.serve.http import ServeServer  # noqa: F401

@@ -49,7 +49,16 @@ Batching semantics carried over from the single-loop design:
   ``/stats`` readers agree.
 - **Failure containment** — a solver exception on an executor lane
   fails only *that batch's* tickets with a typed ``internal`` error;
-  the lane thread and the assembly lane keep running.
+  the lane thread and the assembly lane keep running.  A failed batch
+  also aborts any single-flight followers parked on its tickets'
+  cache digests (``Service._complete_error`` → ``abort_flight``).
+- **Incremental tier upstream** — with ``--serve-cache-mb`` > 0, exact
+  and verified-delta pf answers are completed at *submit* time
+  (:mod:`freedm_tpu.serve.cache`) and never occupy queue depth, a
+  coalescing window, or a device dispatch here; the batches this loop
+  does dispatch populate the cache at scatter time (the pf engine's
+  ``publish`` hook), which is where single-flight followers are
+  answered from their leader's lane.
 
 Watchdog surface (core.slo): the assembly loop and every executor lane
 beat independently and expose ``busy()``, so a stall is attributable
@@ -605,6 +614,10 @@ class MicroBatcher:
                         (t_host0 - group[0].enqueued_at) * 1e3, 3
                     ),
                     solve_ms=round(solve_s * 1e3, 3),
+                    # Every dispatched batch is the full-solve tier;
+                    # exact/delta cache answers never reach this loop
+                    # (serve/cache.py completes them at submit time).
+                    tier="full",
                 )
                 engine.scatter(group, results, info)
             work.span.tag(solve_ms=round(solve_s * 1e3, 3))

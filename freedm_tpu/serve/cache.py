@@ -1,0 +1,708 @@
+"""Incremental serving tier: base-case factorization/solution reuse.
+
+At million-user traffic most what-if queries are small deltas against a
+shared base case, yet a cold serve path runs every ``POST /v1/pf`` as a
+full Newton solve from flat start.  This module is the
+amortize-one-factorization-over-many-queries layer (the SABLE /
+accelerated-DC-loadflow pattern from PAPERS.md, applied to serving): a
+bounded per-(case, topology, pf_backend) cache holding each base case's
+converged solutions **plus the reusable solve artifacts** —
+
+- the FDLF B′/B″ LU pair (:func:`freedm_tpu.pf.krylov.build_fdlf_precond`
+  with ``kind="lu"``), factorized ONCE per (case, topology) and reused
+  by every delta answer;
+- the BCSR symbolic Jacobian pattern
+  (:func:`freedm_tpu.pf.sparse.jacobian_pattern`) for sparse-backend
+  cases (the handle pins the process-wide pattern cache entry alive for
+  the case's lifetime);
+- a lazily-built DC screen (:func:`freedm_tpu.pf.dc.make_dc_solver`)
+  sharing the SAME B′ factorization via its ``lu=`` argument — zero
+  extra O(n³) work to attach DC screening to a cached case.
+
+Three answer tiers, cheapest first (:class:`ServeCache` classifies,
+:class:`~freedm_tpu.serve.service.Service` acts):
+
+1. **exact** — the request's injection vector is byte-identical to a
+   cached solution: answered from host memory, sub-millisecond, no
+   device touch at all.
+2. **delta** — the injections differ from a cached solution at ≤
+   ``delta_max_rank`` buses (and ≤ ``delta_max_pu`` per-bus magnitude):
+   answered by warm-started fast-decoupled sweeps whose inner solve is
+   :func:`freedm_tpu.pf.n1.smw_delta_solve` over the cached LU pair —
+   the rank-0 (matrix-unchanged) case of the same correction solve the
+   N-1 screen uses at rank 2.  O(n²) triangular solves per sweep
+   instead of the full path's per-iteration O(n³) re-factorization.
+   Every delta answer is **verified** by a host float64 residual check
+   (:func:`freedm_tpu.pf.krylov.host_injections` — the same oracle the
+   solver tests trust); a residual above the engine tolerance falls
+   through to tier 3, so the cache can serve a wrong-enough answer to
+   exactly nobody.
+3. **warm** — too big a delta to correct: the full solve proceeds, but
+   seeded with the nearest cached solution through the ``v0``/``theta0``
+   warm-start path (PR 4 measured 37% fewer Newton iterations).
+
+Plus the operational machinery a shared cache needs: **invalidation**
+keyed on a topology digest (a mutated case hashes to a different entry
+— a stale solution is unreachable, never served), **LRU + TTL
+eviction** byte-accounted against the ``--serve-cache-mb`` budget
+(artifacts included), and **single-flight population** — concurrent
+identical cold requests elect one leader ticket; followers ride its
+solve and are answered at scatter time, so a thundering herd on a cold
+case compiles and factorizes once.
+
+Threading: one cache lock guards the maps/accounting (lookups are pure
+host work — dict probes and O(n) numpy compares); artifact builds run
+under a per-entry build lock so a cold case cannot stall other cases'
+lookups.  The cache lock never nests inside (or around) the admission
+queue's condition — pinned by the GL006 static lock graph and a
+DebugLock test.  The delta solve's single device sync is the designed
+pull at the verify boundary (GL002 registry entry).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from freedm_tpu.core import metrics as obs
+from freedm_tpu.core import profiling
+
+#: Recent solutions scanned per lookup for the nearest delta/warm base.
+DELTA_SCAN = 8
+
+#: Fast-decoupled correction sweeps the delta tier may spend before the
+#: residual check decides (the program exits early on convergence).
+DELTA_MAX_SWEEPS = 30
+
+#: Per-bus injection deltas above this (pu) are not worth attempting a
+#: linear-regime correction on — straight to the warm tier.
+DELTA_MAX_PU = 0.5
+
+#: Minimum seconds between full TTL sweeps of one entry's solution
+#: list: a sweep is O(solutions) under the global lock, so it must not
+#: run on every lookup (freshness is still enforced per served
+#: candidate — an expired solution is never answered, sweep or no
+#: sweep; the sweep just reclaims the bytes).
+_TTL_SWEEP_S = 1.0
+
+_TIERS = ("exact", "delta", "warm", "miss")
+
+
+def injection_digest(p: np.ndarray, q: np.ndarray) -> str:
+    """Content key of one injection pair (exact-hit identity)."""
+    return hashlib.sha1(p.tobytes() + q.tobytes()).hexdigest()
+
+
+def topology_digest(sys) -> str:
+    """Digest of everything that shapes the network matrices — bus
+    types/shunts/setpoints and the full branch table.  Injections are
+    deliberately EXCLUDED (they are the delta dimension); any other
+    mutation (an outage baked into ``x``, a retap, an added branch)
+    changes the digest, so a stale entry is unreachable rather than
+    invalid — the "stale entry never served" contract."""
+    h = hashlib.sha1()
+    for arr in (sys.bus_type, sys.v_set, sys.g_shunt, sys.b_shunt,
+                sys.from_bus, sys.to_bus, sys.r, sys.x, sys.b_chg,
+                sys.tap, sys.shift):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    h.update(repr((sys.n_bus, sys.n_branch, float(sys.base_mva))).encode())
+    return h.hexdigest()[:16]
+
+
+def _nbytes(x) -> int:
+    """Recursive byte size of numpy/jax arrays (tuples/lists walked)."""
+    if x is None:
+        return 0
+    if isinstance(x, (tuple, list)):
+        return sum(_nbytes(e) for e in x)
+    size = getattr(x, "size", None)
+    itemsize = getattr(getattr(x, "dtype", None), "itemsize", None)
+    if size is not None and itemsize is not None:
+        return int(size) * int(itemsize)
+    return 0
+
+
+class CachedSolution:
+    """One converged operating point of a cached case: the injections
+    it answers exactly, the solution state, and the response stamps."""
+
+    __slots__ = ("digest", "p_inj", "q_inj", "v", "theta", "p", "q",
+                 "iterations", "mismatch", "converged", "stamp", "nbytes")
+
+    def __init__(self, digest: str, p_inj, q_inj, v, theta, p, q,
+                 iterations: int, mismatch: float, converged: bool):
+        self.digest = digest
+        # np.array (copy) — scatter hands batch-row VIEWS; storing them
+        # would pin the whole padded [bucket, n] batch in memory and
+        # falsify the byte accounting.
+        self.p_inj = np.array(p_inj, np.float64)
+        self.q_inj = np.array(q_inj, np.float64)
+        self.v = np.array(v, np.float64)
+        self.theta = np.array(theta, np.float64)
+        self.p = np.array(p, np.float64)
+        self.q = np.array(q, np.float64)
+        self.iterations = int(iterations)
+        self.mismatch = float(mismatch)
+        self.converged = bool(converged)
+        self.stamp = time.monotonic()
+        self.nbytes = sum(
+            a.nbytes for a in (self.p_inj, self.q_inj, self.v, self.theta,
+                               self.p, self.q)
+        ) + 128  # key/slot overhead, order-of-magnitude honest
+
+
+class CaseEntry:
+    """One (case, topology, pf_backend)'s artifacts + solution store.
+
+    ``precond`` is the ``kind="lu"`` FDLF pair; ``pattern`` the BCSR
+    symbolic handle (sparse-backend cases); ``delta_fn`` the jitted
+    warm-started fast-decoupled correction program; ``dc_solver()``
+    lazily attaches a DC screen sharing the B′ factorization.
+    ``solutions`` is digest → :class:`CachedSolution`, LRU-ordered,
+    manipulated only under the owning cache's lock."""
+
+    __slots__ = ("key", "case", "sys", "backend", "tol", "rdtype",
+                 "build_lock", "precond", "pattern", "delta_fn", "_dc",
+                 "solutions", "artifact_bytes", "accounted", "alive",
+                 "last_used", "ttl_sweep", "_th_free", "_v_free")
+
+    def __init__(self, case: str, sys, backend: str, topo: str):
+        self.key = (case, topo, backend)
+        self.case = case
+        self.sys = sys
+        self.backend = backend
+        self.build_lock = threading.Lock()
+        self.precond = None
+        self.pattern = None
+        self.delta_fn = None
+        self._dc = None
+        self.solutions: "OrderedDict[str, CachedSolution]" = OrderedDict()
+        self.artifact_bytes = 0
+        # artifact_bytes has been added to the owning cache's byte
+        # account (guarded by the cache lock on BOTH the add and every
+        # subtract, so a racing invalidate can never drive the account
+        # negative).
+        self.accounted = False
+        self.alive = True
+        self.last_used = time.monotonic()
+        self.ttl_sweep = 0.0  # last full TTL sweep (time-gated)
+        from freedm_tpu.grid.bus import PQ, SLACK
+
+        self._th_free = np.asarray(sys.bus_type) != SLACK
+        self._v_free = np.asarray(sys.bus_type) == PQ
+        from freedm_tpu.utils import cplx
+
+        self.rdtype = cplx.default_rdtype(None)
+        import jax.numpy as jnp
+
+        self.tol = 1e-8 if self.rdtype == jnp.float64 else 3e-5
+
+    # -- artifacts (built once, under build_lock) -----------------------------
+    def build_artifacts(self) -> None:
+        """Factorize the FDLF pair (and grab the BCSR pattern handle on
+        sparse-backend cases) — the one-time per-(case, topology) cost
+        every tier amortizes.  Idempotent; callers serialize on
+        ``build_lock`` (single-flight: a herd factorizes once)."""
+        if self.precond is not None:
+            return
+        from freedm_tpu.pf.krylov import build_fdlf_precond
+        from freedm_tpu.pf.sparse import jacobian_pattern, resolve_backend
+
+        t0 = time.monotonic()
+        precond = build_fdlf_precond(self.sys, dtype=self.rdtype, kind="lu")
+        pattern = None
+        if resolve_backend(self.backend, self.sys.n_bus) == "sparse":
+            pattern = jacobian_pattern(self.sys)
+        self.artifact_bytes = _nbytes(precond.bp) + _nbytes(precond.bq)
+        if pattern is not None:
+            # The BCSR pattern's index arrays are held alive by this
+            # entry — budget them like every other artifact.  (Jitted
+            # executables are not byte-accounted, same as the serve
+            # engines' programs.)
+            self.artifact_bytes += (
+                _nbytes(pattern.f) + _nbytes(pattern.t)
+                + _nbytes(pattern.rows)
+            )
+        self.pattern = pattern
+        self.precond = precond
+        if profiling.PROFILER.enabled:
+            profiling.PROFILER.record_host(
+                "serve.cache.build", time.monotonic() - t0
+            )
+
+    def ensure_delta_fn(self):
+        """The jitted correction program (built lazily, compiled by XLA
+        on its first call — or at :meth:`ServeCache.prewarm_entry`)."""
+        with self.build_lock:
+            self.build_artifacts()
+            if self.delta_fn is None:
+                self.delta_fn = _build_delta_program(
+                    self.sys, self.precond, self.tol, DELTA_MAX_SWEEPS,
+                    self.rdtype,
+                )
+        return self.delta_fn
+
+    def dc_solver(self):
+        """DC screen over this case, sharing the entry's B′ LU (no
+        second factorization — ``make_dc_solver(lu=...)``)."""
+        with self.build_lock:
+            self.build_artifacts()
+            if self._dc is None:
+                from freedm_tpu.pf.dc import make_dc_solver
+
+                self._dc = make_dc_solver(
+                    self.sys, dtype=self.rdtype, lu=self.precond.bp
+                )
+        return self._dc
+
+    def verify(self, theta: np.ndarray, v: np.ndarray, p_req: np.ndarray,
+               q_req: np.ndarray) -> float:
+        """Host float64 residual of a candidate solution against the
+        REQUEST's injections — the delta tier's accept/fall-through
+        gate, sharing :func:`~freedm_tpu.pf.krylov.host_injections`
+        with the solver oracles."""
+        from freedm_tpu.pf.krylov import host_injections
+
+        p_calc, q_calc = host_injections(self.sys, theta, v)
+        fp = np.where(self._th_free, p_calc - p_req, 0.0)
+        fq = np.where(self._v_free, q_calc - q_req, 0.0)
+        return float(max(np.max(np.abs(fp)), np.max(np.abs(fq))))
+
+
+def _build_delta_program(sys, precond, tol, max_sweeps, rdtype):
+    """Compile the delta tier's correction: warm-started fast-decoupled
+    sweeps whose inner solve is ``smw_delta_solve`` (rank-0: the cached
+    LU pair IS the matrix — an injection delta moves only the RHS),
+    iterated until the mismatch clears ``tol`` or ``max_sweeps``.  One
+    jitted program per (case, topology); every delta answer reuses it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from freedm_tpu.pf.fdlf import decoupled_parts
+    from freedm_tpu.pf.mfree import make_injection_fn
+    from freedm_tpu.pf.n1 import smw_delta_solve
+
+    parts = decoupled_parts(sys, rdtype)
+    th_free, v_free = parts.th_free, parts.v_free
+    inject = make_injection_fn(sys, rdtype)
+    lu_p, lu_q = precond.bp, precond.bq
+
+    @jax.jit
+    def correct(theta0, v0, p_sched, q_sched):
+        with jax.default_matmul_precision("highest"):
+            p_s = jnp.asarray(p_sched, rdtype)
+            q_s = jnp.asarray(q_sched, rdtype)
+
+            def mismatch(theta, v):
+                p_calc, q_calc = inject(theta, v)
+                dp = (p_s - p_calc) / v * th_free
+                dq = (q_s - q_calc) / v * v_free
+                return dp, dq
+
+            def err_from(dp, dq, v):
+                return jnp.maximum(
+                    jnp.max(jnp.abs(dp * v)), jnp.max(jnp.abs(dq * v))
+                ).astype(rdtype)
+
+            theta = jnp.asarray(theta0, rdtype)
+            v = jnp.asarray(v0, rdtype)
+            dp, dq = mismatch(theta, v)
+
+            def cond(c):
+                theta_c, v_c, dp_c, dq_c, it = c
+                return jnp.logical_and(
+                    it < max_sweeps, err_from(dp_c, dq_c, v_c) >= tol
+                )
+
+            def body(c):
+                theta, v, dp, dq, it = c
+                theta = theta + smw_delta_solve(lu_p, None, None, dp) * th_free
+                _, dq2 = mismatch(theta, v)
+                v = v + smw_delta_solve(lu_q, None, None, dq2) * v_free
+                dp3, dq3 = mismatch(theta, v)
+                return (theta, v, dp3, dq3, it + 1)
+
+            theta, v, dp, dq, it = jax.lax.while_loop(
+                cond, body, (theta, v, dp, dq, jnp.int32(0))
+            )
+            p_calc, q_calc = inject(theta, v)
+            return theta, v, p_calc, q_calc, err_from(dp, dq, v), it
+
+    return correct
+
+
+class _Flight:
+    """One in-progress cold solve and the followers riding it."""
+
+    __slots__ = ("entry", "digest", "followers")
+
+    def __init__(self, entry: CaseEntry, digest: str):
+        self.entry = entry
+        self.digest = digest
+        self.followers: List[object] = []  # Ticket-shaped records
+
+
+class ServeCache:
+    """The bounded incremental-tier store (see the module docstring).
+
+    ``max_bytes`` budgets solutions **plus artifacts**; a case whose
+    artifacts alone would overrun it is never cached (``entry`` returns
+    ``None`` and the serve path stays cold — correct, just uncached).
+    ``verify_tol`` overrides the engine-tolerance accept bar of the
+    delta tier (tests use it to force fall-through).
+    """
+
+    def __init__(self, max_bytes: int, ttl_s: float = 600.0,
+                 delta_max_rank: int = 16, delta_max_pu: float = DELTA_MAX_PU,
+                 verify_tol: Optional[float] = None):
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = float(ttl_s)
+        self.delta_max_rank = int(delta_max_rank)
+        self.delta_max_pu = float(delta_max_pu)
+        self.verify_tol = verify_tol
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str, str], CaseEntry] = {}
+        self._lru: "OrderedDict[Tuple[Tuple[str, str, str], str], CaseEntry]" \
+            = OrderedDict()
+        self._flights: Dict[Tuple[Tuple[str, str, str], str], _Flight] = {}
+        self.bytes = 0
+        self._counts = {t: 0 for t in _TIERS}
+        self._joins = 0
+        self._evictions = {"lru": 0, "ttl": 0, "invalidate": 0}
+
+    # -- entries --------------------------------------------------------------
+    def entry(self, case: str, sys, backend: str,
+              topo: Optional[str] = None) -> Optional[CaseEntry]:
+        """The live entry for (case, topology, backend) — created (and
+        its artifacts factorized, single-flight) on first touch, or
+        ``None`` when the case cannot fit the byte budget.  Callers
+        re-fetch per request: an evicted/invalidated entry is dead and
+        its key resolves to a fresh rebuild."""
+        if topo is None:
+            topo = topology_digest(sys)
+        key = (case, topo, backend)
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None:
+                ent.last_used = time.monotonic()
+                return ent
+            n = sys.n_bus
+            # Two [n, n] LU factors (+ pivots) in the working dtype.
+            est = 2 * (n * n + n) * 8
+            if est > self.max_bytes:
+                return None
+            ent = CaseEntry(case, sys, backend, topo)
+            self._entries[key] = ent
+        with ent.build_lock:
+            ent.build_artifacts()
+        with self._lock:
+            # `accounted` pairs the one add with the (at most one)
+            # subtract in invalidate/_evict_locked — a racing
+            # invalidation between build and this block must not drive
+            # the byte account negative.
+            if ent.alive and not ent.accounted:
+                ent.accounted = True
+                self.bytes += ent.artifact_bytes
+                self._evict_locked()
+            self._set_gauges_locked()
+        return ent
+
+    def peek_entry(self, case: str, topo: Optional[str],
+                   backend: str) -> Optional[CaseEntry]:
+        """The live entry for the key, or ``None`` — never builds.  The
+        scatter-side publish path uses this so an invalidated/evicted
+        entry's in-flight inserts genuinely land nowhere (re-creating
+        the entry there would also put an O(n³) artifact factorization
+        on the device-executor lane)."""
+        with self._lock:
+            ent = self._entries.get((case, topo, backend))
+            if ent is not None:
+                ent.last_used = time.monotonic()
+            return ent
+
+    # -- lookup (host-only: GL002 zero-sync hot path) -------------------------
+    def lookup(self, entry: CaseEntry, digest: str, p: np.ndarray,
+               q: np.ndarray):
+        """Classify one pf request against the entry's solutions.
+
+        Returns ``(tier, payload)``: ``("exact", solution)`` /
+        ``("delta", nearest)`` / ``("warm", nearest)`` /
+        ``("miss", None)``.  Pure host work — dict probes plus O(n)
+        numpy compares over at most :data:`DELTA_SCAN` recent solutions
+        — so the submit path never blocks on the device here.
+        """
+        now = time.monotonic()
+        ttl = self.ttl_s
+        with self._lock:
+            entry.last_used = now
+            # Full TTL sweeps are time-gated (at most one per
+            # _TTL_SWEEP_S per entry): O(solutions) work must not sit
+            # on every lookup's critical section, or the exact-hit
+            # sub-millisecond contract dies at exactly the repeat
+            # volume the tier exists for.
+            if ttl > 0 and now - entry.ttl_sweep >= _TTL_SWEEP_S:
+                entry.ttl_sweep = now
+                self._prune_expired_locked(entry, now)
+            sol = entry.solutions.get(digest)
+            if sol is not None and ttl > 0 and now - sol.stamp > ttl:
+                # Freshness is enforced on the candidate itself, not
+                # just by the gated sweep: an expired solution is never
+                # served.
+                self._drop_expired_locked(entry, sol)
+                sol = None
+            if sol is not None and np.array_equal(sol.p_inj, p) \
+                    and np.array_equal(sol.q_inj, q):
+                self._touch_locked(entry, sol, now)
+                return "exact", sol
+            best_delta = None
+            best_delta_rank = None
+            best_warm = None
+            best_warm_l1 = None
+            scanned = 0
+            for s in reversed(entry.solutions.values()):
+                if scanned >= DELTA_SCAN:
+                    break
+                scanned += 1
+                if ttl > 0 and now - s.stamp > ttl:
+                    continue  # expired: never served (sweep reaps it)
+                dp = p - s.p_inj
+                dq = q - s.q_inj
+                changed = (np.abs(dp) > 1e-12) | (np.abs(dq) > 1e-12)
+                rank = int(np.count_nonzero(changed))
+                mag = float(max(np.max(np.abs(dp)), np.max(np.abs(dq))))
+                l1 = float(np.sum(np.abs(dp)) + np.sum(np.abs(dq)))
+                if rank <= self.delta_max_rank and mag <= self.delta_max_pu:
+                    if best_delta_rank is None or rank < best_delta_rank:
+                        best_delta, best_delta_rank = s, rank
+                if best_warm_l1 is None or l1 < best_warm_l1:
+                    best_warm, best_warm_l1 = s, l1
+            if best_delta is not None:
+                self._touch_locked(entry, best_delta, now)
+                return "delta", best_delta
+            if best_warm is not None:
+                self._touch_locked(entry, best_warm, now)
+                return "warm", best_warm
+            return "miss", None
+
+    # -- delta tier (device correction + the ONE designed verify sync) --------
+    def delta_answer(self, entry: CaseEntry, near: CachedSolution,
+                     p: np.ndarray, q: np.ndarray) -> Optional[dict]:
+        """Correct ``near`` to the requested injections off the cached
+        factorization; verify on host; ``None`` on a residual miss (the
+        caller falls through to the warm tier).  The ``np.asarray``
+        pulls below are the delta-verify boundary — the one designed
+        sync of the cache path (GL002)."""
+        if entry.delta_fn is None:
+            entry.ensure_delta_fn()
+        t0 = time.monotonic()
+        res = entry.delta_fn(near.theta, near.v, p, q)
+        theta = np.asarray(res[0], np.float64)
+        v = np.asarray(res[1], np.float64)
+        p_calc = np.asarray(res[2], np.float64)
+        q_calc = np.asarray(res[3], np.float64)
+        sweeps = np.asarray(res[5])
+        if profiling.PROFILER.enabled:
+            profiling.PROFILER.record_host(
+                "serve.cache.delta_solve", time.monotonic() - t0
+            )
+        if not (np.all(np.isfinite(theta)) and np.all(np.isfinite(v))):
+            return None
+        err = entry.verify(theta, v, p, q)
+        tol = self.verify_tol if self.verify_tol is not None else entry.tol
+        if err > tol:
+            return None  # fall through to the warm tier — never served
+        return {
+            "theta": theta, "v": v, "p": p_calc, "q": q_calc,
+            "iterations": int(sweeps), "mismatch": err, "converged": True,
+        }
+
+    # -- insertion (host-only: GL002 zero-sync hot path) ----------------------
+    def insert(self, entry: CaseEntry, digest: str, p: np.ndarray,
+               q: np.ndarray, v, theta, p_calc, q_calc, iterations: int,
+               mismatch: float, converged: bool) -> Optional[CachedSolution]:
+        """Store one converged operating point (full-solve scatter or a
+        verified delta answer); evicts LRU/TTL victims past the byte
+        budget.  Dead entries (evicted/invalidated while the solve was
+        in flight) are skipped."""
+        if not converged:
+            return None
+        sol = CachedSolution(digest, p, q, v, theta, p_calc, q_calc,
+                             iterations, mismatch, converged)
+        with self._lock:
+            if not entry.alive:
+                return None
+            old = entry.solutions.pop(digest, None)
+            if old is not None:
+                self._lru.pop((entry.key, digest), None)
+                self.bytes -= old.nbytes
+            entry.solutions[digest] = sol
+            self._lru[(entry.key, digest)] = entry
+            self.bytes += sol.nbytes
+            entry.last_used = sol.stamp
+            self._evict_locked()
+            self._set_gauges_locked()
+        return sol
+
+    # -- single flight --------------------------------------------------------
+    def flight_claim(self, entry: CaseEntry, digest: str, follower):
+        """Atomically: late exact-hit, join an in-progress solve, or
+        lead a new one.  Returns ``("exact", solution)``,
+        ``("joined", None)`` (the follower is parked on the flight), or
+        ``("lead", None)`` (the caller enqueues the real solve and
+        settles/aborts the flight when it completes)."""
+        key = (entry.key, digest)
+        with self._lock:
+            sol = entry.solutions.get(digest)
+            if sol is not None:
+                self._touch_locked(entry, sol, time.monotonic())
+                return "exact", sol
+            fl = self._flights.get(key)
+            if fl is not None:
+                fl.followers.append(follower)
+                self._joins += 1
+                return "joined", None
+            self._flights[key] = _Flight(entry, digest)
+            return "lead", None
+
+    def settle_flight(self, key) -> Tuple[Optional[CaseEntry], List[object]]:
+        """Pop one flight at leader completion: ``(entry, followers)``
+        (entry ``None`` if the flight vanished with an invalidation)."""
+        with self._lock:
+            fl = self._flights.pop(key, None)
+            if fl is None:
+                return None, []
+            return fl.entry, fl.followers
+
+    def abort_flight(self, key) -> List[object]:
+        """Pop a flight whose leader failed/expired: its followers (the
+        caller fails them with the leader's error)."""
+        with self._lock:
+            fl = self._flights.pop(key, None)
+            return [] if fl is None else fl.followers
+
+    # -- invalidation / eviction ----------------------------------------------
+    def invalidate(self, case: Optional[str] = None) -> int:
+        """Drop every entry (artifacts + solutions) for ``case`` (or
+        all cases) — the explicit topology/status-change hook.  Returns
+        dropped solution count.  In-flight solves against a dropped
+        entry still answer their waiters; their insert lands nowhere."""
+        dropped = 0
+        with self._lock:
+            for key in [k for k in self._entries
+                        if case is None or k[0] == case]:
+                ent = self._entries.pop(key)
+                ent.alive = False
+                for dig in list(ent.solutions):
+                    sol = ent.solutions.pop(dig)
+                    self._lru.pop((key, dig), None)
+                    self.bytes -= sol.nbytes
+                    dropped += 1
+                if ent.accounted:
+                    ent.accounted = False
+                    self.bytes -= ent.artifact_bytes
+                self._evictions["invalidate"] += 1
+                obs.SERVE_CACHE_EVICTIONS.labels("invalidate").inc()
+            self._set_gauges_locked()
+        return dropped
+
+    def _drop_expired_locked(self, entry: CaseEntry,
+                             sol: CachedSolution) -> None:
+        entry.solutions.pop(sol.digest, None)
+        self._lru.pop((entry.key, sol.digest), None)
+        self.bytes -= sol.nbytes
+        self._evictions["ttl"] += 1
+        obs.SERVE_CACHE_EVICTIONS.labels("ttl").inc()
+
+    def _prune_expired_locked(self, entry: CaseEntry, now: float) -> None:
+        if self.ttl_s <= 0:
+            return
+        for sol in [s for s in entry.solutions.values()
+                    if now - s.stamp > self.ttl_s]:
+            self._drop_expired_locked(entry, sol)
+
+    def _touch_locked(self, entry: CaseEntry, sol: CachedSolution,
+                      now: float) -> None:
+        # A touch refreshes LRU order only; TTL ages from insert time.
+        entry.solutions.move_to_end(sol.digest)
+        self._lru.move_to_end((entry.key, sol.digest), last=True)
+
+    def _evict_locked(self) -> None:
+        """LRU victims until the budget holds: solutions first (oldest
+        touch anywhere), then whole idle entries' artifacts."""
+        while self.bytes > self.max_bytes and self._lru:
+            (ekey, dig), ent = self._lru.popitem(last=False)
+            sol = ent.solutions.pop(dig, None)
+            if sol is not None:
+                self.bytes -= sol.nbytes
+                self._evictions["lru"] += 1
+                obs.SERVE_CACHE_EVICTIONS.labels("lru").inc()
+        if self.bytes > self.max_bytes and len(self._entries) > 1:
+            for key in sorted(self._entries,
+                              key=lambda k: self._entries[k].last_used):
+                if self.bytes <= self.max_bytes:
+                    break
+                ent = self._entries.pop(key)
+                ent.alive = False
+                if ent.accounted:
+                    ent.accounted = False
+                    self.bytes -= ent.artifact_bytes
+                self._evictions["lru"] += 1
+                obs.SERVE_CACHE_EVICTIONS.labels("lru").inc()
+
+    # -- accounting -----------------------------------------------------------
+    def record(self, tier: str) -> None:
+        """Count one resolved lookup (tier ∈ exact/delta/warm/miss) and
+        refresh the hit-ratio gauge."""
+        with self._lock:
+            self._counts[tier] += 1
+            lookups = sum(self._counts.values())
+            served = self._counts["exact"] + self._counts["delta"]
+            ratio = served / lookups if lookups else 0.0
+        if tier == "miss":
+            obs.SERVE_CACHE_MISSES.inc()
+        else:
+            obs.SERVE_CACHE_HITS.labels(tier).inc()
+        obs.SERVE_CACHE_HIT_RATIO.set(ratio)
+
+    def _set_gauges_locked(self) -> None:
+        obs.SERVE_CACHE_BYTES.set(self.bytes)
+
+    def prewarm_entry(self, entry: CaseEntry) -> None:
+        """Compile the delta program at startup (``--serve-prewarm``):
+        the first delta request pays a solve, not an XLA compile."""
+        fn = entry.ensure_delta_fn()
+        sys = entry.sys
+        v0 = np.where(entry._v_free, 1.0, np.asarray(sys.v_set, np.float64))
+        out = fn(np.zeros(sys.n_bus), v0,
+                 np.asarray(sys.p_inj, np.float64),
+                 np.asarray(sys.q_inj, np.float64))
+        np.asarray(out[0])  # block: the compile is done when we return
+
+    def stats(self) -> dict:
+        """The ``/stats`` cache block."""
+        with self._lock:
+            return {
+                "bytes": self.bytes,
+                "budget_bytes": self.max_bytes,
+                "ttl_s": self.ttl_s,
+                "delta_max_rank": self.delta_max_rank,
+                "entries": len(self._entries),
+                "solutions": sum(len(e.solutions)
+                                 for e in self._entries.values()),
+                "hits": {t: self._counts[t] for t in ("exact", "delta",
+                                                      "warm")},
+                "misses": self._counts["miss"],
+                "flight_joins": self._joins,
+                "inflight": len(self._flights),
+                "evictions": dict(self._evictions),
+                "hit_ratio": round(
+                    (self._counts["exact"] + self._counts["delta"])
+                    / max(sum(self._counts.values()), 1), 4
+                ),
+            }

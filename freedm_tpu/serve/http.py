@@ -21,8 +21,10 @@ Routes:
 - ``GET /stats`` — queue depth, the batcher's shape-bucket table, the
   per-shape recompile attribution (``recompiles_by_bucket``:
   ``"workload/case:bucket" -> first dispatches``, so a recompile storm
-  names its tenant without reading traces), and the serve metric
-  snapshot.
+  names its tenant without reading traces), the incremental tier's
+  ``cache`` block (hits per tier, misses, evictions, byte occupancy,
+  single-flight joins — docs/serving.md "Incremental tier"), and the
+  serve metric snapshot.
 
 Errors are *typed*, never free-text-only: the body is always
 ``{"error": {"type": <ServeError.code>, "detail": ...}}`` with the

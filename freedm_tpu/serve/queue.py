@@ -91,7 +91,7 @@ class Ticket:
 
     __slots__ = (
         "key", "request", "prepared", "lanes", "enqueued_at",
-        "deadline", "future", "span", "taken",
+        "deadline", "future", "span", "taken", "cache_flight",
     )
 
     def __init__(self, key: Tuple[str, str], request, prepared, lanes: int,
@@ -105,6 +105,9 @@ class Ticket:
         self.future: Future = Future()
         self.span = span  # serve.request span (or tracing NOOP)
         self.taken = False  # popped from one index; lazily dropped from the other
+        # Single-flight leadership (serve/cache.py): the (entry key,
+        # injection digest) this ticket's solve populates, or None.
+        self.cache_flight = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

@@ -44,6 +44,7 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 
 from freedm_tpu.core import metrics as obs
+from freedm_tpu.core import profiling
 from freedm_tpu.core import tracing
 from freedm_tpu.serve.queue import (
     AdmissionQueue,
@@ -114,12 +115,22 @@ class VVCRequest:
 
 @dataclass
 class BatchInfo:
-    """How this request was served — the micro-batching receipt."""
+    """How this request was served — the micro-batching receipt.
+
+    ``tier`` names the incremental-tier path that answered it:
+    ``"full"`` = a dispatched device solve (warm-started or not),
+    ``"exact"`` = the cached solution verbatim (this covers single-
+    flight followers too — they ride the leader's solve and are
+    answered from its just-inserted solution), ``"delta"`` = the
+    residual-verified SMW/FDLF correction off the cached factorization
+    (``bucket`` 0: no batch was dispatched for the cache tiers).
+    """
 
     lanes: int  # real lanes in the dispatched batch (all requests)
     bucket: int  # padded static shape the batch ran at
     queue_ms: float  # admission -> dispatch
     solve_ms: float  # batched solve wall time (shared by the batch)
+    tier: str = "full"  # incremental tier: full | exact | delta
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -318,6 +329,16 @@ class PowerFlowEngine(_Engine):
         from freedm_tpu.pf.newton import make_newton_solver
 
         sys_ = _resolve_bus_case(case)
+        self._sys = sys_  # the serving cache keys its entry off this
+        # Incremental-tier attach points, set by Service.engine() when a
+        # cache is configured: `publish` is the Service-bound callback
+        # scatter feeds converged solutions (and flight settles) into;
+        # `cache_topo` is the topology digest computed ONCE (BusSystem
+        # is frozen — in-place mutation cannot stale it), so per-request
+        # entry resolution is a dict probe, not an O(n+m) hash.
+        self.cache_backend: Optional[str] = None
+        self.cache_topo: Optional[str] = None
+        self.publish = None
         self.n_bus = sys_.n_bus
         self._p0 = np.asarray(sys_.p_inj, np.float64)
         self._q0 = np.asarray(sys_.q_inj, np.float64)
@@ -433,6 +454,11 @@ class PowerFlowEngine(_Engine):
                 theta=np.round(theta[i], 9).tolist() if want_state else None,
                 batch=info,
             ))
+        if self.publish is not None:
+            # Incremental tier: insert converged lanes into the serving
+            # cache and settle any single-flight followers parked on
+            # these tickets' digests (host arrays only — already pulled).
+            self.publish(self, group, v, theta, p, q, its, conv, mism, info)
 
 
 class N1Engine(_Engine):
@@ -645,6 +671,29 @@ _REQUEST_TYPES = {
 }
 
 
+def _response_from_solution(eng, request: PowerFlowRequest, sol,
+                            info: BatchInfo) -> PowerFlowResponse:
+    """Build a pf response from a cached/corrected solution record
+    (``CachedSolution``-shaped: host numpy state + stamps) — the same
+    fields the scatter path computes, honoring ``return_state``."""
+    want_state = bool(request.return_state)
+    return PowerFlowResponse(
+        workload="pf",
+        case=eng.case,
+        scale=float(request.scale),
+        converged=bool(sol.converged),
+        iterations=int(sol.iterations),
+        residual_pu=float(sol.mismatch),
+        p_balance_pu=float(np.sum(sol.p)),
+        q_balance_pu=float(np.sum(sol.q)),
+        v_min_pu=float(np.min(sol.v)),
+        v_max_pu=float(np.max(sol.v)),
+        v=np.round(sol.v, 9).tolist() if want_state else None,
+        theta=np.round(sol.theta, 9).tolist() if want_state else None,
+        batch=info,
+    )
+
+
 def parse_request(workload: str, payload: dict):
     """Build the typed request record from a JSON payload, rejecting
     unknown workloads and unknown fields with typed errors."""
@@ -730,6 +779,15 @@ class ServeConfig(NamedTuple):
     # compiled before the first request, tagged in /stats
     # recompiles_by_bucket and excluded from serve_recompiles_total.
     prewarm: Tuple[str, ...] = ()
+    # Incremental serving tier (serve/cache.py; CLI: --serve-cache-mb /
+    # --serve-cache-ttl-s / --serve-delta-max-rank): byte budget for the
+    # per-(case, topology, backend) base-case cache — solutions PLUS the
+    # reusable artifacts (FDLF LU pair, BCSR pattern) — 0 disables the
+    # tier entirely; solution TTL; and the largest changed-bus count the
+    # SMW delta tier will attempt before falling to warm-start seeding.
+    cache_mb: float = 64.0
+    cache_ttl_s: float = 600.0
+    delta_max_rank: int = 16
 
     def bucket_table(self) -> Tuple[int, ...]:
         bs = self.buckets if self.buckets else default_buckets(self.max_batch)
@@ -776,6 +834,17 @@ class Service:
 
             self.mesh = solver_mesh(
                 config.mesh_devices, config.mesh_batch_axis
+            )
+        # The incremental serving tier (exact/delta/warm answers off
+        # cached base-case solutions + factorizations); None = disabled.
+        self.cache = None
+        if config.cache_mb and config.cache_mb > 0:
+            from freedm_tpu.serve.cache import ServeCache
+
+            self.cache = ServeCache(
+                max_bytes=int(config.cache_mb * 1024 * 1024),
+                ttl_s=config.cache_ttl_s,
+                delta_max_rank=config.delta_max_rank,
             )
         self._engines: Dict[Tuple[str, str], _Engine] = {}
         # Global lock guards the maps only; SLOW engine construction
@@ -846,6 +915,23 @@ class Service:
             eng = _ENGINE_TYPES[workload](
                 case, mesh=self.mesh, backend=cfg.pf_backend, **kwargs
             )
+            if workload == "pf" and self.cache is not None:
+                from freedm_tpu.pf.sparse import resolve_backend
+
+                # Resolve the backend ONCE (it is part of the cache
+                # key: dense and sparse solutions agree only to solver
+                # tolerance) and factorize the entry's artifacts here,
+                # inside the engine build lock — first-touch cost, off
+                # the steady-state submit path.
+                from freedm_tpu.serve.cache import topology_digest
+
+                eng.cache_backend = resolve_backend(
+                    cfg.pf_backend, eng.n_bus
+                )
+                eng.cache_topo = topology_digest(eng._sys)
+                eng.publish = self._publish_pf
+                self.cache.entry(case, eng._sys, eng.cache_backend,
+                                 topo=eng.cache_topo)
             with self._engines_lock:
                 self._engines[key] = eng
             return eng
@@ -881,6 +967,15 @@ class Service:
                 jax.block_until_ready(out)
                 self.batcher.note_prewarmed(eng, bucket)
                 done.append(f"{workload}/{case}:{bucket}")
+            if workload == "pf" and self.cache is not None \
+                    and eng.cache_backend is not None:
+                # Compile the incremental tier's delta-correction
+                # program too, so the first delta hit pays a solve,
+                # not an XLA compile.
+                entry = self.cache.entry(case, eng._sys, eng.cache_backend,
+                                         topo=eng.cache_topo)
+                if entry is not None:
+                    self.cache.prewarm_entry(entry)
         return done
 
     # -- submission ----------------------------------------------------------
@@ -925,18 +1020,43 @@ class Service:
             key=eng.key, request=request, prepared=prepared, lanes=lanes,
             deadline=_time.monotonic() + timeout, span=span,
         )
+        # Incremental tier (pf + cache enabled): exact/delta hits return
+        # a completed future without occupying queue depth or device
+        # time; single-flight followers return a pending future parked
+        # on the leader's solve; warm hits seed the prepared arrays and
+        # fall through to admission like any other full solve.  A
+        # request carrying its OWN v0/theta0 bypasses the cache in both
+        # directions — the client is steering the solver (possibly
+        # toward a different solution branch), so neither may the cache
+        # answer for it nor may its steered solution be served to
+        # flat-start clients later.
+        if self.cache is not None and workload == "pf" \
+                and eng.cache_backend is not None \
+                and request.v0 is None and request.theta0 is None:
+            try:
+                fut = self._cache_tier(eng, ticket)
+            except Exception as e:  # noqa: BLE001 — the tier is an
+                # optimization: a failing delta compile/dispatch (or any
+                # cache-side surprise) must never turn an answerable
+                # request into an error — fall through to the full path.
+                ticket.span.tag(cache_error=repr(e))
+                fut = None
+            if fut is not None:
+                return fut
         try:
             self.queue.put(ticket)
-        except Overloaded:
+        except Overloaded as e:
             obs.SERVE_SHED.inc()
             obs.SERVE_REQUESTS.labels(workload, "overloaded").inc()
             span.tag(outcome="overloaded")
             span.end()
+            self._abort_flight(ticket, e)
             raise
-        except ShuttingDown:
+        except ShuttingDown as e:
             obs.SERVE_REQUESTS.labels(workload, "shutdown").inc()
             span.tag(outcome="shutdown")
             span.end()
+            self._abort_flight(ticket, e)
             raise
         return ticket.future
 
@@ -973,6 +1093,172 @@ class Service:
                 f"be solving; its result is discarded)"
             ) from None
 
+    # -- incremental serving tier (serve/cache.py) ---------------------------
+    def _cache_tier(self, eng, ticket: Ticket):
+        """Run one validated pf ticket through the tier ladder.
+
+        Returns the ticket's future when the cache answered (exact or
+        verified delta) or parked it on an in-flight leader (single
+        flight); returns ``None`` when the ticket must take the full
+        path — possibly warm-seeded, and marked as its digest's flight
+        leader so an identical herd coalesces onto this one solve.
+        """
+        from freedm_tpu.serve.cache import injection_digest
+
+        cache = self.cache
+        entry = cache.entry(eng.case, eng._sys, eng.cache_backend,
+                            topo=eng.cache_topo)
+        if entry is None:  # case over the byte budget: stays uncached
+            return None
+        t0 = _time.monotonic()
+        prepared = ticket.prepared
+        p, q = prepared["p"], prepared["q"]
+        digest = injection_digest(p, q)
+        tier, near = cache.lookup(entry, digest, p, q)
+        if profiling.PROFILER.enabled:
+            profiling.PROFILER.record_host(
+                "serve.cache.lookup", _time.monotonic() - t0
+            )
+        if tier == "exact":
+            cache.record("exact")
+            return self._respond_cached(eng, ticket, near, "exact", 0.0)
+        if tier == "delta":
+            t1 = _time.monotonic()
+            ans = cache.delta_answer(entry, near, p, q)
+            if ans is not None:
+                sol = cache.insert(
+                    entry, digest, p, q, ans["v"], ans["theta"], ans["p"],
+                    ans["q"], ans["iterations"], ans["mismatch"], True,
+                )
+                if sol is None:  # entry died mid-answer: serve transient
+                    from freedm_tpu.serve.cache import CachedSolution
+
+                    sol = CachedSolution(
+                        digest, p, q, ans["v"], ans["theta"], ans["p"],
+                        ans["q"], ans["iterations"], ans["mismatch"], True,
+                    )
+                cache.record("delta")
+                return self._respond_cached(
+                    eng, ticket, sol, "delta",
+                    round((_time.monotonic() - t1) * 1e3, 3),
+                )
+            tier = "warm"  # residual fall-through: never served unverified
+        # Full-solve path: claim the digest's flight (or join one).
+        outcome, late = cache.flight_claim(entry, digest, ticket)
+        if outcome == "exact":  # a leader finished while we classified
+            cache.record("exact")
+            return self._respond_cached(eng, ticket, late, "exact", 0.0)
+        if outcome == "joined":
+            cache.record("miss")
+            ticket.span.tag(cache_tier="flight")
+            return ticket.future
+        ticket.cache_flight = (entry.key, digest)
+        if tier == "warm" and near is not None:
+            # Seed the full solve from the nearest cached solution (the
+            # v0/theta0 path PR 4 measured at 37% fewer iterations).
+            # Client-supplied seeds never reach here — submit bypasses
+            # the cache for steered requests.
+            prepared["v0"] = near.v
+            prepared["th0"] = near.theta
+            cache.record("warm")
+            ticket.span.tag(cache_tier="warm")
+        else:
+            cache.record("miss")
+            ticket.span.tag(cache_tier="miss")
+        return None
+
+    def _respond_cached(self, eng, ticket: Ticket, sol, tier: str,
+                        solve_ms: float):
+        """Complete one ticket from a cached/corrected solution — no
+        admission, no batch, no device (exact) or one correction solve
+        (delta)."""
+        info = BatchInfo(lanes=1, bucket=0, queue_ms=0.0,
+                         solve_ms=solve_ms, tier=tier)
+        resp = _response_from_solution(eng, ticket.request, sol, info)
+        ticket.span.tag(cache_tier=tier)
+        ticket.future.set_result(resp)
+        self._complete_ok(ticket, info)
+        return ticket.future
+
+    def _publish_pf(self, eng, group: List[Ticket], v, theta, p, q, its,
+                    conv, mism, info: BatchInfo) -> None:
+        """Scatter-side cache population + single-flight settlement.
+
+        Runs on the executor lane with HOST arrays only (the scatter
+        already pulled them): converged lanes are inserted as cached
+        solutions; followers parked on a lane's flight are answered
+        from that lane's numbers with an ``exact``-tier receipt.
+        """
+        cache = self.cache
+        if cache is None:
+            return
+        from freedm_tpu.serve.cache import CachedSolution, injection_digest
+
+        # peek, never build: an invalidated/LRU-evicted entry means the
+        # in-flight inserts land nowhere (the documented contract), and
+        # an O(n³) artifact re-factorization must never run on the
+        # executor lane.
+        entry = cache.peek_entry(eng.case, eng.cache_topo,
+                                 eng.cache_backend)
+        for i, t in enumerate(group):
+            fl = t.cache_flight
+            if fl is None and (t.request.v0 is not None
+                               or t.request.theta0 is not None):
+                # Client-steered solve: its solution may sit on a
+                # different branch than a flat start would find — never
+                # publish it under an injections-only digest.
+                continue
+            digest = fl[1] if fl is not None else None
+            sol = None
+            if entry is not None and bool(conv[i]):
+                if digest is None:
+                    digest = injection_digest(t.prepared["p"],
+                                              t.prepared["q"])
+                sol = cache.insert(
+                    entry, digest, t.prepared["p"], t.prepared["q"],
+                    v[i], theta[i], p[i], q[i], int(its[i]),
+                    float(mism[i]), True,
+                )
+            if fl is None:
+                continue
+            # Settle BEFORE clearing the ticket's flight mark: an
+            # exception anywhere above leaves the mark in place, so the
+            # batcher's error path still aborts the flight and no
+            # follower can hang on a leaked _Flight.
+            _fentry, followers = cache.settle_flight(fl)
+            t.cache_flight = None
+            if not followers:
+                continue
+            if sol is None:  # dead entry / non-converged: transient
+                sol = CachedSolution(
+                    fl[1], t.prepared["p"], t.prepared["q"], v[i],
+                    theta[i], p[i], q[i], int(its[i]), float(mism[i]),
+                    bool(conv[i]),
+                )
+            # Followers are answered from the just-populated solution —
+            # semantically an exact hit, so the receipt matches one
+            # (bucket 0: no batch of *theirs* existed).
+            finfo = BatchInfo(lanes=1, bucket=0, queue_ms=0.0,
+                              solve_ms=0.0, tier="exact")
+            for f in followers:
+                try:
+                    f.future.set_result(
+                        _response_from_solution(eng, f.request, sol, finfo)
+                    )
+                    self._complete_ok(f, finfo)
+                except Exception as e:  # noqa: BLE001 — never hang the rest
+                    self._complete_error(f, e)
+
+    def _abort_flight(self, ticket: Ticket, err: BaseException) -> None:
+        """A flight leader failed/expired/shed before populating the
+        cache: fail its followers with the same typed error."""
+        fl = getattr(ticket, "cache_flight", None)
+        if fl is None or self.cache is None:
+            return
+        ticket.cache_flight = None
+        for f in self.cache.abort_flight(fl):
+            self._complete_error(f, err)
+
     # -- completion accounting (called by the batcher / queue) ---------------
     def _expire(self, ticket: Ticket) -> None:
         obs.SERVE_REQUESTS.labels(ticket.key[0], "deadline").inc()
@@ -981,9 +1267,9 @@ class Service:
         )
         ticket.span.tag(outcome="deadline")
         ticket.span.end()
-        ticket.future.set_exception(
-            DeadlineExceeded("deadline passed while queued")
-        )
+        err = DeadlineExceeded("deadline passed while queued")
+        ticket.future.set_exception(err)
+        self._abort_flight(ticket, err)
 
     def _complete_ok(self, ticket: Ticket, info: BatchInfo) -> None:
         self._ok_counters[ticket.key[0]].inc()
@@ -1006,6 +1292,7 @@ class Service:
         ticket.span.end()
         if not ticket.future.done():
             ticket.future.set_exception(err)
+        self._abort_flight(ticket, err)
 
     # -- introspection / lifecycle -------------------------------------------
     def stats(self) -> dict:
@@ -1045,6 +1332,12 @@ class Service:
             # /stats read mid-recompile-storm sees a consistent table.
             "recompiles_by_bucket": dict(
                 sorted(self.batcher.shape_table().items())
+            ),
+            # Incremental-tier state: hit/miss/eviction counts, byte
+            # budget occupancy, flight joins (docs/serving.md).
+            "cache": (
+                {"enabled": True, **self.cache.stats()}
+                if self.cache is not None else {"enabled": False}
             ),
             "batch_lanes": metric("serve_batch_lanes"),
             "queue_wait_seconds": metric("serve_queue_wait_seconds"),

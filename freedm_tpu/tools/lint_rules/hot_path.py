@@ -95,6 +95,21 @@ HOT_PATHS: Tuple[HotPath, ...] = (
             sources=("r", "results"), allow=frozenset({"asarray"})),
     HotPath("freedm_tpu/serve/service.py", "VVCEngine.scatter",
             sources=("out", "results"), allow=frozenset({"asarray"})),
+    # Incremental serving tier (serve/cache.py): lookup and insert are
+    # pure host work (dict probes + numpy compares over host arrays) —
+    # zero syncs allowed, ever: a device pull on the submit path would
+    # re-serialize exactly the latency the cache exists to remove.  The
+    # delta tier's correction is the ONE designed sync of the cache
+    # path: delta_answer dispatches the jitted program and pulls the
+    # candidate at the verify boundary (np.asarray), where the host
+    # float64 residual check decides serve-or-fall-through.
+    HotPath("freedm_tpu/serve/cache.py", "ServeCache.lookup"),
+    HotPath("freedm_tpu/serve/cache.py", "ServeCache.insert"),
+    HotPath("freedm_tpu/serve/cache.py", "ServeCache.delta_answer",
+            source_calls=("delta_fn",), allow=frozenset({"asarray"})),
+    # The scatter-side cache population + single-flight settlement:
+    # host arrays only (scatter already performed the designed pull).
+    HotPath("freedm_tpu/serve/service.py", "Service._publish_pf"),
     # QSTS chunk loop: run_chunk owns the designed chunk-exit sync +
     # host pull (checkpoint state must be host numpy); the outer study
     # loop and the job workers must not sync at all.

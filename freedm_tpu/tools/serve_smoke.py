@@ -93,6 +93,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         ok("typed_invalid_request",
            code == 400 and d["error"]["type"] == "invalid_request",
            f"code={code}")
+        # Incremental tier (ISSUE 10): a repeat of an already-served
+        # query must answer from the cache (receipt tier "exact", no
+        # batch dispatched — bucket 0).
+        code, d = _post(srv.port, "/v1/pf", {"case": "case14", "scale": 1.0})
+        ok("cache_exact_repeat",
+           code == 200 and d["batch"]["tier"] == "exact"
+           and d["batch"]["bucket"] == 0,
+           f"batch={d.get('batch')}")
         with urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/stats", timeout=10
         ) as r:
@@ -103,6 +111,10 @@ def main(argv: Optional[List[str]] = None) -> int:
            and set(stats["executor_lanes"]) == {"pf", "n1", "vvc"},
            f"depth={stats['pipeline_depth']} "
            f"lanes={sorted(stats['executor_lanes'])}")
+        ok("stats_cache_block",
+           stats["cache"]["enabled"] is True
+           and stats["cache"]["hits"]["exact"] >= 1,
+           f"cache={stats['cache']}")
     finally:
         srv.stop()
         svc.stop()

@@ -390,6 +390,136 @@ class ServeLoader:
         }
 
 
+class CacheProbe:
+    """Delta-heavy repeat-query phase against one slice's serve port
+    (ISSUE 10): a few distinct base injection vectors, each re-queried
+    several times (exact-hit traffic) interleaved with rank-1
+    perturbations (delta-hit traffic).  The slice's ``/stats`` cache
+    block is snapshotted before/after so the asserted hit ratio covers
+    exactly this window, and the client-side p50s give the artifact a
+    delta-vs-full speedup figure measured through the real HTTP path.
+    """
+
+    #: Known bus counts; any other case is learned from a
+    #: ``return_state`` response at run time (no hardcoded crash).
+    N_BUS = {"case14": 14, "case_ieee30": 30}
+
+    def __init__(self, port: int, case: str = "case14"):
+        self.port = int(port)
+        self.case = case
+        self.n = self.N_BUS.get(case)
+
+    def _learn_n(self) -> Optional[int]:
+        import urllib.request
+
+        body = json.dumps({"case": self.case, "return_state": True,
+                           "timeout_s": 120}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}/v1/pf", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return len(json.loads(r.read())["v"])
+        except Exception:
+            return None
+
+    def _cache_stats(self) -> Dict:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.port}/stats", timeout=30
+            ) as r:
+                return json.loads(r.read()).get("cache") or {}
+        except Exception:
+            return {}
+
+    def _query(self, p_inj) -> Optional[float]:
+        import urllib.request
+
+        body = json.dumps({
+            "case": self.case, "p_inj": list(p_inj),
+            "q_inj": [0.0] * self.n, "timeout_s": 120,
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}/v1/pf", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                json.loads(r.read())
+            return time.perf_counter() - t0
+        except Exception:
+            return None
+
+    def run(self, bases: int = 3, repeats: int = 6,
+            perturbed: int = 12) -> Optional[Dict[str, float]]:
+        import random
+
+        if self.n is None:
+            self.n = self._learn_n()
+        if self.n is None:
+            return None  # case unreachable/unknown: skip, don't crash
+        before = self._cache_stats()
+        if not before.get("enabled", False):
+            return None
+        base_vecs = []
+        for b in range(bases):
+            p = [0.0] * self.n
+            p[1 + b % (self.n - 1)] = -0.05 * (b + 1)
+            base_vecs.append(p)
+        # Prime each base (cold full solves through the serve path).
+        prime_lats = [self._query(p) for p in base_vecs]
+        # Repeat phase: identical vectors — the exact tier's traffic.
+        exact_lats = []
+        for _ in range(repeats):
+            for p in base_vecs:
+                exact_lats.append(self._query(p))
+        # Perturbed phase: rank-1 deltas — the SMW delta tier's traffic.
+        rng = random.Random(5)
+        delta_lats = []
+        for j in range(perturbed):
+            p = list(base_vecs[j % bases])
+            p[2 + j % (self.n - 3)] += rng.uniform(-0.02, 0.02)
+            delta_lats.append(self._query(p))
+        after = self._cache_stats()
+
+        def count(d, *path):
+            cur: object = d
+            for k in path:
+                cur = (cur or {}).get(k, 0) if isinstance(cur, dict) else 0
+            return float(cur or 0)
+
+        hits_e = count(after, "hits", "exact") - count(before, "hits", "exact")
+        hits_d = count(after, "hits", "delta") - count(before, "hits", "delta")
+        hits_w = count(after, "hits", "warm") - count(before, "hits", "warm")
+        misses = count(after, "misses") - count(before, "misses")
+        lookups = hits_e + hits_d + hits_w + misses
+
+        def p50(lats):
+            ok = sorted(x for x in lats if x is not None)
+            return round(ok[len(ok) // 2] * 1e3, 3) if ok else None
+
+        out: Dict[str, float] = {
+            "serve_cache_probe_hit_ratio": (
+                round((hits_e + hits_d) / lookups, 4) if lookups else 0.0
+            ),
+            "serve_cache_probe_lookups": lookups,
+            "serve_cache_probe_exact_hits": hits_e,
+            "serve_cache_probe_delta_hits": hits_d,
+            "serve_cache_probe_exact_p50_ms": p50(exact_lats),
+            "serve_cache_probe_delta_p50_ms": p50(delta_lats),
+            "serve_cache_probe_full_p50_ms": p50(prime_lats),
+        }
+        full = out["serve_cache_probe_full_p50_ms"]
+        delta = out["serve_cache_probe_delta_p50_ms"]
+        if full and delta:
+            out["serve_cache_probe_delta_speedup"] = round(full / delta, 2)
+        return out
+
+
 class QstsProbe:
     """One QSTS job driven across the kill/restart schedule.
 
@@ -706,6 +836,8 @@ def run_soak(
     check = Check()
     slice_metrics: Dict[str, Dict[str, float]] = {}
     loader: Optional[ServeLoader] = None
+    serve_summary: Optional[Dict[str, float]] = None
+    cache_summary: Optional[Dict[str, float]] = None
     slo_pairs: List[Dict] = []
     pre_kill_pairs: List[Dict] = []
     slo_status: Dict = {}
@@ -870,6 +1002,34 @@ def run_soak(
         crashed = [p.spec.uuid for p in procs if not p.alive()]
         check.record("no_unexpected_crashes", not crashed, f"crashed={crashed}")
 
+        # Delta-heavy repeat-query phase (ISSUE 10): stop the random
+        # background load FIRST so the /stats counter window measures
+        # the probe's repeat/perturbed traffic, not the loader's noise,
+        # then assert the incremental tier actually absorbed it.
+        if serve_load:
+            if loader is not None:
+                serve_summary = loader.stop()
+                loader = None
+            cache_target = next(
+                (p for p in sorted(procs,
+                                   key=lambda p: p.spec is not specs[-1])
+                 if p.alive() and p.spec.serve_port is not None),
+                None,
+            )
+            if cache_target is not None:
+                cache_summary = CacheProbe(
+                    cache_target.spec.serve_port
+                ).run()
+                ratio = (cache_summary or {}).get(
+                    "serve_cache_probe_hit_ratio"
+                )
+                check.record(
+                    "serve_cache_hit_ratio_over_half",
+                    ratio is not None and ratio > 0.5,
+                    f"ratio={ratio} "
+                    f"speedup={(cache_summary or {}).get('serve_cache_probe_delta_speedup')}",
+                )
+
         if probe is not None and probe.submitted:
             job = probe.wait(timeout_s=max(2.0 * form_timeout, 300.0))
             completed = job.get("state") == "completed"
@@ -962,7 +1122,8 @@ def run_soak(
             if p.alive() and p.spec.metrics_port is not None
         )
     finally:
-        serve_summary = loader.stop() if loader is not None else None
+        if loader is not None:
+            serve_summary = loader.stop()
         for p in procs:
             p.kill()
             p._release_port()
@@ -983,6 +1144,10 @@ def run_soak(
         # died before the final scrape).
         totals.update(serve_summary)
         totals.setdefault("serve_shed_total", serve_summary["serve_client_shed_429"])
+    if cache_summary is not None:
+        # The repeat-query phase's hit ratio + delta speedup, measured
+        # through the live slice's HTTP path and its /stats window.
+        totals.update(cache_summary)
     # Per-slice trace files + a merged mini-report: the artifact records
     # how causally connected the run was (cross-node links prove the
     # wire trace context survived the lossy transport), with the full

@@ -94,3 +94,60 @@ def test_smw_screen_handles_pinned_endpoints():
     st[pinned[0]] = 0.0
     rr = fd(status=jnp.asarray(st))
     np.testing.assert_allclose(np.asarray(r.v)[0], np.asarray(rr.v), atol=1e-8)
+
+
+def test_smw_delta_solve_matches_dense_refactorization():
+    """The public correction solve (ISSUE 10 satellite) against the
+    float64 oracle: for random rank-k updates, (A + U Vᵀ)⁻¹ b computed
+    via smw_delta_solve must match numpy's dense re-factorization of
+    the updated matrix."""
+    from freedm_tpu.pf.n1 import smw_delta_solve
+
+    rng = np.random.default_rng(3)
+    n = 24
+    a = rng.normal(size=(n, n)) + n * np.eye(n)  # well-conditioned base
+    lu = jax.scipy.linalg.lu_factor(jnp.asarray(a))
+    b = rng.normal(size=n)
+    for k in (1, 2, 5):
+        u = rng.normal(size=(n, k)) / np.sqrt(n)
+        v = rng.normal(size=(n, k)) / np.sqrt(n)
+        got = np.asarray(smw_delta_solve(lu, jnp.asarray(u),
+                                         jnp.asarray(v), jnp.asarray(b)))
+        want = np.linalg.solve(a + u @ v.T, b)
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-10)
+
+
+def test_smw_delta_solve_precomputed_and_rank0_paths():
+    """The two call-site shapes: precomputed z/cap (the N-1 screen's
+    build-time Z columns) must equal the from-scratch path exactly, and
+    the rank-0 degenerate case (the serving cache's injection-delta
+    tier: matrix unchanged) must be the bare base solve."""
+    from freedm_tpu.pf.n1 import smw_delta_solve
+
+    rng = np.random.default_rng(7)
+    n, k = 16, 2
+    a = rng.normal(size=(n, n)) + n * np.eye(n)
+    lu = jax.scipy.linalg.lu_factor(jnp.asarray(a))
+    b = jnp.asarray(rng.normal(size=n))
+    u = jnp.asarray(rng.normal(size=(n, k)) / np.sqrt(n))
+    v = jnp.asarray(rng.normal(size=(n, k)) / np.sqrt(n))
+    z = jax.scipy.linalg.lu_solve(lu, u)
+    cap = jnp.eye(k) + v.T @ z
+    full = np.asarray(smw_delta_solve(lu, u, v, b))
+    pre = np.asarray(smw_delta_solve(lu, None, v, b, z=z, cap=cap))
+    np.testing.assert_allclose(pre, full, rtol=0, atol=1e-13)
+    # The structured-Vᵀ hook (the N-1 screen's gather form) must be the
+    # same correction: here V's columns are masked one-hots at idx.
+    idx = jnp.asarray([3, 11])
+    mask = jnp.asarray([1.0, 1.0])
+    v_oh = jnp.zeros((n, k)).at[idx, jnp.arange(k)].set(mask)
+    dense = np.asarray(smw_delta_solve(lu, u, v_oh, b))
+    gather = np.asarray(smw_delta_solve(
+        lu, u, None, b,
+        # cap is not precomputed here, so vt also sees the [n, k] Z —
+        # mask per ROW for matrices, per element for vectors.
+        vt=lambda x: x[idx] * (mask[:, None] if x.ndim == 2 else mask)))
+    np.testing.assert_allclose(gather, dense, rtol=0, atol=1e-13)
+    rank0 = np.asarray(smw_delta_solve(lu, None, None, b))
+    want0 = np.linalg.solve(a, np.asarray(b))
+    np.testing.assert_allclose(rank0, want0, rtol=0, atol=1e-10)

@@ -41,8 +41,11 @@ BUCKETS = (1, 2, 4)
 
 @pytest.fixture(scope="module")
 def svc():
+    # cache_mb=0: this module pins the admission/batching/dispatch path
+    # itself — the incremental tier would answer repeat requests before
+    # they ever reach it (tests/test_serve_cache.py covers cache-on).
     s = Service(ServeConfig(max_batch=4, max_wait_ms=25.0, queue_depth=64,
-                            buckets=BUCKETS))
+                            buckets=BUCKETS, cache_mb=0.0))
     yield s
     s.stop()
 
@@ -470,7 +473,10 @@ def test_pf_warm_start_fields_cut_iterations(svc):
 def test_http_overload_sheds_with_429():
     # A service whose batcher never runs: the queue fills and stays full,
     # so admission control is exercised deterministically.
-    svc2 = Service(ServeConfig(max_batch=4, queue_depth=1, buckets=(1, 4)),
+    # cache_mb=0: the second identical request must hit ADMISSION (the
+    # cache's single-flight would park it on the first one instead).
+    svc2 = Service(ServeConfig(max_batch=4, queue_depth=1, buckets=(1, 4),
+                               cache_mb=0.0),
                    start=False)
     srv = ServeServer(svc2, port=0).start()
     try:
@@ -548,7 +554,12 @@ def test_pipeline_matches_serialized_byte_identical():
     composition the two schedulers happened to coalesce (the single
     fixed bucket keeps every batch at one compiled shape, so per-lane
     results cannot depend on who shared the batch)."""
-    cfg = dict(max_batch=4, max_wait_ms=25.0, queue_depth=64, buckets=(4,))
+    # cache_mb=0 here: this is the BATCHING equivalence oracle (which
+    # tier a request lands on depends on thread timing with the cache
+    # on); the cache-on equivalence contract has its own oracle in
+    # tests/test_serve_cache.py.
+    cfg = dict(max_batch=4, max_wait_ms=25.0, queue_depth=64, buckets=(4,),
+               cache_mb=0.0)
     svc_pipe = Service(ServeConfig(pipeline_depth=2, **cfg))
     svc_ser = Service(ServeConfig(pipeline_depth=0, **cfg))
     try:
@@ -572,7 +583,8 @@ def test_pipeline_ordered_per_ticket_completion():
     FIFO through the workload's single executor lane, and the scatter
     loop resolves a batch's futures in group (= pop) order."""
     svc2 = Service(ServeConfig(max_batch=2, max_wait_ms=5.0, queue_depth=64,
-                               buckets=(1, 2), pipeline_depth=2))
+                               buckets=(1, 2), pipeline_depth=2,
+                               cache_mb=0.0))  # identical tickets must QUEUE
     try:
         order = []
         lock = threading.Lock()
@@ -640,7 +652,8 @@ def test_watchdog_stall_detection_per_lane():
     journal = obs.JsonlEventJournal()
     mon = SloMonitor(SloConfig(watchdog_s=0.05), journal=journal)
     svc2 = Service(ServeConfig(max_batch=2, max_wait_ms=2.0, queue_depth=64,
-                               buckets=(1, 2), pipeline_depth=1))
+                               buckets=(1, 2), pipeline_depth=1,
+                               cache_mb=0.0))  # repeats must reach the lane
     try:
         # Warm the engine/bucket first so the stall below is the gate,
         # not an XLA compile.
@@ -692,7 +705,8 @@ def test_adaptive_coalescing_skips_empty_window():
     solve time so the old behavior would be unmissable."""
     svc2 = Service(ServeConfig(max_batch=4, max_wait_ms=400.0,
                                queue_depth=64, buckets=(1, 2, 4),
-                               pipeline_depth=2))
+                               pipeline_depth=2,
+                               cache_mb=0.0))  # the repeat must DISPATCH
     try:
         svc2.request("pf", {"case": "case14"})  # compile the shape
         t0 = time.monotonic()

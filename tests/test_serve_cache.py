@@ -1,0 +1,489 @@
+"""Incremental serving tier tests (``freedm_tpu.serve.cache``,
+ISSUE 10): tier ladder correctness under churn (delta answers within
+solver tolerance of full solves, residual fall-through), invalidation
+on topology mutation (stale entry never served), LRU+TTL eviction under
+a tiny byte budget, single-flight population (a cold herd solves once;
+a failed leader fails its followers typed), byte-identity of the
+``--serve-pipeline-depth 0`` oracle with caching on, and the GL006
+cache-lock ↔ queue-condition acyclicity cross-check.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from freedm_tpu.core import metrics as M
+from freedm_tpu.grid.matpower import load_builtin
+from freedm_tpu.serve import ServeConfig, ServeError, Service
+from freedm_tpu.serve.cache import (
+    ServeCache,
+    injection_digest,
+    topology_digest,
+)
+from freedm_tpu.serve.service import PowerFlowRequest
+
+BUCKETS = (1, 2, 4)
+T = 300  # generous per-request timeout: first touches compile
+
+
+def _cfg(**kw):
+    base = dict(max_batch=4, max_wait_ms=5.0, queue_depth=64,
+                buckets=BUCKETS)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def svc():
+    s = Service(_cfg())
+    # Prime the base case once: every test below starts from a warm
+    # engine + a populated base entry.
+    r = s.request("pf", PowerFlowRequest(case="case14", timeout_s=T))
+    assert r.converged and r.batch.tier == "full"
+    yield s
+    s.stop()
+
+
+@pytest.fixture(scope="module")
+def cold_svc():
+    """The cache-off reference service the correctness tests compare
+    against (every request here is a full solve)."""
+    s = Service(_cfg(cache_mb=0.0))
+    yield s
+    s.stop()
+
+
+def _base_inj(svc):
+    eng = svc.engine("pf", "case14")
+    return np.array(eng._p0), np.array(eng._q0)
+
+
+# ---------------------------------------------------------------------------
+# tier ladder
+# ---------------------------------------------------------------------------
+
+
+def test_exact_hit_serves_from_cache_without_dispatch(svc):
+    before = M.SERVE_BATCH_LANES.labels("pf").count
+    r1 = svc.request("pf", PowerFlowRequest(case="case14", timeout_s=T))
+    r2 = svc.request("pf", PowerFlowRequest(case="case14", timeout_s=T,
+                                            return_state=True))
+    assert r1.batch.tier == "exact" and r2.batch.tier == "exact"
+    assert r1.batch.bucket == 0 and r1.batch.solve_ms == 0.0
+    # No batch was dispatched for either answer...
+    assert M.SERVE_BATCH_LANES.labels("pf").count == before
+    # ...and the answer is the solved solution, state included on ask.
+    assert r2.converged and len(r2.v) == 14
+    assert r1.iterations == r2.iterations
+    assert svc.stats()["cache"]["hits"]["exact"] >= 2
+
+
+def test_delta_hits_match_full_solves_across_random_deltas(svc, cold_svc):
+    """Churn correctness: random small-rank injection deltas answered by
+    the delta tier agree with cache-off full solves to solver tolerance,
+    and every delta answer carries a verified residual."""
+    p0, q0 = _base_inj(svc)
+    rng = np.random.default_rng(11)
+    served_delta = 0
+    for trial in range(5):
+        p = p0.copy()
+        q = q0.copy()
+        for j in rng.choice(14, size=rng.integers(1, 4), replace=False):
+            p[j] += rng.uniform(-0.05, 0.05)
+            q[j] += rng.uniform(-0.02, 0.02)
+        req = dict(case="case14", p_inj=p.tolist(), q_inj=q.tolist(),
+                   return_state=True, timeout_s=T)
+        warm = svc.request("pf", PowerFlowRequest(**req))
+        full = cold_svc.request("pf", PowerFlowRequest(**req))
+        assert warm.converged and full.converged
+        if warm.batch.tier == "delta":
+            served_delta += 1
+            assert warm.residual_pu <= 1e-8  # host-verified, not claimed
+        assert np.max(np.abs(np.array(warm.v) - np.array(full.v))) < 1e-6
+        assert np.max(np.abs(np.array(warm.theta)
+                             - np.array(full.theta))) < 1e-6
+    assert served_delta >= 4  # the ladder actually exercised tier 2
+
+
+def test_delta_residual_fallthrough_never_serves_unverified(svc):
+    """An impossible verify bar forces every delta attempt to fall
+    through: the answer must come from a full (warm-seeded) solve, and
+    the delta-hit counter must not move."""
+    p0, q0 = _base_inj(svc)
+    p = p0.copy()
+    p[2] += 0.031
+    cache = svc.cache
+    before = dict(svc.stats()["cache"]["hits"])
+    old_tol = cache.verify_tol
+    cache.verify_tol = 1e-300
+    try:
+        r = svc.request("pf", PowerFlowRequest(
+            case="case14", p_inj=p.tolist(), q_inj=q0.tolist(), timeout_s=T))
+    finally:
+        cache.verify_tol = old_tol
+    assert r.converged and r.batch.tier == "full"
+    after = svc.stats()["cache"]["hits"]
+    assert after["delta"] == before["delta"]
+    assert after["warm"] == before["warm"] + 1  # seeded, solved, verified
+
+
+def test_warm_tier_seeds_and_cuts_iterations(svc, cold_svc):
+    """A delta too large for tier 2 (every bus moved) still wins: the
+    full solve is seeded from the nearest cached solution and converges
+    in fewer Newton iterations than the cold flat start."""
+    warm = svc.request("pf", PowerFlowRequest(case="case14", scale=1.35,
+                                              timeout_s=T))
+    cold = cold_svc.request("pf", PowerFlowRequest(case="case14", scale=1.35,
+                                                   timeout_s=T))
+    assert warm.converged and cold.converged
+    assert warm.batch.tier == "full"
+    assert warm.iterations < cold.iterations
+    assert svc.stats()["cache"]["hits"]["warm"] >= 1
+
+
+def test_client_supplied_seed_bypasses_cache_both_ways():
+    """A request carrying its own v0/theta0 is steering the solver
+    (possibly toward a different solution branch): the cache must
+    neither answer it NOR publish its steered solution under an
+    injections-only digest for flat-start clients to hit later."""
+    svc3 = Service(_cfg(delta_max_rank=0))  # no delta tier: seeds matter
+    try:
+        r0 = svc3.request("pf", PowerFlowRequest(
+            case="case14", return_state=True, timeout_s=T))
+        before = svc3.stats()["cache"]
+        seeded = PowerFlowRequest(case="case14", scale=1.28, v0=r0.v,
+                                  theta0=r0.theta, timeout_s=T)
+        r = svc3.request("pf", seeded)
+        assert r.converged and r.batch.tier == "full"
+        after = svc3.stats()["cache"]
+        # No lookup was recorded at all: the tier ladder never ran.
+        assert after["hits"] == before["hits"]
+        assert after["misses"] == before["misses"]
+        # ...and the steered solution was NOT inserted: the same
+        # injections without seeds miss (full solve), then hit.
+        flat = PowerFlowRequest(case="case14", scale=1.28, timeout_s=T)
+        assert svc3.request("pf", flat).batch.tier == "full"
+        assert svc3.request("pf", flat).batch.tier == "exact"
+    finally:
+        svc3.stop()
+
+
+# ---------------------------------------------------------------------------
+# invalidation / eviction
+# ---------------------------------------------------------------------------
+
+
+def test_topology_mutation_means_stale_entry_unreachable():
+    """The cache key carries a topology digest: a mutated-status case (a
+    branch reactance bumped — an outage baked into the table) resolves
+    to a DIFFERENT entry, so the stale solution cannot be served."""
+    sys14 = load_builtin("case14")
+    mutated = dataclasses.replace(
+        sys14, x=np.array(sys14.x) * np.where(
+            np.arange(sys14.n_branch) == 3, 1e6, 1.0)
+    )
+    assert topology_digest(sys14) != topology_digest(mutated)
+    cache = ServeCache(max_bytes=32 << 20)
+    e1 = cache.entry("case14", sys14, "dense")
+    p, q = np.array(sys14.p_inj), np.array(sys14.q_inj)
+    dig = injection_digest(p, q)
+    cache.insert(e1, dig, p, q, np.ones(14), np.zeros(14), p, q, 3,
+                 1e-10, True)
+    assert cache.lookup(e1, dig, p, q)[0] == "exact"
+    e2 = cache.entry("case14", mutated, "dense")
+    assert e2 is not e1 and e2.key != e1.key
+    tier, _ = cache.lookup(e2, dig, p, q)
+    assert tier == "miss"  # the stale solution is unreachable
+
+
+def test_service_invalidate_drops_entries(svc):
+    svc.request("pf", PowerFlowRequest(case="case14", timeout_s=T))
+    assert svc.stats()["cache"]["solutions"] >= 1
+    dropped = svc.cache.invalidate("case14")
+    assert dropped >= 1
+    r = svc.request("pf", PowerFlowRequest(case="case14", timeout_s=T))
+    assert r.batch.tier == "full"  # nothing stale survived to answer
+    assert svc.stats()["cache"]["evictions"]["invalidate"] >= 1
+
+
+def test_lru_eviction_under_tiny_budget():
+    """A budget with room for the artifacts plus ~2 solutions: inserting
+    a ladder of distinct solutions must evict LRU-first and keep the
+    byte accounting under the budget."""
+    sys14 = load_builtin("case14")
+    cache = ServeCache(max_bytes=5500)  # artifacts ~3.4 KB + ~2 solutions
+    ent = cache.entry("case14", sys14, "dense")
+    assert ent is not None and ent.artifact_bytes > 0
+    p0, q0 = np.array(sys14.p_inj), np.array(sys14.q_inj)
+    digs = []
+    for i in range(6):
+        p = p0 + 0.01 * (i + 1)
+        d = injection_digest(p, q0)
+        digs.append(d)
+        cache.insert(ent, d, p, q0, np.ones(14), np.zeros(14), p, q0,
+                     3, 1e-10, True)
+        assert cache.bytes <= cache.max_bytes
+    st = cache.stats()
+    assert st["evictions"]["lru"] >= 4
+    assert cache.lookup(ent, digs[0], p0 + 0.01, q0)[0] != "exact"  # evicted
+    # The most recent survivor is still exact-servable.
+    assert cache.lookup(ent, digs[-1], p0 + 0.06, q0)[0] == "exact"
+
+
+def test_over_budget_case_is_never_cached():
+    sys14 = load_builtin("case14")
+    cache = ServeCache(max_bytes=1024)  # under the two-LU artifact cost
+    assert cache.entry("case14", sys14, "dense") is None
+
+
+def test_ttl_expiry_evicts_at_next_touch():
+    sys14 = load_builtin("case14")
+    cache = ServeCache(max_bytes=32 << 20, ttl_s=0.05)
+    ent = cache.entry("case14", sys14, "dense")
+    p, q = np.array(sys14.p_inj), np.array(sys14.q_inj)
+    dig = injection_digest(p, q)
+    cache.insert(ent, dig, p, q, np.ones(14), np.zeros(14), p, q, 3,
+                 1e-10, True)
+    assert cache.lookup(ent, dig, p, q)[0] == "exact"
+    time.sleep(0.08)
+    tier, _ = cache.lookup(ent, dig, p, q)
+    assert tier == "miss"
+    assert cache.stats()["evictions"]["ttl"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# single flight
+# ---------------------------------------------------------------------------
+
+
+def test_cold_herd_populates_once():
+    """N concurrent identical requests on a cold digest: one leader
+    solves, the rest join its flight — exactly one pf batch dispatches
+    and every waiter gets the same answer."""
+    # delta_max_rank=0: on a 14-bus case EVERY small delta is
+    # rank-eligible, and a delta answer would (correctly) avoid the
+    # dispatch this test counts — force the herd onto the full path.
+    svc2 = Service(_cfg(delta_max_rank=0))
+    try:
+        svc2.request("pf", PowerFlowRequest(case="case14", timeout_s=T))
+        before = M.SERVE_BATCH_LANES.labels("pf").count
+        req = PowerFlowRequest(case="case14", scale=0.93, timeout_s=T)
+        n = 6
+        barrier = threading.Barrier(n)
+        results = [None] * n
+
+        def worker(i):
+            barrier.wait(timeout=60)
+            results[i] = svc2.request("pf", req)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=T)
+        assert all(r is not None and r.converged for r in results)
+        vals = {(r.iterations, r.residual_pu, r.v_min_pu) for r in results}
+        assert len(vals) == 1  # everyone got the leader's solution
+        assert M.SERVE_BATCH_LANES.labels("pf").count == before + 1
+        st = svc2.stats()["cache"]
+        assert st["flight_joins"] >= n - 1
+        tiers = sorted(r.batch.tier for r in results)
+        assert tiers.count("full") == 1 and tiers.count("exact") == n - 1
+    finally:
+        svc2.stop()
+
+
+def test_flight_followers_fail_with_their_leader():
+    """A follower never occupies queue depth — and never hangs: the
+    leader's typed failure propagates to everyone riding it."""
+    svc2 = Service(_cfg(), start=False)
+    try:
+        req = PowerFlowRequest(case="case14", timeout_s=T)
+        f_lead = svc2.submit("pf", req)
+        f_join = svc2.submit("pf", req)
+        assert svc2.queue.depth_lanes == 1  # the follower is parked, not queued
+        eng = svc2.engine("pf", "case14")
+        eng.solve = lambda batch: (_ for _ in ()).throw(
+            RuntimeError("injected cold-solve crash"))
+        svc2.start()
+        for f in (f_lead, f_join):
+            with pytest.raises(ServeError) as ei:
+                f.result(timeout=T)
+            assert ei.value.code == "internal"
+    finally:
+        svc2.stop()
+
+
+def test_invalidate_mid_flight_insert_lands_nowhere():
+    """Invalidation while a flight is queued: the waiters are still
+    answered (leader full, follower exact) but the solve's insert lands
+    nowhere — the scatter path peeks, never rebuilds, so no stale-keyed
+    entry reappears and no artifact factorization runs on the executor
+    lane."""
+    svc2 = Service(_cfg(delta_max_rank=0), start=False)
+    try:
+        req = PowerFlowRequest(case="case14", timeout_s=T)
+        f_lead = svc2.submit("pf", req)
+        f_join = svc2.submit("pf", req)
+        assert svc2.cache.invalidate("case14") == 0  # entries, no solutions
+        svc2.start()
+        r_lead = f_lead.result(timeout=T)
+        r_join = f_join.result(timeout=T)
+        assert r_lead.converged and r_join.converged
+        assert r_lead.batch.tier == "full"
+        assert r_join.batch.tier == "exact" and r_join.batch.bucket == 0
+        st = svc2.stats()["cache"]
+        assert st["entries"] == 0 and st["solutions"] == 0
+    finally:
+        svc2.stop()
+
+
+# ---------------------------------------------------------------------------
+# pipeline-oracle equivalence, artifacts, stats, locks
+# ---------------------------------------------------------------------------
+
+
+def _strip_batch(resp) -> str:
+    d = resp.to_dict()
+    tier = d.pop("batch")["tier"]
+    return json.dumps({"tier": tier, **d}, sort_keys=True)
+
+
+def test_depth0_oracle_byte_identity_with_cache_on():
+    """The same sequential request ladder (cold, exact, delta, warm)
+    through the pipelined path and the --serve-pipeline-depth 0 oracle,
+    both with caching on: identical responses AND identical tiers."""
+    svc_pipe = Service(_cfg(pipeline_depth=2))
+    svc_ser = Service(_cfg(pipeline_depth=0))
+    try:
+        p0 = np.array(svc_pipe.engine("pf", "case14")._p0)
+        q0 = np.array(svc_pipe.engine("pf", "case14")._q0)
+        p_d = p0.copy()
+        p_d[4] += 0.02
+        ladder = [
+            PowerFlowRequest(case="case14", timeout_s=T),
+            PowerFlowRequest(case="case14", timeout_s=T),  # exact
+            PowerFlowRequest(case="case14", p_inj=p_d.tolist(),
+                             q_inj=q0.tolist(), return_state=True,
+                             timeout_s=T),  # delta
+            PowerFlowRequest(case="case14", scale=1.35, timeout_s=T),  # warm
+        ]
+        got_p = [_strip_batch(svc_pipe.request("pf", r)) for r in ladder]
+        got_s = [_strip_batch(svc_ser.request("pf", r)) for r in ladder]
+        assert got_p == got_s
+        assert [json.loads(g)["tier"] for g in got_p] == [
+            "full", "exact", "delta", "full"]
+    finally:
+        svc_pipe.stop()
+        svc_ser.stop()
+
+
+def test_entry_artifacts_shared_and_dc_reuses_b_prime():
+    """The entry's DC screen attaches without a second B′ factorization
+    (make_dc_solver's lu= reuse): no dc.factorize host timer fires, and
+    the screen solves sanely off the shared factors."""
+    from freedm_tpu.core import profiling
+
+    sys14 = load_builtin("case14")
+    cache = ServeCache(max_bytes=32 << 20)
+    ent = cache.entry("case14", sys14, "dense")
+    profiling.PROFILER.configure(enabled=True)
+    try:
+        dc = ent.dc_solver()
+        assert ent.dc_solver() is dc  # built once
+        host = profiling.PROFILER.snapshot()["host"]
+        assert "dc.factorize" not in host  # the cached LU was reused
+        r = dc.solve()
+        theta = np.asarray(r.theta)
+        assert np.all(np.isfinite(theta)) and theta.shape == (14,)
+    finally:
+        profiling.PROFILER.reset()
+
+
+def test_prewarm_compiles_delta_program():
+    svc2 = Service(_cfg(prewarm=("pf/case14",)))
+    try:
+        ent = svc2.cache.entry(
+            "case14", svc2.engine("pf", "case14")._sys, "dense")
+        assert ent is not None and ent.delta_fn is not None
+    finally:
+        svc2.stop()
+
+
+def test_stats_and_http_expose_cache_block(svc):
+    st = svc.stats()["cache"]
+    assert st["enabled"] is True
+    for key in ("bytes", "budget_bytes", "entries", "solutions", "hits",
+                "misses", "evictions", "hit_ratio", "flight_joins"):
+        assert key in st
+    assert st["bytes"] <= st["budget_bytes"]
+    # Disabled config reports itself honestly.
+    svc_off = Service(_cfg(cache_mb=0.0), start=False)
+    assert svc_off.stats()["cache"] == {"enabled": False}
+    svc_off.stop()
+
+
+def test_debuglock_cache_lock_queue_condition_acyclic():
+    """ISSUE 10 satellite: the cache lock and the admission queue's
+    condition never nest in either direction (lookup happens before
+    put; scatter-side inserts happen outside the queue), and the
+    observed order composes acyclically with GL006's static graph."""
+    import pathlib
+
+    from freedm_tpu.core.debuglock import DebugLock, LockOrderRecorder
+    from freedm_tpu.tools.gridlint import run_lint
+
+    rec = LockOrderRecorder()
+    cond_name = "freedm_tpu/serve/queue.py:AdmissionQueue._cond"
+    cache_name = "freedm_tpu/serve/cache.py:ServeCache._lock"
+    svc2 = Service(_cfg(), start=False)
+    svc2.queue._cond = threading.Condition(
+        lock=DebugLock(cond_name, recorder=rec))
+    svc2.cache._lock = DebugLock(cache_name, recorder=rec)
+    try:
+        svc2.start()
+        p0 = np.array(svc2.engine("pf", "case14")._p0)
+        q0 = np.array(svc2.engine("pf", "case14")._q0)
+        svc2.request("pf", PowerFlowRequest(case="case14", timeout_s=T))
+        svc2.request("pf", PowerFlowRequest(case="case14", timeout_s=T))
+        p_d = p0.copy()
+        p_d[1] += 0.02
+        svc2.request("pf", PowerFlowRequest(
+            case="case14", p_inj=p_d.tolist(), q_inj=q0.tolist(),
+            timeout_s=T))
+        threads = [
+            threading.Thread(target=lambda: svc2.request(
+                "pf", PowerFlowRequest(case="case14", scale=0.97,
+                                       timeout_s=T)))
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=T)
+    finally:
+        svc2.stop()
+
+    observed = rec.snapshot_edges()
+    assert rec.acquisitions > 0
+    assert (cache_name, cond_name) not in observed
+    assert (cond_name, cache_name) not in observed
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    static = run_lint(
+        [str(root / "freedm_tpu" / d) for d in ("serve", "scenarios",
+                                                "core")],
+        root=str(root),
+    )
+    static_edges = {
+        tuple(e) for e in static.artifacts["lock_graph"]["edges"]
+    }
+    union = observed | static_edges
+    assert LockOrderRecorder.find_cycle(union) is None, (
+        "observed cache lock order contradicts the GL006 static graph"
+    )
