@@ -68,6 +68,7 @@ class Runtime:
     serve_server: Optional[object] = None  # serve.ServeServer (--serve-port)
     qsts_jobs: Optional[object] = None  # scenarios.JobManager (--serve-port)
     slo_monitor: Optional[object] = None  # slo.SloMonitor (--slo-enabled)
+    router_server: Optional[object] = None  # serve.router (--router-port)
 
     def start(self) -> "Runtime":
         if self.endpoint is not None:
@@ -90,6 +91,8 @@ class Runtime:
                 slo_mod.install(None)
         if self.endpoint is not None:
             self.endpoint.stop()
+        if self.router_server is not None:
+            self.router_server.stop()
         if self.serve_server is not None:
             self.serve_server.stop()
         if self.qsts_jobs is not None:
@@ -183,6 +186,32 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                     metavar="S", help="stall watchdog: busy with no progress "
                                       "for S seconds journals watchdog.stall "
                                       "(default 20)")
+    ap.add_argument("--fault-spec", default=None, metavar="SPEC",
+                    help="deterministic fault-injection schedule: "
+                         "'[seed=N;]point:rate[:arg=V][:after=N][:max=N]' "
+                         "over the named injection points (UDP drop/dup/"
+                         "delay, executor delay/crash, replica stall/kill, "
+                         "cache corruption — docs/robustness.md); unset = "
+                         "disabled at one-attribute-check cost")
+    ap.add_argument("--router-port", type=int, default=None, metavar="PORT",
+                    help="run the replica ROUTER on PORT (0 = ephemeral): "
+                         "consistent-hash requests over --router-replica "
+                         "serve endpoints with health probes, circuit "
+                         "breakers, deadline-budgeted retries, and typed "
+                         "shed (docs/robustness.md)")
+    ap.add_argument("--router-replica", action="append", default=None,
+                    metavar="HOST:PORT",
+                    help="a replica serve endpoint behind --router-port "
+                         "(repeatable)")
+    ap.add_argument("--router-probe-interval-s", type=float, default=None,
+                    metavar="S", help="router /healthz probe cadence "
+                                      "(default 1)")
+    ap.add_argument("--router-breaker-failures", type=int, default=None,
+                    metavar="N", help="consecutive transport failures that "
+                                      "open a replica's breaker (default 3)")
+    ap.add_argument("--router-breaker-cooldown-s", type=float, default=None,
+                    metavar="S", help="breaker open -> half-open cooldown "
+                                      "(default 2)")
     ap.add_argument("--serve-port", type=int, default=None, metavar="PORT",
                     help="serve the JSON what-if query API (pf/N-1/VVC) on "
                          "PORT (0 = ephemeral; unset = disabled)")
@@ -301,6 +330,12 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("slo_overrun_rate", "slo_overrun_rate"),
         ("slo_qsts_floor", "slo_qsts_floor"),
         ("slo_watchdog_s", "slo_watchdog_s"),
+        ("fault_spec", "fault_spec"),
+        ("router_port", "router_port"),
+        ("router_replica", "router_replica"),
+        ("router_probe_interval_s", "router_probe_interval_s"),
+        ("router_breaker_failures", "router_breaker_failures"),
+        ("router_breaker_cooldown_s", "router_breaker_cooldown_s"),
         ("serve_port", "serve_port"), ("serve_max_batch", "serve_max_batch"),
         ("serve_max_wait_ms", "serve_max_wait_ms"),
         ("serve_queue_depth", "serve_queue_depth"),
@@ -361,6 +396,14 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         from freedm_tpu.core import profiling
 
         profiling.PROFILER.configure(enabled=True)
+
+    if cfg.fault_spec:
+        # Fault schedule installed before any subsystem exists, so the
+        # very first datagram/dispatch is already under the schedule
+        # (the determinism contract counts draws from zero).
+        from freedm_tpu.core.faults import FAULTS
+
+        FAULTS.configure(cfg.fault_spec)
 
     # Config sanity BEFORE any resource is bound: --mesh-devices and
     # --federate are different deployment shapes, and rejecting them
@@ -615,6 +658,35 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             f"serve: http://127.0.0.1:{serve_server.port}/v1/pf "
             f"(n1: /v1/n1, vvc: /v1/vvc, qsts: /v1/qsts, health: /healthz)"
         )
+    router_server = None
+    if cfg.router_port is not None:
+        # Fleet front door (serve/router.py): consistent-hash the named
+        # replica serve endpoints so each replica's incremental cache
+        # stays hot, with health probes, breakers, deadline-budgeted
+        # retries, drain handling, and typed shed.
+        from freedm_tpu.serve.router import (
+            Router,
+            RouterConfig,
+            RouterServer,
+        )
+
+        if not cfg.router_replica:
+            raise ValueError(
+                "--router-port needs at least one --router-replica "
+                "(host:port serve endpoint)"
+            )
+        router_server = RouterServer(
+            Router(list(cfg.router_replica), RouterConfig(
+                probe_interval_s=cfg.router_probe_interval_s,
+                breaker_failures=cfg.router_breaker_failures,
+                breaker_cooldown_s=cfg.router_breaker_cooldown_s,
+            )),
+            port=cfg.router_port,
+        ).start()
+        logger.status(
+            f"router: http://127.0.0.1:{router_server.port}/v1/pf over "
+            f"{len(cfg.router_replica)} replica(s)"
+        )
     slo_monitor = None
     if cfg.slo_enabled:
         # The judgment layer over the registry: objectives evaluated on
@@ -651,7 +723,7 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
     return Runtime(
         cfg, timings, broker, fleet, factories, vvc, endpoint, federation,
         telemetry, mesh_mod, metrics_server, serve_service, serve_server,
-        qsts_jobs, slo_monitor,
+        qsts_jobs, slo_monitor, router_server,
     )
 
 

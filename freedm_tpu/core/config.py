@@ -313,6 +313,24 @@ class GlobalConfig:
     qsts_max_jobs: int = 16
     qsts_chunk_steps: int = 24
     qsts_checkpoint_dir: Optional[str] = None
+    # Fault injection (freedm_tpu.core.faults): a seeded, deterministic
+    # fault schedule as "[seed=N;]point:rate[:arg=V][:after=N][:max=N]"
+    # entries over the named injection points (docs/robustness.md).
+    # Unset = disabled at one-attribute-check cost, like tracing.
+    fault_spec: Optional[str] = None
+    # Replica router (freedm_tpu.serve.router): run THIS process as the
+    # fleet front door instead of a solver — consistent-hash requests
+    # over router-replica entries ("host:port" serve endpoints) with
+    # health probes, per-replica circuit breakers, deadline-budgeted
+    # retries, and typed shed (docs/robustness.md).  Unset = no router.
+    router_port: Optional[int] = None
+    router_replica: List[str] = field(default_factory=list)
+    # Active /healthz probe cadence over the replica table.
+    router_probe_interval_s: float = 1.0
+    # Consecutive transport failures that open a replica's breaker, and
+    # the open -> half-open cooldown.
+    router_breaker_failures: int = 3
+    router_breaker_cooldown_s: float = 2.0
     # Profiling registry (freedm_tpu.core.profiling): per-(workload,
     # shape-bucket) jit compile accounting, device-memory peaks, and
     # host hot-path timers, exported as profile_* metrics and the

@@ -1,0 +1,288 @@
+"""Deterministic, config-driven fault injection.
+
+The robustness story (replica failover, typed shed, retry budgets,
+residual-verified cache fall-through) is only as honest as the faults
+it was proven against.  This module is the framework's one fault
+switchboard: a process-wide registry (:data:`FAULTS`) of **named
+injection points** threaded through the stack — UDP drop/dup/delay in
+the DCN endpoint, slow/crashing dispatch on the serve executor lanes,
+replica stall/kill in the HTTP front end, cache-artifact corruption on
+the delta tier (which the float64 residual verify must catch), and a
+QSTS worker crash that exercises the jobs requeue path — so the chaos
+rig (:mod:`freedm_tpu.tools.chaos`) and the soak can drive a fleet
+through a *scripted* fault schedule instead of hoping production finds
+the interleavings first.
+
+Design rules (the same discipline as ``TRACER``/``PROFILER``):
+
+- **Disabled by default at one-attribute-check cost.**  Every
+  instrumented site guards on ``FAULTS.enabled`` before calling
+  anything, so the production hot paths (DCN pump, executor lanes) pay
+  exactly one attribute read when no faults are configured.
+- **Deterministic.**  Each point draws from its own
+  ``random.Random(f"{seed}:{name}")`` stream and counts its draws, so
+  a given ``--fault-spec`` replays the identical fire sequence run
+  after run (per point; cross-point interleaving is whatever the
+  threads do, but each point's Nth draw always lands the same way).
+  :meth:`FaultRegistry.sequence` exposes the replay for tests.
+- **Declared, not stringly.**  :data:`KNOWN_POINTS` is the catalogue;
+  a spec naming an unknown point is a configuration error, not a
+  silently-dead fault.
+
+Spec grammar (``--fault-spec`` CLI/cfg key)::
+
+    [seed=N;]name:rate[:key=val[:key=val...]][;name:rate...]
+
+``rate`` is the per-draw fire probability in [0, 1].  Optional keys:
+``arg`` (a float the site interprets — a delay in seconds, a
+corruption magnitude), ``after`` (skip the first N draws), ``max``
+(stop firing after N fires).  Example::
+
+    seed=7;dcn.drop_tx:0.25;serve.exec.delay:1:arg=0.05:max=3
+
+Fired injections count on ``faults_injected_total{point}``; configuring
+the registry journals one ``faults.configured`` event.  See
+``docs/robustness.md`` for the point catalogue and the fault model.
+
+Like :mod:`freedm_tpu.core.tracing`, this module imports nothing
+heavyweight at module load (no jax, no numpy): the metrics hook is
+imported lazily on the first actual fire.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+#: The injection-point catalogue: every name a spec may configure, and
+#: where in the stack it fires.  docs/robustness.md documents each.
+KNOWN_POINTS: Dict[str, str] = {
+    "dcn.drop_rx": "drop an incoming UDP datagram before decode "
+                   "(dcn/endpoint.py _on_datagram)",
+    "dcn.drop_tx": "drop an outgoing UDP datagram at the socket "
+                   "(dcn/endpoint.py _flush)",
+    "dcn.dup_tx": "send an outgoing UDP datagram twice "
+                  "(dcn/endpoint.py _flush)",
+    "dcn.delay_tx": "sleep `arg` seconds before an outgoing datagram "
+                    "(dcn/endpoint.py _flush runs under the endpoint "
+                    "lock, so this stalls the WHOLE endpoint — a frozen "
+                    "transport, not per-link latency)",
+    "serve.exec.delay": "sleep `arg` seconds on the executor lane "
+                        "before a batch dispatch (serve/batcher.py)",
+    "serve.exec.crash": "raise inside a batch dispatch — the batch "
+                        "fails typed `internal`, the lane survives "
+                        "(serve/batcher.py)",
+    "serve.replica.stall": "sleep `arg` seconds in the HTTP handler "
+                           "before serving a request (serve/http.py)",
+    "serve.replica.kill": "hard-exit the replica process (os._exit) "
+                          "from the HTTP handler (serve/http.py)",
+    "serve.cache.corrupt": "perturb the delta tier's candidate "
+                           "solution by `arg` pu BEFORE the float64 "
+                           "residual verify — the verify must catch it "
+                           "and fall through (serve/cache.py)",
+    "qsts.worker.crash": "raise at a QSTS chunk boundary — the job "
+                         "manager requeues the job from its checkpoint "
+                         "(scenarios/jobs.py)",
+}
+
+
+class FaultPoint:
+    """One configured injection point's state (draws are serialized by
+    the registry lock; the per-point RNG stream is what makes the fire
+    sequence replayable)."""
+
+    __slots__ = ("name", "rate", "arg", "after", "max_fires",
+                 "draws", "fires", "_rng")
+
+    def __init__(self, name: str, rate: float,
+                 arg: Optional[float] = None,
+                 after: int = 0, max_fires: Optional[int] = None,
+                 seed: int = 0):
+        self.name = name
+        self.rate = float(rate)
+        # None = "not configured" (the site's default applies); an
+        # explicit arg=0 is a real value, not a fall-through.
+        self.arg = None if arg is None else float(arg)
+        self.after = int(after)
+        self.max_fires = max_fires
+        self.draws = 0
+        self.fires = 0
+        # str-seeded Random is deterministic across processes (it does
+        # not go through PYTHONHASHSEED), which is the replay contract.
+        self._rng = random.Random(f"{seed}:{name}")
+
+
+def parse_spec(spec: str) -> Tuple[int, List[FaultPoint]]:
+    """Parse a ``--fault-spec`` string; raises ``ValueError`` on an
+    unknown point name or malformed entry (typos must not become
+    silently-dead faults)."""
+    seed = 0
+    entries: List[Tuple[str, float, Dict[str, str]]] = []
+    for raw in str(spec).split(";"):
+        part = raw.strip()
+        if not part:
+            continue
+        if part.startswith("seed="):
+            seed = int(part[len("seed="):])
+            continue
+        bits = part.split(":")
+        if len(bits) < 2:
+            raise ValueError(
+                f"fault-spec entry {part!r} is not name:rate[:key=val...]"
+            )
+        name = bits[0].strip()
+        if name not in KNOWN_POINTS:
+            raise ValueError(
+                f"unknown fault point {name!r} "
+                f"(have: {', '.join(sorted(KNOWN_POINTS))})"
+            )
+        rate = float(bits[1])
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"fault rate for {name!r} must be in [0, 1]")
+        kv: Dict[str, str] = {}
+        for b in bits[2:]:
+            if "=" not in b:
+                raise ValueError(f"fault-spec option {b!r} is not key=val")
+            k, _, v = b.partition("=")
+            if k not in ("arg", "after", "max"):
+                raise ValueError(
+                    f"unknown fault option {k!r} (have: arg, after, max)"
+                )
+            kv[k] = v
+        entries.append((name, rate, kv))
+    points = [
+        FaultPoint(
+            name, rate,
+            arg=float(kv["arg"]) if "arg" in kv else None,
+            after=int(kv.get("after", 0)),
+            max_fires=int(kv["max"]) if "max" in kv else None,
+            seed=seed,
+        )
+        for name, rate, kv in entries
+    ]
+    return seed, points
+
+
+class FaultRegistry:
+    """The process-wide fault switchboard.
+
+    ``enabled`` is a plain attribute — instrumented sites guard on it
+    before calling :meth:`should`, so the disabled hot path is one
+    attribute check.  All draw/fire state is serialized under one lock
+    (only ever taken while faults are configured)."""
+
+    def __init__(self):
+        self.enabled = False
+        self.seed = 0
+        self._lock = threading.Lock()
+        self._points: Dict[str, FaultPoint] = {}
+
+    # -- configuration -------------------------------------------------------
+    def configure(self, spec: Optional[str]) -> "FaultRegistry":
+        """Install a spec (``None``/empty disables).  Journals one
+        ``faults.configured`` event when enabling."""
+        if not spec:
+            self.reset()
+            return self
+        seed, points = parse_spec(spec)
+        with self._lock:
+            self.seed = seed
+            self._points = {p.name: p for p in points}
+            self.enabled = bool(points)
+        if self.enabled:
+            from freedm_tpu.core import metrics as obs
+
+            obs.EVENTS.emit(
+                "faults.configured", seed=seed,
+                points={p.name: p.rate for p in points},
+            )
+        return self
+
+    def reset(self) -> None:
+        """Back to the disabled boot state (tests, teardown)."""
+        with self._lock:
+            self.enabled = False
+            self.seed = 0
+            self._points = {}
+
+    # -- the injection sites -------------------------------------------------
+    def should(self, name: str) -> bool:
+        """One deterministic draw for ``name``: True when the fault
+        fires.  Callers guard on ``.enabled`` first — this method is
+        never reached on the disabled path."""
+        p = self._points.get(name)
+        if p is None:
+            return False
+        with self._lock:
+            p.draws += 1
+            if p.draws <= p.after:
+                return False
+            if p.max_fires is not None and p.fires >= p.max_fires:
+                return False
+            hit = p._rng.random() < p.rate
+            if hit:
+                p.fires += 1
+        if hit:
+            # Outside the registry lock: the metric family has its own
+            # lock and nothing may nest inside this one (GL006).
+            from freedm_tpu.core import metrics as obs
+
+            obs.FAULTS_INJECTED.labels(name).inc()
+        return hit
+
+    def arg(self, name: str, default: float = 0.0) -> float:
+        p = self._points.get(name)
+        return p.arg if p is not None and p.arg is not None else default
+
+    def sleep_point(self, name: str, default_s: float = 0.05) -> bool:
+        """Fire a delay-style point: sleeps the point's ``arg`` (or
+        ``default_s``) when it fires.  Returns whether it fired."""
+        if self.should(name):
+            time.sleep(self.arg(name, default_s))
+            return True
+        return False
+
+    # -- introspection (tests, chaos artifact) -------------------------------
+    def sequence(self, name: str, n: int) -> List[bool]:
+        """The NEXT ``n`` draws ``name`` would produce, without
+        consuming them — the determinism oracle for tests (a fresh
+        registry configured with the same spec must fire identically)."""
+        p = self._points.get(name)
+        if p is None:
+            return [False] * n
+        with self._lock:
+            rng = random.Random()
+            rng.setstate(p._rng.getstate())
+            draws, fires = p.draws, p.fires
+            out: List[bool] = []
+            for _ in range(n):
+                draws += 1
+                if draws <= p.after or (
+                    p.max_fires is not None and fires >= p.max_fires
+                ):
+                    out.append(False)
+                    continue
+                hit = rng.random() < p.rate
+                if hit:
+                    fires += 1
+                out.append(hit)
+        return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "seed": self.seed,
+                "points": {
+                    p.name: {"rate": p.rate, "arg": p.arg,
+                             "after": p.after, "max": p.max_fires,
+                             "draws": p.draws, "fires": p.fires}
+                    for p in self._points.values()
+                },
+            }
+
+
+#: The process-wide fault registry every injection site guards on.
+FAULTS = FaultRegistry()

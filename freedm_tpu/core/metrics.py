@@ -783,6 +783,48 @@ SERVE_CACHE_BYTES = REGISTRY.gauge(
     "Bytes held by the serving cache (solutions + per-case artifacts) "
     "against the --serve-cache-mb budget")
 
+# -- replica router (freedm_tpu.serve.router) -------------------------------
+ROUTER_REQUESTS = REGISTRY.counter(
+    "router_requests_total",
+    "Routed requests by final outcome as seen by the CLIENT "
+    "(ok/invalid/overloaded/unavailable/deadline/error/...)",
+    labels=("outcome",))
+ROUTER_RETRIES = REGISTRY.counter(
+    "router_retries_total",
+    "Proxy attempts beyond each request's first (failover or backoff "
+    "retry, always inside the request's own deadline budget)")
+ROUTER_FAILOVERS = REGISTRY.counter(
+    "router_failovers_total",
+    "Requests served by a replica other than their hash-affinity owner "
+    "(owner down, draining, or breaker-open)")
+ROUTER_SHED = REGISTRY.counter(
+    "router_shed_total",
+    "Requests shed with a typed 503 + Retry-After because no replica "
+    "was available (every breaker open / every replica down)")
+ROUTER_BREAKER_STATE = REGISTRY.gauge(
+    "router_breaker_state",
+    "Per-replica circuit state: 0 closed, 1 half-open, 2 open",
+    labels=("replica",))
+ROUTER_BREAKER_TRANSITIONS = REGISTRY.counter(
+    "router_breaker_transitions_total",
+    "Circuit-breaker state changes per replica, by new state",
+    labels=("replica", "state"))
+ROUTER_REPLICAS_AVAILABLE = REGISTRY.gauge(
+    "router_replicas_available",
+    "Replicas currently admittable (healthy, not draining, breaker "
+    "not open)")
+ROUTER_PROXY_LATENCY = REGISTRY.histogram(
+    "router_proxy_seconds",
+    "Wall time of one proxied attempt (connect + replica answer)",
+    buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0, 30.0))
+
+# -- fault injection (freedm_tpu.core.faults) -------------------------------
+FAULTS_INJECTED = REGISTRY.counter(
+    "faults_injected_total",
+    "Fault-injection fires by point name (zero unless --fault-spec "
+    "configured a schedule; see docs/robustness.md)",
+    labels=("point",))
+
 # -- QSTS scenario engine (freedm_tpu.scenarios) ----------------------------
 QSTS_SUBMITTED = REGISTRY.counter(
     "qsts_jobs_submitted_total", "QSTS jobs accepted by the jobs API")
@@ -803,6 +845,10 @@ QSTS_SCENARIO_RATE = REGISTRY.gauge(
     "Scenario-timesteps per second of the most recent QSTS chunk")
 QSTS_RESUMES = REGISTRY.counter(
     "qsts_resumes_total", "QSTS jobs resumed from a chunk checkpoint")
+QSTS_REQUEUED = REGISTRY.counter(
+    "qsts_jobs_requeued_total",
+    "QSTS jobs auto-requeued after a worker crash (resumed from their "
+    "last chunk checkpoint instead of requiring manual resubmission)")
 
 # -- static analysis (freedm_tpu.tools.gridlint) ----------------------------
 GRIDLINT_FINDINGS = REGISTRY.counter(

@@ -36,6 +36,7 @@ from typing import Callable, Dict, Optional, Tuple, Union
 import numpy as np
 
 from freedm_tpu.core import metrics
+from freedm_tpu.core.faults import FAULTS
 from freedm_tpu.dcn import wire
 from freedm_tpu.dcn.protocol import SrChannel
 from freedm_tpu.runtime.messages import ModuleMessage
@@ -166,6 +167,8 @@ class UdpEndpoint:
     def _on_datagram(self, data: bytes, addr: Tuple[str, int]) -> None:
         metrics.DCN_DATAGRAMS_IN.inc()
         metrics.DCN_BYTES_IN.inc(len(data))
+        if FAULTS.enabled and FAULTS.should("dcn.drop_rx"):
+            return  # injected ingress drop (docs/robustness.md)
         if self.incoming_reliability < 100 and (
             self._rng.integers(100) >= self.incoming_reliability
         ):
@@ -199,10 +202,22 @@ class UdpEndpoint:
         for datagram in wire.encode_windows(self.uuid, frames, time.time()):
             if st.reliability < 100 and self._rng.integers(100) >= st.reliability:
                 continue  # IProtocol.cpp:94-101 outgoing drop
+            sends = 1
+            if FAULTS.enabled:
+                # Injected egress faults (docs/robustness.md): the SR
+                # protocol above must absorb drops/dups/delays exactly
+                # like real loss — that equivalence is what the chaos
+                # schedule proves.
+                if FAULTS.should("dcn.drop_tx"):
+                    continue
+                if FAULTS.should("dcn.dup_tx"):
+                    sends = 2
+                FAULTS.sleep_point("dcn.delay_tx", 0.02)
             try:
-                self._sock.sendto(datagram, st.addr)
-                metrics.DCN_DATAGRAMS_OUT.inc()
-                metrics.DCN_BYTES_OUT.inc(len(datagram))
+                for _ in range(sends):
+                    self._sock.sendto(datagram, st.addr)
+                    metrics.DCN_DATAGRAMS_OUT.inc()
+                    metrics.DCN_BYTES_OUT.inc(len(datagram))
             except OSError:
                 pass  # unreachable peers retry on the resend clock
 

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional
 
 from freedm_tpu.core import metrics as obs
 from freedm_tpu.core import tracing
+from freedm_tpu.core.faults import FAULTS
 from freedm_tpu.scenarios.engine import StudyCancelled, StudySpec, run_study
 from freedm_tpu.scenarios.profiles import PROFILE_KINDS
 from freedm_tpu.serve.queue import (
@@ -171,6 +172,7 @@ class JobRecord:
     chunks_done: int = 0
     chunks_total: int = 0
     resumed_from_chunk: int = 0
+    requeues: int = 0  # worker-crash auto-requeues consumed so far
     summary: Optional[dict] = None
     error: Optional[str] = None
     cancel: threading.Event = field(default_factory=threading.Event)
@@ -184,6 +186,7 @@ class JobRecord:
             "chunks_done": self.chunks_done,
             "chunks_total": self.chunks_total,
             "resumed_from_chunk": self.resumed_from_chunk,
+            "requeues": self.requeues,
         }
         if self.job_key is not None:
             out["job_key"] = self.job_key
@@ -207,6 +210,12 @@ class JobManager:
     """
 
     MAX_TABLE = 256
+
+    #: Worker-crash auto-requeues per job: a job whose worker died
+    #: mid-chunk is resumed from its last checkpoint this many times
+    #: before it is declared failed (a deterministic bug would requeue
+    #: forever otherwise).
+    MAX_REQUEUES = 2
 
     def __init__(self, workers: int = 1, max_pending: int = 16,
                  checkpoint_dir: Optional[str] = None,
@@ -377,6 +386,11 @@ class JobManager:
             obs.QSTS_CHUNK_SECONDS.observe(chunk_s)
             if chunk_s > 0:
                 obs.QSTS_SCENARIO_RATE.set(lane_steps / chunk_s)
+            if FAULTS.enabled and FAULTS.should("qsts.worker.crash"):
+                # Injected worker death at a chunk boundary — the
+                # requeue path below must resume this job from the
+                # checkpoint the chunk just wrote (docs/robustness.md).
+                raise RuntimeError("fault injected: qsts.worker.crash")
 
         ckpt_path = self._checkpoint_path(rec)
         try:
@@ -386,6 +400,7 @@ class JobManager:
                     cancel=rec.cancel, on_chunk=on_chunk,
                 )
             rec.summary = summary
+            rec.error = None  # clear a prior requeue's crash record
             rec.resumed_from_chunk = summary.get("resumed_from_chunk", 0)
             if rec.resumed_from_chunk:
                 obs.QSTS_RESUMES.inc()
@@ -402,14 +417,43 @@ class JobManager:
             obs.EVENTS.emit("qsts.cancelled", job_id=rec.id,
                             chunks=rec.chunks_done)
         except Exception as e:  # noqa: BLE001 — pollers must see failures
+            if self._try_requeue(rec, ckpt_path, e, span):
+                return  # back on the pending queue; not terminal
             rec.state = "failed"
             rec.error = repr(e)
             span.tag(outcome="failed", error=repr(e))
             obs.QSTS_JOBS.labels("failed").inc()
             obs.EVENTS.emit("qsts.failed", job_id=rec.id, error=repr(e))
         finally:
-            rec.finished_ts = time.time()
+            if rec.state in ("completed", "failed", "cancelled"):
+                rec.finished_ts = time.time()
             span.end()
             with self._cond:
                 self._worker_beats.pop(ident, None)
             obs.QSTS_RUNNING.dec()
+
+    def _try_requeue(self, rec: JobRecord, ckpt_path: Optional[str],
+                     err: BaseException, span) -> bool:
+        """A worker died mid-study: requeue the job to resume from its
+        chunk checkpoint instead of demanding a manual resubmission.
+        Only checkpointed (keyed) jobs requeue — an unkeyed job would
+        silently restart from scratch — and only ``MAX_REQUEUES``
+        times, so a deterministic crash still terminates as failed."""
+        if ckpt_path is None or rec.cancel.is_set():
+            return False
+        with self._cond:
+            if self._closed or rec.requeues >= self.MAX_REQUEUES:
+                return False
+            rec.requeues += 1
+            rec.state = "queued"
+            rec.error = repr(err)  # visible to pollers mid-requeue
+            self._pending.append(rec)
+            self._cond.notify()
+        obs.QSTS_REQUEUED.inc()
+        span.tag(outcome="requeued", error=repr(err),
+                 requeue=rec.requeues)
+        obs.EVENTS.emit(
+            "qsts.requeued", job_id=rec.id, error=repr(err),
+            requeue=rec.requeues, chunks_done=rec.chunks_done,
+        )
+        return True

@@ -15,6 +15,7 @@ from freedm_tpu.serve.queue import (  # noqa: F401
     Overloaded,
     ServeError,
     ShuttingDown,
+    Unavailable,
 )
 from freedm_tpu.serve.service import (  # noqa: F401
     N1Request,
@@ -36,3 +37,9 @@ from freedm_tpu.serve.cache import (  # noqa: F401
     topology_digest,
 )
 from freedm_tpu.serve.http import ServeServer  # noqa: F401
+from freedm_tpu.serve.router import (  # noqa: F401
+    HashRing,
+    Router,
+    RouterConfig,
+    RouterServer,
+)

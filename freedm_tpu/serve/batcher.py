@@ -78,6 +78,7 @@ from typing import Dict, List, Optional
 from freedm_tpu.core import metrics as obs
 from freedm_tpu.core import profiling
 from freedm_tpu.core import tracing
+from freedm_tpu.core.faults import FAULTS
 from freedm_tpu.serve.queue import ServeError, ShuttingDown, Ticket
 
 
@@ -587,6 +588,16 @@ class MicroBatcher:
         )
         solve_s = 0.0
         try:
+            if FAULTS.enabled:
+                # Injected executor faults (docs/robustness.md): a
+                # delay models a compile storm / slow device; a crash
+                # must fail ONLY this batch's tickets with the typed
+                # `internal` error while the lane itself survives —
+                # the crash-containment contract the router's retry
+                # depends on.
+                FAULTS.sleep_point("serve.exec.delay")
+                if FAULTS.should("serve.exec.crash"):
+                    raise RuntimeError("fault injected: serve.exec.crash")
             with work.span.activate():
                 t0 = time.monotonic()
                 with tracing.TRACER.start(

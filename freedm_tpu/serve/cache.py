@@ -69,6 +69,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from freedm_tpu.core import faults
 from freedm_tpu.core import metrics as obs
 from freedm_tpu.core import profiling
 
@@ -511,6 +512,15 @@ class ServeCache:
             profiling.PROFILER.record_host(
                 "serve.cache.delta_solve", time.monotonic() - t0
             )
+        if faults.FAULTS.enabled and faults.FAULTS.should(
+            "serve.cache.corrupt"
+        ):
+            # Injected artifact corruption (docs/robustness.md): the
+            # candidate is perturbed BEFORE the verify, on the already-
+            # pulled host arrays.  The float64 residual check below is
+            # the only thing standing between this and a wrong answer —
+            # it must catch the corruption and fall through.
+            v = v + faults.FAULTS.arg("serve.cache.corrupt", 0.05)
         if not (np.all(np.isfinite(theta)) and np.all(np.isfinite(v))):
             return None
         err = entry.verify(theta, v, p, q)

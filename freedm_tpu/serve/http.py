@@ -30,8 +30,17 @@ Errors are *typed*, never free-text-only: the body is always
 ``{"error": {"type": <ServeError.code>, "detail": ...}}`` with the
 matching HTTP status (400 invalid_request, 404 not_found, 429
 overloaded, 503 shutting_down, 504 deadline_exceeded, 500 internal).
-Clients switch on ``error.type``; 429/503 mean back off and retry,
+Clients switch on ``error.type``; 429/503 mean back off and retry —
+and carry a ``Retry-After`` header sized by the error class — while
 400/404/504 mean don't.
+
+Fleet discipline (docs/robustness.md): a request arriving with an
+``X-Deadline-Budget-S`` header (the router's propagated deadline
+budget) has its ``timeout_s`` clamped to that budget, so a retried
+request can never outlive the deadline its client is still waiting
+on.  ``/healthz`` reports ``draining: true`` once shutdown has begun
+(:meth:`ServeServer.begin_drain`) — the router stops sending new work
+while in-flight requests finish.
 
 Keep-alive discipline: handlers speak HTTP/1.1 persistent connections,
 so every error path must leave the socket **positionally clean** — the
@@ -45,9 +54,11 @@ unread bytes can never be parsed as the next pipelined request.
 from __future__ import annotations
 
 import json
+import os
 from http.server import BaseHTTPRequestHandler
 from urllib.parse import urlparse
 
+from freedm_tpu.core.faults import FAULTS
 from freedm_tpu.core.metrics import BackgroundHttpServer
 from freedm_tpu.serve.queue import InvalidRequest, NotFound, ServeError
 from freedm_tpu.serve.service import BUS_CASES, FEEDER_CASES, WORKLOADS, Service
@@ -55,6 +66,53 @@ from freedm_tpu.serve.service import BUS_CASES, FEEDER_CASES, WORKLOADS, Service
 #: Request bodies past this are refused unread (a 256-outage N-1
 #: request is ~2 KB; nothing legitimate approaches a megabyte).
 MAX_BODY_BYTES = 4_000_000
+
+
+def retry_after_header(seconds) -> str:
+    """The one ``Retry-After`` formatting rule of both HTTP front ends
+    (this server and the replica router): whole seconds, floor 1."""
+    return str(int(max(1, round(float(seconds)))))
+
+
+def read_request_body(handler, max_bytes: int = MAX_BODY_BYTES) -> bytes:
+    """The shared keep-alive body discipline of BOTH HTTP front ends
+    (this server and the replica router): read the declared request
+    body, or refuse it with the connection marked for close — either
+    way the socket is left positionally clean for (or closed against)
+    the next pipelined request."""
+    raw = handler.headers.get("Content-Length") or "0"
+    try:
+        length = int(raw)
+    except ValueError:
+        length = -1
+    if length < 0 or length > max_bytes:
+        handler.close_connection = True
+        raise InvalidRequest(
+            f"request body over {max_bytes} bytes or "
+            f"Content-Length unparseable ({raw!r})"
+        )
+    return handler.rfile.read(length) if length else b""
+
+
+def apply_deadline_budget(payload, header_value) -> None:
+    """Clamp a workload payload's ``timeout_s`` to the router's
+    propagated ``X-Deadline-Budget-S`` budget (in place).  A request
+    must not out-wait the client that is still holding the deadline
+    upstream; an unparseable or non-positive budget is ignored."""
+    if not header_value or not isinstance(payload, dict):
+        return
+    try:
+        budget = float(header_value)
+    except (TypeError, ValueError):
+        return
+    if budget <= 0:
+        return
+    t = payload.get("timeout_s")
+    payload["timeout_s"] = (
+        min(float(t), budget)
+        if isinstance(t, (int, float)) and not isinstance(t, bool) and t > 0
+        else budget
+    )
 
 
 class ServeServer(BackgroundHttpServer):
@@ -67,6 +125,10 @@ class ServeServer(BackgroundHttpServer):
         # no auth; widening the bind is an explicit caller decision.
         svc = service
         jm = jobs
+        # Closed over by the handler; begin_drain()/stop() flip it so
+        # /healthz advertises the drain to the router's prober.
+        flags = {"draining": False}
+        self._flags = flags
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -74,11 +136,17 @@ class ServeServer(BackgroundHttpServer):
             def log_message(self, *a):  # load generators must not spam stderr
                 pass
 
-            def _reply(self, code: int, obj) -> None:
+            def _reply(self, code: int, obj,
+                       retry_after_s=None) -> None:
                 data = (json.dumps(obj) + "\n").encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                if retry_after_s is not None:
+                    # Typed backpressure (429/503) always tells the
+                    # client WHEN to come back, not just to go away.
+                    self.send_header("Retry-After",
+                                     retry_after_header(retry_after_s))
                 if self.close_connection:
                     # An unread body is still on the socket: tell the
                     # client this connection is done.
@@ -88,7 +156,8 @@ class ServeServer(BackgroundHttpServer):
 
             def _error(self, err: ServeError) -> None:
                 self._reply(err.http_status,
-                            {"error": {"type": err.code, "detail": str(err)}})
+                            {"error": {"type": err.code, "detail": str(err)}},
+                            retry_after_s=getattr(err, "retry_after_s", None))
 
             def _jobs(self):
                 if jm is None:
@@ -107,6 +176,7 @@ class ServeServer(BackgroundHttpServer):
                     if path == "/healthz":
                         self._reply(200, {
                             "ok": True,
+                            "draining": flags["draining"],
                             "workloads": list(WORKLOADS),
                             "bus_cases": list(BUS_CASES),
                             "feeder_cases": list(FEEDER_CASES),
@@ -137,26 +207,20 @@ class ServeServer(BackgroundHttpServer):
                                                 "detail": repr(e)}})
 
             def _read_body(self) -> bytes:
-                """Read the declared request body, or refuse it with the
-                connection marked for close — either way the socket is
-                left clean for (or closed against) the next pipelined
-                request."""
-                raw = self.headers.get("Content-Length") or "0"
-                try:
-                    length = int(raw)
-                except ValueError:
-                    length = -1
-                if length < 0 or length > MAX_BODY_BYTES:
-                    self.close_connection = True
-                    raise InvalidRequest(
-                        f"request body over {MAX_BODY_BYTES} bytes or "
-                        f"Content-Length unparseable ({raw!r})"
-                    )
-                return self.rfile.read(length) if length else b""
+                return read_request_body(self)
 
             def do_POST(self):
                 path = urlparse(self.path).path
                 try:
+                    if FAULTS.enabled:
+                        # Replica-level faults (docs/robustness.md):
+                        # kill is an abrupt process death (what the
+                        # router's passive failure marking + retries
+                        # must absorb); stall models a wedged replica
+                        # (what the router's per-try timeout bounds).
+                        if FAULTS.should("serve.replica.kill"):
+                            os._exit(86)
+                        FAULTS.sleep_point("serve.replica.stall", 0.2)
                     # Drain FIRST: everything after this point can fail
                     # without corrupting the persistent connection.
                     body = self._read_body()
@@ -178,6 +242,9 @@ class ServeServer(BackgroundHttpServer):
                         self._reply(202, self._jobs().submit(payload))
                         return
                     workload = path[len("/v1/"):]
+                    apply_deadline_budget(
+                        payload, self.headers.get("X-Deadline-Budget-S")
+                    )
                     response = svc.request(workload, payload)
                     self._reply(200, response.to_dict())
                 except ServeError as e:
@@ -187,3 +254,13 @@ class ServeServer(BackgroundHttpServer):
                                                 "detail": repr(e)}})
 
         super().__init__(Handler, port=port, host=host)
+
+    def begin_drain(self) -> None:
+        """Advertise the drain on ``/healthz`` (``draining: true``) so
+        the router stops routing NEW work here; in-flight requests
+        keep their handler threads and finish normally."""
+        self._flags["draining"] = True
+
+    def stop(self) -> None:
+        self.begin_drain()
+        super().stop()

@@ -45,6 +45,10 @@ class ServeError(Exception):
 
     code = "internal"
     http_status = 500
+    #: Back-off hint (seconds) carried as a ``Retry-After`` header by
+    #: the HTTP front end on retryable statuses (429/503); ``None`` on
+    #: errors a client must not retry.
+    retry_after_s: Optional[float] = None
 
 
 class Overloaded(ServeError):
@@ -54,6 +58,7 @@ class Overloaded(ServeError):
 
     code = "overloaded"
     http_status = 429
+    retry_after_s = 1.0
 
 
 class DeadlineExceeded(ServeError):
@@ -72,10 +77,24 @@ class InvalidRequest(ServeError):
 
 
 class ShuttingDown(ServeError):
-    """The service is stopping; queued requests are drained with this."""
+    """The service is stopping.  Since the graceful-drain change this
+    is only raised for work that was never admitted (submission after
+    :meth:`AdmissionQueue.seal`, or tickets still queued when the drain
+    budget ran out) — already-admitted tickets finish normally."""
 
     code = "shutting_down"
     http_status = 503
+    retry_after_s = 2.0
+
+
+class Unavailable(ServeError):
+    """No replica can take the request right now: the router's entire
+    replica table is down, draining, or breaker-open.  Always carries a
+    ``Retry-After`` — the fleet is expected to recover."""
+
+    code = "unavailable"
+    http_status = 503
+    retry_after_s = 1.0
 
 
 class NotFound(ServeError):
@@ -216,10 +235,15 @@ class AdmissionQueue:
                     took = t
                     break
                 if took is None and not dead:
-                    if self._closed:
-                        return None
                     remaining = None if deadline is None else deadline - now
                     if remaining is not None and remaining <= 0:
+                        return None
+                    if self._closed:
+                        # Sealed and empty: nothing can arrive (put
+                        # raises), but honoring the timeout keeps the
+                        # draining batcher from spinning hot.
+                        self._cond.wait(remaining if remaining is not None
+                                        else 0.2)
                         return None
                     self._cond.wait(remaining)
                     continue
@@ -274,10 +298,16 @@ class AdmissionQueue:
                     took = t
                     break
                 if took is None and not dead:
-                    if self._closed:
-                        return None
                     remaining = None if deadline is None else deadline - now
                     if remaining is not None and remaining <= 0:
+                        return None
+                    if self._closed:
+                        # Sealed and empty (see pop()): wait out the
+                        # timeout instead of hot-spinning the caller —
+                        # but keep the short re-check bound while a
+                        # gated key still holds drainable tickets.
+                        w = remaining if remaining is not None else 0.2
+                        self._cond.wait(min(w, 0.05) if skipped else w)
                         return None
                     # A gated key's lane drains without notifying this
                     # condition — wake on a short bound to re-check.
@@ -345,6 +375,15 @@ class AdmissionQueue:
                 )
 
     # -- shutdown ------------------------------------------------------------
+    def seal(self) -> None:
+        """Refuse NEW work (``put`` raises :class:`ShuttingDown`) while
+        keeping every already-admitted ticket poppable — the graceful-
+        drain half of shutdown: the batcher keeps dispatching what was
+        promised, only not-yet-admitted work sees the typed error."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
     def close(self) -> List[Ticket]:
         """Refuse new work and return the still-queued tickets (the
         service drains them with :class:`ShuttingDown`)."""

@@ -1,0 +1,777 @@
+"""Cache-affinity failover router for a replicated serving fleet.
+
+One serve process is one failure domain: a wedged executor lane or a
+killed host takes the whole front door down.  This module is the thin
+horizontal layer ROADMAP's multi-host item calls for — N independent
+replica processes (each a full :mod:`freedm_tpu.serve` stack with its
+own PR-10 incremental cache) behind a zero-dependency HTTP router that:
+
+- **consistent-hashes the request's ``case`` onto the replica ring**
+  (``vnodes`` virtual points per replica, blake2-hashed), so repeat
+  traffic for a (case, topology) lands on the same replica and its
+  incremental cache stays hot.  The case name *is* the topology
+  identity at the front door — replicas key their caches by the full
+  (case, topology-digest, backend) triple internally, so a stale
+  router can never cause a wrong answer, only a cold one;
+- keeps a **health-checked replica table**: a background prober GETs
+  every replica's ``/healthz`` (which also reports ``draining``), and
+  proxy failures mark replicas passively — a kill is noticed by the
+  very request that hit it, not a probe later;
+- runs a **per-replica circuit breaker** (closed → open after
+  ``breaker_failures`` consecutive transport failures → half-open
+  after ``breaker_cooldown_s`` → closed on a successful trial), so a
+  dead replica costs one connect timeout per cooldown, not per
+  request;
+- retries with **jittered exponential backoff under the request's own
+  deadline budget**: the budget (the request's ``timeout_s``) is
+  propagated to replicas via the ``X-Deadline-Budget-S`` header
+  (replicas clamp their queue deadline to it), every retry re-checks
+  the remaining budget, and a request is never retried past its own
+  deadline — it answers a typed 504 instead;
+- **fails over along the ring**: an unavailable owner's keys walk to
+  the next replica clockwise (counted on ``router_failovers_total``),
+  so one replica's death moves only its own hash range;
+- honors **graceful drain**: a replica whose ``/healthz`` reports
+  ``draining: true`` (SIGTERM, rolling restart) stops receiving new
+  requests while its in-flight work finishes; its range rebalances to
+  the ring successors;
+- sheds with a **typed 503 + ``Retry-After``** only when every replica
+  is open/down/draining (``router_shed_total``).
+
+Everything is surfaced on the existing registry/tracer: ``router_*``
+metrics, per-replica breaker-state gauges, and one ``serve.route``
+span per routed request (tags: case, owner, served-by replica,
+attempts, outcome).  The router itself exposes ``/healthz`` (its own
+liveness + the replica table) and ``/stats``.
+
+Scope: the router fronts the synchronous what-if workloads
+(``POST /v1/pf|n1|vvc``).  QSTS jobs are replica-local state (a job id
+only means something to the process that runs it) — route those to a
+replica directly.  See ``docs/robustness.md``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import random
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List, NamedTuple, Optional, Tuple
+from urllib.parse import urlparse
+
+from freedm_tpu.core import metrics as obs
+from freedm_tpu.core import tracing
+from freedm_tpu.serve.queue import (
+    DeadlineExceeded,
+    InvalidRequest,
+    NotFound,
+    ServeError,
+    Unavailable,
+)
+
+#: Workloads the router fronts (same vocabulary as serve.service).
+ROUTED_WORKLOADS = ("pf", "n1", "vvc")
+
+#: Breaker states, also the ``router_breaker_state`` gauge encoding.
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+_STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+def _hash64(key: str) -> int:
+    """Stable 64-bit ring position (blake2b — no PYTHONHASHSEED)."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode(), digest_size=8).digest(), "big"
+    )
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    Affinity stability is the contract the tests pin: adding or
+    removing one member only remaps keys that hashed into that
+    member's arcs — every other key keeps its owner."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = int(vnodes)
+        self._points: List[Tuple[int, str]] = []  # sorted (hash, member)
+
+    def add(self, member: str) -> None:
+        pts = [(_hash64(f"{member}#{i}"), member)
+               for i in range(self.vnodes)]
+        self._points = sorted(self._points + pts)
+
+    def remove(self, member: str) -> None:
+        self._points = [(h, m) for h, m in self._points if m != member]
+
+    def members(self) -> List[str]:
+        return sorted({m for _, m in self._points})
+
+    def preference(self, key: str) -> List[str]:
+        """All members, clockwise from ``key``'s ring position,
+        deduplicated: ``[owner, first failover, ...]``."""
+        if not self._points:
+            return []
+        h = _hash64(key)
+        # binary search for the first point >= h (wraps to 0)
+        lo, hi = 0, len(self._points)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._points[mid][0] < h:
+                lo = mid + 1
+            else:
+                hi = mid
+        out: List[str] = []
+        n = len(self._points)
+        for i in range(n):
+            m = self._points[(lo + i) % n][1]
+            if m not in out:
+                out.append(m)
+        return out
+
+    def owner(self, key: str) -> Optional[str]:
+        pref = self.preference(key)
+        return pref[0] if pref else None
+
+
+class ReplicaState:
+    """One replica's routing state (mutated under the router lock)."""
+
+    __slots__ = ("id", "host", "port", "state", "failures", "opened_at",
+                 "healthy", "draining", "admin_drained", "trial_inflight",
+                 "last_probe")
+
+    def __init__(self, rid: str, host: str, port: int):
+        self.id = rid
+        self.host = host
+        self.port = port
+        self.state = CLOSED
+        self.failures = 0  # consecutive transport failures
+        self.opened_at = 0.0
+        self.healthy = True  # optimistic until a probe/proxy says otherwise
+        # Two drain verdicts with different owners: ``draining`` is the
+        # REPLICA's own /healthz (or shutting_down) signal, refreshed by
+        # every probe; ``admin_drained`` is the router-side
+        # :meth:`Router.drain` decision, which a probe must never undo.
+        self.draining = False
+        self.admin_drained = False
+        self.trial_inflight = False  # half-open: one trial at a time
+        self.last_probe = 0.0
+
+    @property
+    def is_draining(self) -> bool:
+        return self.draining or self.admin_drained
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id, "breaker": self.state,
+            "healthy": self.healthy, "draining": self.is_draining,
+            "admin_drained": self.admin_drained,
+            "consecutive_failures": self.failures,
+        }
+
+
+class RouterConfig(NamedTuple):
+    """Routing knobs (CLI: ``--router-port`` and friends)."""
+
+    #: Active /healthz probe cadence per replica.
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 1.0
+    #: Consecutive transport failures that open a replica's breaker.
+    breaker_failures: int = 3
+    #: Open → half-open cooldown.
+    breaker_cooldown_s: float = 2.0
+    #: Jittered exponential backoff between retries: base * 2^attempt,
+    #: uniformly jittered in [0.5x, 1.5x], capped.
+    retry_base_s: float = 0.025
+    retry_cap_s: float = 0.5
+    #: Deadline budget for requests that carry no timeout_s of their own.
+    default_timeout_s: float = 30.0
+    #: Per-attempt ceiling (None = the remaining budget): bounds how
+    #: long one stalled replica can eat before the router fails over.
+    try_timeout_s: Optional[float] = None
+    connect_timeout_s: float = 2.0
+    #: Virtual ring points per replica.
+    vnodes: int = 64
+    #: Backoff-jitter seed (deterministic retries for tests/replays).
+    seed: int = 0
+
+
+class _ProxyReply(NamedTuple):
+    status: int
+    body: bytes
+    retry_after: Optional[str]
+    #: Which replica produced the answer (the ``X-Served-By`` response
+    #: header) — None on router-originated errors.
+    served_by: Optional[str] = None
+
+
+class Router:
+    """The replica table + routing core.  :class:`RouterServer` is the
+    HTTP shell around it; tests drive this class directly."""
+
+    def __init__(self, replicas: List[str],
+                 config: RouterConfig = RouterConfig()):
+        self.config = config
+        self._lock = threading.Lock()
+        self._rng = random.Random(f"router:{config.seed}")
+        self.ring = HashRing(vnodes=config.vnodes)
+        self.replicas: Dict[str, ReplicaState] = {}
+        for r in replicas:
+            self.add_replica(r)
+        self._stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+
+    # -- membership ----------------------------------------------------------
+    def add_replica(self, addr: str) -> None:
+        host, _, port = str(addr).rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"replica must be host:port, got {addr!r}")
+        with self._lock:
+            if addr in self.replicas:
+                return
+            self.replicas[addr] = ReplicaState(addr, host, int(port))
+            self.ring.add(addr)
+        obs.ROUTER_BREAKER_STATE.labels(addr).set(_STATE_CODE[CLOSED])
+        self._set_available_gauge()
+
+    def remove_replica(self, addr: str) -> None:
+        with self._lock:
+            self.replicas.pop(addr, None)
+            self.ring.remove(addr)
+        self._set_available_gauge()
+
+    def drain(self, addr: str) -> None:
+        """Administratively stop routing NEW work to a replica (its
+        in-flight requests finish on their own connections)."""
+        with self._lock:
+            st = self.replicas.get(addr)
+            if st is not None:
+                st.admin_drained = True
+        obs.EVENTS.emit("router.drain", replica=addr)
+        self._set_available_gauge()
+
+    # -- availability / breaker ---------------------------------------------
+    def _admittable_locked(self, st: ReplicaState, now: float) -> bool:
+        if st.is_draining or not st.healthy:
+            return False
+        if st.state == CLOSED:
+            return True
+        if st.state == OPEN:
+            if now - st.opened_at >= self.config.breaker_cooldown_s:
+                self._transition_locked(st, HALF_OPEN)
+            else:
+                return False
+        # half-open: one trial request at a time
+        if st.trial_inflight:
+            return False
+        st.trial_inflight = True
+        return True
+
+    def _transition_locked(self, st: ReplicaState, state: str) -> None:
+        if st.state == state:
+            return
+        st.state = state
+        if state == OPEN:
+            st.opened_at = time.monotonic()
+        if state != HALF_OPEN:
+            st.trial_inflight = False
+        obs.ROUTER_BREAKER_STATE.labels(st.id).set(_STATE_CODE[state])
+        obs.ROUTER_BREAKER_TRANSITIONS.labels(st.id, state).inc()
+
+    def _record_failure(self, rid: str) -> None:
+        opened = []
+        with self._lock:
+            st = self.replicas.get(rid)
+            if st is None:
+                return
+            st.trial_inflight = False
+            st.failures += 1
+            if st.state == HALF_OPEN or (
+                st.state == CLOSED
+                and st.failures >= self.config.breaker_failures
+            ):
+                self._transition_locked(st, OPEN)
+                opened.append((st.id, st.failures))
+        for rid_, fails in opened:
+            obs.EVENTS.emit("router.breaker_open", replica=rid_,
+                            consecutive_failures=fails)
+        self._set_available_gauge()
+
+    def _record_success(self, rid: str) -> None:
+        events = []
+        with self._lock:
+            st = self.replicas.get(rid)
+            if st is None:
+                return
+            st.trial_inflight = False
+            st.failures = 0
+            st.healthy = True
+            if st.state != CLOSED:
+                self._transition_locked(st, CLOSED)
+                events.append(st.id)
+        for rid_ in events:
+            obs.EVENTS.emit("router.breaker_close", replica=rid_)
+        self._set_available_gauge()
+
+    def _set_available_gauge(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            n = sum(
+                1 for st in self.replicas.values()
+                if not st.is_draining and st.healthy
+                and (st.state != OPEN
+                     or now - st.opened_at >= self.config.breaker_cooldown_s)
+            )
+        obs.ROUTER_REPLICAS_AVAILABLE.set(n)
+
+    # -- health prober -------------------------------------------------------
+    def start_probes(self) -> "Router":
+        if self._prober is None or not self._prober.is_alive():
+            self._stop.clear()
+            self._prober = threading.Thread(
+                target=self._probe_loop, name="router-prober", daemon=True
+            )
+            self._prober.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=2.0)
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — the prober must outlive
+                # any single bad probe/emit; a dead prober would freeze
+                # the health table for the router's whole lifetime.
+                pass
+            self._stop.wait(self.config.probe_interval_s)
+
+    def probe_once(self) -> None:
+        """One active /healthz pass over the table (also callable
+        synchronously from tests)."""
+        with self._lock:
+            targets = list(self.replicas.values())
+        for st in targets:
+            healthy, draining = self._probe(st)
+            with self._lock:
+                cur = self.replicas.get(st.id)
+                if cur is None:
+                    continue
+                changed = healthy != cur.healthy
+                cur.healthy = healthy
+                cur.draining = draining if healthy else cur.draining
+                cur.last_probe = time.monotonic()
+            if changed and healthy:
+                obs.EVENTS.emit("router.replica_up", replica=st.id)
+            elif changed:
+                obs.EVENTS.emit("router.replica_down", replica=st.id)
+        self._set_available_gauge()
+
+    def _probe(self, st: ReplicaState) -> Tuple[bool, bool]:
+        try:
+            conn = http.client.HTTPConnection(
+                st.host, st.port, timeout=self.config.probe_timeout_s
+            )
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    return False, False
+                d = json.loads(body)
+                return True, bool(d.get("draining", False))
+            finally:
+                conn.close()
+        except (OSError, ValueError, http.client.HTTPException):
+            # HTTPException covers IncompleteRead/BadStatusLine from a
+            # replica dying mid-response — a probe failure, never a
+            # prober-thread death.
+            return False, False
+
+    # -- routing core --------------------------------------------------------
+    def route(self, path: str, body: bytes) -> _ProxyReply:
+        """Route one ``POST /v1/<workload>`` body; always returns a
+        typed HTTP reply (never raises to the shell)."""
+        workload = path[len("/v1/"):]
+        try:
+            case, timeout_s = self._parse(workload, body)
+        except ServeError as e:
+            obs.ROUTER_REQUESTS.labels(e.code).inc()
+            return _error_reply(e)
+        deadline = time.monotonic() + timeout_s
+        span = tracing.TRACER.start(
+            "serve.route", kind="route",
+            tags={"workload": workload, "case": case},
+        )
+        try:
+            reply, served_by, attempts, outcome = self._route_attempts(
+                case, path, body, deadline, span
+            )
+        except Exception as e:  # noqa: BLE001 — the shell answers typed
+            obs.ROUTER_REQUESTS.labels("error").inc()
+            span.tag(outcome="error", error=repr(e))
+            span.end()
+            return _error_reply(_RouterInternal(repr(e)))
+        span.tag(outcome=outcome, attempts=attempts,
+                 served_by=served_by or "")
+        span.end()
+        obs.ROUTER_REQUESTS.labels(outcome).inc()
+        return reply._replace(served_by=served_by)
+
+    def _parse(self, workload: str, body: bytes) -> Tuple[str, float]:
+        if workload not in ROUTED_WORKLOADS:
+            raise InvalidRequest(
+                f"router fronts {'/'.join(ROUTED_WORKLOADS)}; "
+                f"route {workload!r} to a replica directly"
+            )
+        try:
+            payload = json.loads(body or b"null")
+        except ValueError as e:
+            raise InvalidRequest(f"malformed JSON: {e}") from None
+        if not isinstance(payload, dict) or not isinstance(
+            payload.get("case"), str
+        ) or not payload["case"]:
+            raise InvalidRequest(
+                "request body must be a JSON object with a 'case' string "
+                "(the router's affinity key)"
+            )
+        timeout_s = payload.get("timeout_s", 0)
+        # bool is an int subclass: {"timeout_s": true} must not become
+        # a 1-second budget (mirrors http.apply_deadline_budget).
+        if isinstance(timeout_s, bool) or \
+                not isinstance(timeout_s, (int, float)) or timeout_s <= 0:
+            timeout_s = self.config.default_timeout_s
+        return payload["case"], float(timeout_s)
+
+    def _pick(self, preference: List[str], now: float,
+              avoid=frozenset()) -> Tuple[Optional[ReplicaState], bool]:
+        """First admittable replica in ring order; second value is
+        whether the pick is a failover off the affinity owner.
+        ``avoid`` holds replicas that already answered THIS request
+        with per-replica backpressure (429) — another replica may have
+        room, so they are skipped for the request's remaining attempts."""
+        with self._lock:
+            for i, rid in enumerate(preference):
+                if rid in avoid:
+                    continue
+                st = self.replicas.get(rid)
+                if st is None:
+                    continue
+                if self._admittable_locked(st, now):
+                    return st, i > 0
+        return None, False
+
+    def _release_pick(self, st: ReplicaState) -> None:
+        """Undo a pick that will NOT be forwarded to (probe-only
+        re-picks): a claimed half-open trial slot must be returned or
+        the breaker's single trial leaks."""
+        with self._lock:
+            st.trial_inflight = False
+
+    def _route_attempts(self, case: str, path: str, body: bytes,
+                        deadline: float, span):
+        cfg = self.config
+        preference = self.ring.preference(case)
+        attempt = 0
+        last_err: Optional[ServeError] = None
+        # Replicas that answered THIS request with per-replica 429:
+        # skipped for the request's remaining attempts (failover, not
+        # hammering) — cleared only by running out of alternatives.
+        overloaded: set = set()
+        while True:
+            now = time.monotonic()
+            remaining = deadline - now
+            if remaining <= 0:
+                err = last_err or DeadlineExceeded(
+                    "deadline budget exhausted before any replica answered"
+                )
+                if not isinstance(err, DeadlineExceeded):
+                    err = DeadlineExceeded(
+                        f"deadline budget exhausted retrying "
+                        f"(last: {err.code})"
+                    )
+                return _error_reply(err), None, attempt, "deadline"
+            st, failover = self._pick(preference, now, avoid=overloaded)
+            if st is None and overloaded:
+                # No non-shedding replica left.  Distinguish "every
+                # admittable replica shed THIS request" (propagate the
+                # typed 429 promptly) from "the shedders are also the
+                # only ones alive and now something else changed": a
+                # re-pick WITHOUT the avoid set tells which — and its
+                # half-open trial claim is released, since no request
+                # is actually sent.
+                st2, _ = self._pick(preference, now)
+                if st2 is not None:
+                    self._release_pick(st2)
+                    err = _Overloaded(
+                        "every available replica is shedding (fleet at "
+                        "admission depth); back off and retry"
+                    )
+                    return _error_reply(err), None, attempt, "overloaded"
+            if st is None:
+                # Nothing admittable at all (down, draining, or
+                # breaker-open): typed shed with a Retry-After sized to
+                # the breaker cooldown (by then an open breaker is
+                # half-open and will trial a request).
+                obs.ROUTER_SHED.inc()
+                err = Unavailable(
+                    "no replica available (all down, draining, or "
+                    "breaker-open); retry after the cooldown"
+                )
+                err.retry_after_s = max(cfg.breaker_cooldown_s, 1.0)
+                return _error_reply(err), None, attempt, "unavailable"
+            attempt += 1
+            if attempt > 1:
+                obs.ROUTER_RETRIES.inc()
+            if failover:
+                obs.ROUTER_FAILOVERS.inc()
+            ok, reply = self._forward_once(st, path, body, remaining)
+            if ok:
+                return reply, st.id, attempt, _outcome_of(reply)
+            last_err = reply  # a ServeError on the failure path
+            if isinstance(reply, _Overloaded):
+                # Per-replica backpressure: fail over to the next ring
+                # replica immediately — no backoff, another replica may
+                # have room right now.
+                overloaded.add(st.id)
+                continue
+            # Failure-shaped errors (transport, internal, draining):
+            # jittered exponential backoff, never past the deadline.
+            back = min(
+                cfg.retry_base_s * (2 ** (attempt - 1)), cfg.retry_cap_s
+            ) * (0.5 + self._rng.random())
+            back = min(back, max(deadline - time.monotonic(), 0.0))
+            if back > 0:
+                span.annotate("backoff", attempt=attempt,
+                              sleep_ms=round(back * 1e3, 3))
+                time.sleep(back)
+
+    def _forward_once(self, st: ReplicaState, path: str, body: bytes,
+                      remaining: float):
+        """One proxy attempt.  Returns ``(True, _ProxyReply)`` on an
+        answer the client should see, or ``(False, ServeError)`` on a
+        failure the retry loop handles (``_Overloaded`` = fail over
+        now, anything else = backoff then retry)."""
+        cfg = self.config
+        per_try = remaining if cfg.try_timeout_s is None \
+            else min(remaining, cfg.try_timeout_s)
+        t0 = time.monotonic()
+        try:
+            conn = http.client.HTTPConnection(
+                st.host, st.port,
+                timeout=max(min(per_try, 1e6), 0.001),
+            )
+            try:
+                conn.request(
+                    "POST", path, body=body,
+                    headers={
+                        "Content-Type": "application/json",
+                        # The deadline budget rides the wire: the
+                        # replica clamps its own queue deadline to it,
+                        # so a retried request cannot straddle budgets.
+                        "X-Deadline-Budget-S": f"{remaining:.3f}",
+                    },
+                )
+                resp = conn.getresponse()
+                payload = resp.read()
+                status = resp.status
+                retry_after = resp.getheader("Retry-After")
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException) as e:
+            obs.ROUTER_PROXY_LATENCY.observe(time.monotonic() - t0)
+            self._record_failure(st.id)
+            kind = "timeout" if isinstance(e, socket.timeout) else "connect"
+            return False, _Transport(f"{kind} error on {st.id}: {e!r}")
+        obs.ROUTER_PROXY_LATENCY.observe(time.monotonic() - t0)
+        if status >= 500 and _error_code(payload) in (None, "internal",
+                                                      "error"):
+            # Replica-internal failure: breaker-relevant, retryable
+            # (the solve is pure — a crashed batch re-runs cleanly).
+            self._record_failure(st.id)
+            return False, _Transport(
+                f"replica {st.id} answered {status} internal"
+            )
+        # Any other answer is an ANSWER: typed client errors (400/404),
+        # typed backpressure (429/503), and 200s all pass through.
+        self._record_success(st.id)
+        code = _error_code(payload)
+        if code == "shutting_down":
+            # The replica is draining: remember it so new work stops
+            # landing there before the next probe, and retry elsewhere.
+            with self._lock:
+                cur = self.replicas.get(st.id)
+                if cur is not None:
+                    cur.draining = True
+            self._set_available_gauge()
+            return False, _Transport(f"replica {st.id} draining")
+        if code == "overloaded":
+            # Per-replica backpressure: another replica may have room.
+            return False, _Overloaded(f"replica {st.id} overloaded")
+        return True, _ProxyReply(status, payload, retry_after)
+
+    # -- introspection -------------------------------------------------------
+    def states(self) -> Dict[str, dict]:
+        with self._lock:
+            return {rid: st.to_dict()
+                    for rid, st in sorted(self.replicas.items())}
+
+    def stats(self) -> dict:
+        snap = obs.REGISTRY.snapshot()
+
+        def metric(name):
+            return snap.get(name, {}).get("values", {})
+
+        return {
+            "replicas": self.states(),
+            "ring_members": self.ring.members(),
+            "vnodes": self.config.vnodes,
+            "requests": metric("router_requests_total"),
+            "retries": metric("router_retries_total"),
+            "failovers": metric("router_failovers_total"),
+            "shed": metric("router_shed_total"),
+            "breaker_state": metric("router_breaker_state"),
+            "proxy_seconds": metric("router_proxy_seconds"),
+        }
+
+
+class _Transport(ServeError):
+    code = "transport"
+    http_status = 502
+
+
+class _Overloaded(ServeError):
+    code = "overloaded"
+    http_status = 429
+    retry_after_s = 1.0
+
+
+class _RouterInternal(ServeError):
+    code = "internal"
+    http_status = 500
+
+
+def _error_code(payload: bytes) -> Optional[str]:
+    try:
+        d = json.loads(payload)
+        return d["error"]["type"]
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+def _outcome_of(reply: _ProxyReply) -> str:
+    if reply.status == 200:
+        return "ok"
+    return _error_code(reply.body) or f"http_{reply.status}"
+
+
+def _error_reply(err: ServeError) -> _ProxyReply:
+    from freedm_tpu.serve.http import retry_after_header
+
+    body = (json.dumps(
+        {"error": {"type": err.code, "detail": str(err)}}
+    ) + "\n").encode()
+    ra = getattr(err, "retry_after_s", None)
+    return _ProxyReply(
+        err.http_status, body,
+        retry_after_header(ra) if ra else None,
+    )
+
+
+class RouterServer:
+    """The HTTP shell: ``POST /v1/*`` routed, ``GET /healthz``/
+    ``/stats`` served locally.  Same zero-dependency scaffold as the
+    serve front end."""
+
+    def __init__(self, router: Router, port: int = 0,
+                 host: str = "127.0.0.1"):
+        from freedm_tpu.core.metrics import BackgroundHttpServer
+
+        rt = router
+        self.router = router
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status: int, data: bytes,
+                       retry_after: Optional[str] = None,
+                       served_by: Optional[str] = None) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if retry_after:
+                    self.send_header("Retry-After", retry_after)
+                if served_by:
+                    self.send_header("X-Served-By", served_by)
+                if self.close_connection:
+                    self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _read_body(self) -> bytes:
+                from freedm_tpu.serve.http import read_request_body
+
+                return read_request_body(self)
+
+            def do_GET(self):
+                path = urlparse(self.path).path
+                try:
+                    self._read_body()
+                    if path == "/healthz":
+                        states = rt.states()
+                        body = (json.dumps({
+                            "ok": True,
+                            "role": "router",
+                            "replicas": states,
+                        }) + "\n").encode()
+                        self._reply(200, body)
+                    elif path == "/stats":
+                        self._reply(
+                            200, (json.dumps(rt.stats()) + "\n").encode()
+                        )
+                    else:
+                        self._reply(404, _error_reply(
+                            NotFound(f"no route {path!r}")
+                        ).body)
+                except ServeError as e:
+                    r = _error_reply(e)
+                    self._reply(r.status, r.body, r.retry_after)
+
+            def do_POST(self):
+                path = urlparse(self.path).path
+                try:
+                    body = self._read_body()
+                    if not path.startswith("/v1/"):
+                        r = _error_reply(NotFound(f"no route {path!r}"))
+                        self._reply(404, r.body)
+                        return
+                    reply = rt.route(path, body)
+                    self._reply(reply.status, reply.body,
+                                reply.retry_after, reply.served_by)
+                except ServeError as e:
+                    r = _error_reply(e)
+                    self._reply(r.status, r.body, r.retry_after)
+                except Exception as e:  # noqa: BLE001 — always typed
+                    r = _error_reply(_RouterInternal(repr(e)))
+                    self._reply(r.status, r.body, r.retry_after)
+
+        self._server = BackgroundHttpServer(Handler, port=port, host=host)
+        self.port = self._server.port
+
+    def start(self) -> "RouterServer":
+        self._server.start()
+        self.router.start_probes()
+        return self
+
+    def stop(self) -> None:
+        self.router.stop()
+        self._server.stop()

@@ -1349,9 +1349,20 @@ class Service:
         self.batcher.start()
         return self
 
-    def stop(self) -> None:
-        """Refuse new work, drain the queue with ``shutting_down``, stop
-        the batcher."""
+    def stop(self, drain_s: float = 5.0) -> None:
+        """Graceful shutdown: seal admission (new submissions raise the
+        typed ``shutting_down``), let the batcher FINISH every already-
+        admitted ticket for up to ``drain_s`` seconds, then fail
+        whatever is still queued and stop the pipeline.  Admitted work
+        completing instead of being dropped is the drain contract the
+        router's rolling-restart path depends on; ``drain_s=0`` is the
+        old drop-everything behavior."""
+        self.queue.seal()
+        deadline = _time.monotonic() + max(drain_s, 0.0)
+        while _time.monotonic() < deadline:
+            if self.queue.depth_lanes == 0 and not self.batcher.busy():
+                break
+            _time.sleep(0.02)
         for t in self.queue.close():
             self._complete_error(t, ShuttingDown("service stopped"))
         self.batcher.stop()

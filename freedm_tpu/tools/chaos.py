@@ -1,0 +1,512 @@
+"""Chaos rig: a replicated serving fleet under a deterministic fault
+schedule.
+
+The robustness acceptance test for the router tier (docs/robustness.md):
+
+- spawn N (default 3) **replica** processes — each a full serve stack
+  (:mod:`freedm_tpu.serve`) with its own incremental cache, prewarmed,
+  on an ephemeral port;
+- front them with the cache-affinity failover router
+  (:mod:`freedm_tpu.serve.router`) in this process;
+- drive a **closed-loop mixed load** through the router while the
+  fault schedule runs: one replica carries a ``serve.replica.kill``
+  fault (its K-th request hard-exits the process — a deterministic
+  mid-load kill), another a low-rate ``serve.exec.crash`` (typed
+  batch failures the router must retry);
+- assert the contract: **zero non-typed client failures** (every
+  response the client sees is a 200 or a typed
+  ``{"error": {"type": ...}}`` — never a connection reset), request
+  success ratio **>= 0.999** via router retries, the victim's breaker
+  opened, and the **cache hit ratio on the victim's hash range
+  retained within 10%** after failover (the survivor warms the moved
+  range in one pass).
+
+One command, one pass/fail JSON artifact::
+
+    python -m freedm_tpu.tools.chaos --out chaos.json
+
+``--replica`` is the internal entry the rig spawns: a serve-only
+process that prints ``{"replica_port": N}`` and drains gracefully on
+SIGTERM (stops admitting, finishes in-flight, exits 0).
+``tools/soak.py --chaos`` folds this rig's artifact into the soak
+artifact.  Exit code 0 iff every check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: Cases the mixed load spreads over the ring (distinct engines, all
+#: cheap at CPU scale).  mesh cases give the ring enough distinct keys
+#: that every replica owns some range.
+LOAD_CASES = ("case14", "case_ieee30", "mesh20", "mesh24", "mesh28")
+
+
+# ---------------------------------------------------------------------------
+# Replica entry (--replica): serve-only process with graceful drain
+# ---------------------------------------------------------------------------
+
+
+def run_replica(fault_spec: Optional[str] = None,
+                prewarm: str = "pf/case14") -> int:
+    from freedm_tpu.core.faults import FAULTS
+    from freedm_tpu.serve import ServeConfig, ServeServer, Service
+
+    if fault_spec:
+        FAULTS.configure(fault_spec)
+    svc = Service(ServeConfig(
+        max_batch=16, queue_depth=256,
+        prewarm=(prewarm,) if prewarm else (),
+    ))
+    srv = ServeServer(svc, port=0).start()
+    done = threading.Event()
+
+    def _drain(signum, frame):
+        # Graceful drain: /healthz flips to draining (the router stops
+        # sending new work), admitted tickets finish, then exit 0.
+        srv.begin_drain()
+        done.set()
+
+    signal.signal(signal.SIGTERM, _drain)
+    print(json.dumps({"replica_port": srv.port, "pid": os.getpid()}),
+          flush=True)
+    while not done.wait(0.2):
+        pass
+    srv.stop()
+    svc.stop()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# The rig
+# ---------------------------------------------------------------------------
+
+
+class _Check:
+    def __init__(self):
+        self.results: List[Dict] = []
+
+    def record(self, name: str, ok: bool, detail: str = "") -> bool:
+        self.results.append({"name": name, "ok": bool(ok), "detail": detail})
+        print(f"[chaos] {'ok ' if ok else 'FAIL'} {name}  {detail}",
+              flush=True)
+        return ok
+
+    @property
+    def passed(self) -> bool:
+        return all(r["ok"] for r in self.results)
+
+
+class _Replica:
+    def __init__(self, index: int, fault_spec: Optional[str], env: dict):
+        self.index = index
+        self.fault_spec = fault_spec
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "freedm_tpu.tools.chaos", "--replica"]
+            + (["--fault-spec", fault_spec] if fault_spec else []),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, text=True,
+        )
+        self.port: Optional[int] = None
+
+    def wait_port(self, timeout_s: float) -> Optional[int]:
+        deadline = time.monotonic() + timeout_s
+
+        def reader():
+            line = self.proc.stdout.readline()
+            try:
+                self.port = json.loads(line)["replica_port"]
+            except (ValueError, KeyError):
+                pass
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        while time.monotonic() < deadline and self.port is None:
+            if self.proc.poll() is not None:
+                return None
+            time.sleep(0.2)
+        return self.port
+
+    @property
+    def id(self) -> Optional[str]:
+        return f"127.0.0.1:{self.port}" if self.port is not None else None
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+class _Loader:
+    """Closed-loop mixed load through the router.  Every completed
+    request is classified: ok (200), typed (a JSON error body with a
+    type), or UNTYPED (connection reset / unparseable — the class
+    that must be zero)."""
+
+    def __init__(self, router_port: int, n_threads: int = 4,
+                 cases=LOAD_CASES):
+        self.port = router_port
+        self.n_threads = n_threads
+        self.cases = tuple(cases)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self.ok = 0
+        self.typed: Dict[str, int] = {}
+        self.untyped = 0
+
+    def _classify(self, status: int, body: bytes) -> None:
+        with self._lock:
+            if status == 200:
+                self.ok += 1
+                return
+            try:
+                code = json.loads(body)["error"]["type"]
+            except (ValueError, KeyError, TypeError):
+                self.untyped += 1
+                return
+            self.typed[code] = self.typed.get(code, 0) + 1
+
+    def _loop(self, seed: int) -> None:
+        import random
+        import urllib.error
+        import urllib.request
+
+        rng = random.Random(seed)
+        while not self._stop.is_set():
+            case = rng.choice(self.cases)
+            body = json.dumps({
+                "case": case,
+                "scale": round(rng.choice((1.0, 1.0, 0.95, 1.05)), 3),
+                "timeout_s": 60,
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{self.port}/v1/pf", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=90) as r:
+                    self._classify(r.status, r.read())
+            except urllib.error.HTTPError as e:
+                payload = e.read()
+                e.close()
+                self._classify(e.code, payload)
+            except Exception:
+                # Transport-level failure surfaced to the CLIENT: the
+                # router exists to make this impossible.
+                with self._lock:
+                    self.untyped += 1
+
+    def start(self) -> "_Loader":
+        self._threads = [
+            threading.Thread(target=self._loop, args=(i,), daemon=True)
+            for i in range(self.n_threads)
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> Dict[str, object]:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=95)
+        total = self.ok + sum(self.typed.values()) + self.untyped
+        return {
+            "requests": total,
+            "ok": self.ok,
+            "typed": dict(sorted(self.typed.items())),
+            "untyped": self.untyped,
+            "success_ratio": round(self.ok / total, 6) if total else 0.0,
+        }
+
+
+def _get_json(port: int, path: str, timeout_s: float = 10.0) -> Dict:
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout_s
+        ) as r:
+            return json.loads(r.read())
+    except Exception:
+        return {}
+
+
+def _cache_counts(replicas: List[_Replica]) -> Dict[str, float]:
+    """Summed exact/delta hits + misses over the LIVE replicas' /stats
+    cache blocks — the fleet-wide hit-ratio window."""
+    out = {"exact": 0.0, "delta": 0.0, "misses": 0.0}
+    for rep in replicas:
+        if not rep.alive() or rep.port is None:
+            continue
+        cache = _get_json(rep.port, "/stats").get("cache") or {}
+        hits = cache.get("hits") or {}
+        out["exact"] += float(hits.get("exact", 0) or 0)
+        out["delta"] += float(hits.get("delta", 0) or 0)
+        out["misses"] += float(cache.get("misses", 0) or 0)
+    return out
+
+
+def _post_pf(router_port: int, case: str, timeout_s: float = 90.0) -> bool:
+    import urllib.error
+    import urllib.request
+
+    body = json.dumps({"case": case, "timeout_s": timeout_s}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router_port}/v1/pf", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s + 5) as r:
+            return r.status == 200
+    except urllib.error.HTTPError as e:
+        e.close()
+        return False
+    except Exception:
+        return False
+
+
+def _hit_ratio_probe(router_port: int, cases: List[str],
+                     replicas: List[_Replica],
+                     repeats: int = 16) -> Optional[float]:
+    """(exact+delta hits)/lookups across a repeats x cases window of
+    identical queries driven through the router.  16 repeats per key
+    keeps one post-failover warming miss per key inside the 10%
+    retention budget ((R-1)/R = 0.9375)."""
+    before = _cache_counts(replicas)
+    for _ in range(repeats):
+        for c in cases:
+            _post_pf(router_port, c)
+    after = _cache_counts(replicas)
+    hits = (after["exact"] - before["exact"]) + (
+        after["delta"] - before["delta"]
+    )
+    lookups = hits + (after["misses"] - before["misses"])
+    return round(hits / lookups, 4) if lookups > 0 else None
+
+
+def run_chaos(n_replicas: int = 3, load_s: float = 6.0,
+              post_kill_s: float = 8.0, clients: int = 4,
+              kill_after: int = 80, out: Optional[str] = None,
+              workdir: Optional[str] = None) -> Dict:
+    """The kill-one-of-N acceptance scenario; returns the artifact."""
+    import tempfile
+
+    from freedm_tpu.serve.router import Router, RouterConfig, RouterServer
+
+    t0 = time.monotonic()
+    wd = workdir or tempfile.mkdtemp(prefix="freedm_chaos_")
+    cache_dir = os.path.join(wd, "jax_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    env = dict(
+        os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+        JAX_COMPILATION_CACHE_DIR=cache_dir,
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="1",
+    )
+    check = _Check()
+    # The DETERMINISTIC fault schedule: replica 0 hard-exits on its
+    # kill_after-th request (the mid-load kill); replica 1 carries a
+    # low-rate executor crash (typed internal failures the router's
+    # retry absorbs).  Replica 2 is clean.
+    specs: List[Optional[str]] = [
+        f"seed=11;serve.replica.kill:1:after={kill_after}:max=1",
+        "seed=12;serve.exec.crash:0.02:max=5",
+    ] + [None] * max(n_replicas - 2, 0)
+    replicas = [_Replica(i, specs[i] if i < len(specs) else None, env)
+                for i in range(n_replicas)]
+    router_server = None
+    loader = None
+    summary: Dict[str, object] = {}
+    try:
+        ports = [rep.wait_port(300.0) for rep in replicas]
+        check.record("replicas_up", all(p is not None for p in ports),
+                     f"ports={ports}")
+        if not all(p is not None for p in ports):
+            raise RuntimeError("replica spawn failed")
+        router = Router(
+            [rep.id for rep in replicas],
+            RouterConfig(
+                probe_interval_s=0.5,
+                breaker_failures=2,
+                breaker_cooldown_s=1.0,
+                default_timeout_s=60.0,
+            ),
+        )
+        router_server = RouterServer(router, port=0).start()
+
+        # Prime every case once through the router (absorbs each
+        # replica's first-touch engine compile outside the windows).
+        primed = all(
+            _post_pf(router_server.port, c, timeout_s=240.0)
+            for c in LOAD_CASES
+        )
+        check.record("fleet_primed", primed, f"cases={LOAD_CASES}")
+
+        # The victim is replica 0 (the kill fault).  The affected hash
+        # range = the load cases it owns.
+        victim = replicas[0]
+        # At most 2 probe cases: the pre-fault probe's requests DRAW on
+        # the victim's kill schedule (every POST counts), and priming +
+        # 16 x len(cases) must stay comfortably under kill_after so the
+        # kill lands in the LOAD window, not during the probe.
+        victim_cases = [
+            c for c in LOAD_CASES if router.ring.owner(c) == victim.id
+        ][:2]
+        if not victim_cases:
+            # Every ring is different (ephemeral ports): fall back to
+            # probing whichever range the victim does own among a wider
+            # candidate set, else the first case (retention still
+            # meaningful — the range simply did not move).
+            victim_cases = [
+                c for c in (f"mesh{n}" for n in range(20, 60, 2))
+                if router.ring.owner(c) == victim.id
+            ][:2] or [LOAD_CASES[0]]
+            for c in victim_cases:
+                _post_pf(router_server.port, c, timeout_s=240.0)
+        pre_ratio = _hit_ratio_probe(
+            router_server.port, victim_cases, replicas
+        )
+        check.record("pre_fault_hit_ratio_measured", pre_ratio is not None,
+                     f"ratio={pre_ratio} cases={victim_cases}")
+
+        # Closed-loop mixed load; the schedule kills replica 0 mid-way.
+        # The victim's own hash range is always part of the mix — the
+        # ephemeral-port ring may have handed it none of LOAD_CASES,
+        # and a victim that sees no traffic can neither be killed by
+        # its schedule nor prove failover.
+        loader = _Loader(
+            router_server.port, n_threads=clients,
+            cases=tuple(LOAD_CASES) + tuple(victim_cases),
+        ).start()
+        time.sleep(load_s)
+        killed = not victim.alive()
+        deadline = time.monotonic() + post_kill_s
+        while time.monotonic() < deadline:
+            time.sleep(0.5)
+            killed = killed or not victim.alive()
+        summary = loader.stop()
+        loader = None
+        check.record(
+            "replica_killed_by_schedule", killed,
+            f"victim={victim.id} rc={victim.proc.poll()}",
+        )
+        check.record(
+            "zero_untyped_client_failures", summary["untyped"] == 0,
+            f"untyped={summary['untyped']} over {summary['requests']}",
+        )
+        check.record(
+            "success_ratio_over_999",
+            summary["requests"] > 0 and summary["success_ratio"] >= 0.999,
+            f"ratio={summary['success_ratio']} typed={summary['typed']}",
+        )
+        states = router.states()
+        vstate = states.get(victim.id, {})
+        check.record(
+            "victim_breaker_opened_or_marked_down",
+            vstate.get("breaker") == "open" or not vstate.get("healthy", True),
+            f"victim={vstate}",
+        )
+        # Post-failover: the victim's range now lands on survivors; one
+        # warming pass per key, then hits — retention within 10%.
+        post_ratio = _hit_ratio_probe(
+            router_server.port, victim_cases, replicas
+        )
+        retained = (
+            pre_ratio is not None and post_ratio is not None
+            and post_ratio >= pre_ratio * 0.9
+        )
+        check.record(
+            "cache_hit_ratio_retained_after_failover", retained,
+            f"pre={pre_ratio} post={post_ratio} range={victim_cases}",
+        )
+        # Graceful drain: SIGTERM a SURVIVOR — it must flip /healthz to
+        # draining, finish its in-flight work, and exit 0 (the rolling-
+        # restart path), while the remaining replica keeps answering.
+        drained = next(rep for rep in replicas[1:] if rep.alive())
+        drained.proc.send_signal(signal.SIGTERM)
+        drain_deadline = time.monotonic() + 15.0
+        while drained.alive() and time.monotonic() < drain_deadline:
+            time.sleep(0.2)
+        check.record(
+            "sigterm_drain_exits_clean", drained.proc.poll() == 0,
+            f"replica={drained.id} rc={drained.proc.poll()}",
+        )
+        router.probe_once()
+        still_ok = _post_pf(router_server.port, victim_cases[0],
+                            timeout_s=120.0)
+        check.record("fleet_serves_after_drain", still_ok,
+                     f"case={victim_cases[0]}")
+        router_stats = router.stats()
+    except Exception as e:  # noqa: BLE001 — the artifact must exist
+        check.record("rig_error", False, repr(e))
+        router_stats = {}
+    finally:
+        if loader is not None:
+            summary = loader.stop()
+        if router_server is not None:
+            router_server.stop()
+        for rep in replicas:
+            if rep.alive():
+                rep.proc.terminate()
+        deadline = time.monotonic() + 10.0
+        for rep in replicas:
+            while rep.alive() and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if rep.alive():
+                rep.proc.kill()
+    artifact = {
+        "pass": check.passed,
+        "replicas": n_replicas,
+        "duration_s": round(time.monotonic() - t0, 1),
+        "checks": check.results,
+        "load": summary,
+        "router": router_stats,
+        "fault_specs": specs[:n_replicas],
+        "workdir": wd,
+    }
+    if out:
+        with open(out, "w") as fh:
+            json.dump(artifact, fh, indent=2)
+    print(json.dumps({"chaos_pass": artifact["pass"],
+                      "failed": [c["name"] for c in check.results
+                                 if not c["ok"]]}), flush=True)
+    return artifact
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Replicated-serving chaos rig (router + fault schedule)"
+    )
+    ap.add_argument("--replica", action="store_true",
+                    help="internal: run as one serve replica")
+    ap.add_argument("--fault-spec", default=None,
+                    help="fault schedule for --replica mode")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--load", type=float, default=6.0,
+                    help="pre/post-kill load window, seconds")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--kill-after", type=int, default=80,
+                    help="victim hard-exits on its Nth request")
+    ap.add_argument("--out", default=None, metavar="PATH")
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args(argv)
+    if args.replica:
+        return run_replica(fault_spec=args.fault_spec)
+    artifact = run_chaos(
+        n_replicas=args.replicas, load_s=args.load,
+        post_kill_s=args.load + 2.0, clients=args.clients,
+        kill_after=args.kill_after, out=args.out, workdir=args.workdir,
+    )
+    return 0 if artifact["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,6 +121,16 @@ HOT_PATHS: Tuple[HotPath, ...] = (
     # Broker phase handlers: the round loop itself.
     HotPath("freedm_tpu/runtime/broker.py", "Broker.run_round"),
     HotPath("freedm_tpu/runtime/broker.py", "Broker.run"),
+    # Replica router (serve/router.py): pure host HTTP proxying — no
+    # device value can ever appear on a routing path, so zero syncs are
+    # allowed anywhere in the attempt loop or the single-forward step.
+    HotPath("freedm_tpu/serve/router.py", "Router.route"),
+    HotPath("freedm_tpu/serve/router.py", "Router._route_attempts"),
+    HotPath("freedm_tpu/serve/router.py", "Router._forward_once"),
+    # Fault injection (core/faults.py): should() runs inside the DCN
+    # pump and executor-lane hot paths whenever a schedule is active —
+    # host-only bookkeeping, zero syncs.
+    HotPath("freedm_tpu/core/faults.py", "FaultRegistry.should"),
 )
 
 #: numpy coercions that force a device transfer when fed a jax array.

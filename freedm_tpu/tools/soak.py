@@ -806,6 +806,7 @@ def run_soak(
     vvc: bool = True,
     serve_load: bool = True,
     qsts_probe: bool = False,
+    chaos: bool = False,
 ) -> Dict:
     import tempfile
 
@@ -1174,6 +1175,25 @@ def run_soak(
             )
         except Exception as e:  # a truncated file must not fail the soak
             trace_summary["error"] = repr(e)
+    # Replicated-serving chaos phase (ISSUE 12): the 3-replica router
+    # fleet driven through its deterministic fault schedule — a replica
+    # hard-killed mid-load must yield zero untyped client failures,
+    # >= 99.9% success via router retries, and cache hit-ratio
+    # retention on the moved hash range.  Run AFTER the federation
+    # schedule (its own processes, its own ports) so the two fault
+    # domains cannot mask each other's failures.
+    chaos_artifact: Optional[Dict] = None
+    if chaos:
+        from freedm_tpu.tools import chaos as chaos_mod
+
+        chaos_artifact = chaos_mod.run_chaos(
+            workdir=str(wd / "chaos"), out=str(wd / "chaos.json")
+        )
+        check.record(
+            "chaos_replica_fleet", chaos_artifact["pass"],
+            f"failed={[c['name'] for c in chaos_artifact['checks'] if not c['ok']]}",
+        )
+
     artifact = {
         "pass": check.passed,
         "slices": n_slices,
@@ -1191,6 +1211,8 @@ def run_soak(
         },
         "profile": profile_snap,
     }
+    if chaos_artifact is not None:
+        artifact["chaos"] = chaos_artifact
     if out:
         Path(out).write_text(json.dumps(artifact, indent=2))
     print(json.dumps({"soak_pass": artifact["pass"],
@@ -1216,12 +1238,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the background what-if query load")
     ap.add_argument("--no-qsts-probe", action="store_true",
                     help="skip the QSTS kill/resume determinism probe")
+    ap.add_argument("--chaos", action="store_true",
+                    help="also run the replicated-serving chaos phase "
+                         "(3 replicas + router, deterministic kill "
+                         "schedule; tools/chaos.py) and gate on it")
     args = ap.parse_args(argv)
     artifact = run_soak(
         n_slices=args.slices, duration_s=args.duration, loss_pct=args.loss,
         workdir=args.workdir, out=args.out, vvc=not args.no_vvc,
         serve_load=not args.no_serve_load,
         qsts_probe=not args.no_qsts_probe,
+        chaos=args.chaos,
     )
     return 0 if artifact["pass"] else 1
 

@@ -1,0 +1,289 @@
+"""Fault-injection framework tests (``freedm_tpu.core.faults``):
+spec parsing + typed rejection, deterministic replay, the disabled-path
+cost contract, and the end-to-end injection sites — DCN drop absorbed
+by the SR transport, executor crash contained to one batch, cache
+corruption caught by the float64 residual verify, and the QSTS
+worker-crash auto-requeue.
+"""
+
+import time
+
+import pytest
+
+from freedm_tpu.core import metrics as M
+from freedm_tpu.core.faults import (
+    FAULTS,
+    FaultRegistry,
+    KNOWN_POINTS,
+    parse_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+# ---------------------------------------------------------------------------
+# spec parsing + determinism
+# ---------------------------------------------------------------------------
+
+
+def test_parse_spec_full_grammar():
+    seed, points = parse_spec(
+        "seed=7; dcn.drop_tx:0.25; serve.exec.delay:1:arg=0.05:max=3;"
+        "serve.replica.kill:1:after=80:max=1"
+    )
+    assert seed == 7
+    by = {p.name: p for p in points}
+    assert by["dcn.drop_tx"].rate == 0.25
+    assert by["serve.exec.delay"].arg == 0.05
+    assert by["serve.exec.delay"].max_fires == 3
+    assert by["serve.replica.kill"].after == 80
+
+
+def test_unknown_point_and_bad_options_are_typed_errors():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        parse_spec("dcn.drop_everything:0.5")
+    with pytest.raises(ValueError, match="rate"):
+        parse_spec("dcn.drop_tx:1.5")
+    with pytest.raises(ValueError, match="unknown fault option"):
+        parse_spec("dcn.drop_tx:0.5:frequency=2")
+    with pytest.raises(ValueError, match="name:rate"):
+        parse_spec("dcn.drop_tx")
+
+
+def test_schedule_replays_identically():
+    """The acceptance contract: a fresh registry configured with the
+    SAME spec fires the identical sequence, and sequence() predicts it
+    without consuming draws."""
+    spec = "seed=42;dcn.drop_rx:0.3:after=2;serve.exec.crash:0.6:max=4"
+    a, b = FaultRegistry(), FaultRegistry()
+    a.configure(spec)
+    b.configure(spec)
+    for point in ("dcn.drop_rx", "serve.exec.crash"):
+        predicted = a.sequence(point, 50)
+        fired_a = [a.should(point) for _ in range(50)]
+        fired_b = [b.should(point) for _ in range(50)]
+        assert predicted == fired_a == fired_b
+    # A different seed produces a different schedule.
+    c = FaultRegistry().configure(spec.replace("seed=42", "seed=43"))
+    assert [c.should("dcn.drop_rx") for _ in range(50)] != \
+        [FaultRegistry().configure(spec).should("dcn.drop_rx")
+         for _ in range(50)]
+
+
+def test_explicit_zero_arg_is_honored():
+    """`arg=0` is a configured value, not a fall-through to the site
+    default (a zero-magnitude control run must actually be zero)."""
+    r = FaultRegistry().configure("seed=1;serve.cache.corrupt:1:arg=0")
+    assert r.arg("serve.cache.corrupt", 0.05) == 0.0
+    r2 = FaultRegistry().configure("seed=1;serve.cache.corrupt:1")
+    assert r2.arg("serve.cache.corrupt", 0.05) == 0.05  # unconfigured
+
+
+def test_after_and_max_bound_the_fires():
+    r = FaultRegistry().configure("seed=1;dcn.drop_tx:1:after=3:max=2")
+    fires = [r.should("dcn.drop_tx") for _ in range(10)]
+    assert fires == [False] * 3 + [True, True] + [False] * 5
+
+
+def test_disabled_path_is_one_attribute_check():
+    """The production contract: with no schedule configured, the
+    instrumented sites pay one attribute read.  Pin the shape (enabled
+    is a plain False attribute) and a generous absolute bound on the
+    guard itself — not a brittle micro-benchmark, just a tripwire
+    against someone putting a lock or dict probe on the disabled path."""
+    assert FAULTS.enabled is False
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if FAULTS.enabled:  # the exact guard every site uses
+            FAULTS.should("dcn.drop_tx")
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 5e-6, f"disabled fault guard cost {per_call * 1e9:.0f} ns"
+    # configure(None) / reset() return to the disabled state.
+    FAULTS.configure("seed=1;dcn.drop_tx:1")
+    assert FAULTS.enabled
+    FAULTS.configure(None)
+    assert FAULTS.enabled is False
+
+
+def test_every_known_point_is_documented():
+    text = open("docs/robustness.md").read()
+    for name in KNOWN_POINTS:
+        assert f"`{name}`" in text, f"{name} missing from docs/robustness.md"
+
+
+# ---------------------------------------------------------------------------
+# injection sites, end to end
+# ---------------------------------------------------------------------------
+
+
+def test_dcn_drop_tx_is_absorbed_by_sr_retransmits():
+    """100%-for-3-fires egress drop: the SR channel's resend clock must
+    deliver the message anyway, and the injected drops are counted."""
+    from freedm_tpu.dcn.endpoint import UdpEndpoint
+    from freedm_tpu.runtime.messages import ModuleMessage
+
+    from test_federation import free_udp_ports
+
+    pa, pb = free_udp_ports(2)
+    got = []
+    a = UdpEndpoint(f"127.0.0.1:{pa}", bind=("127.0.0.1", pa),
+                    resend_time_s=0.02)
+    b = UdpEndpoint(f"127.0.0.1:{pb}", bind=("127.0.0.1", pb),
+                    sink=got.append, resend_time_s=0.02)
+    a.connect(b.uuid, ("127.0.0.1", pb))
+    b.connect(a.uuid, ("127.0.0.1", pa))
+    injected_before = M.FAULTS_INJECTED.labels("dcn.drop_tx").value
+    FAULTS.configure("seed=5;dcn.drop_tx:1:max=3")
+    a.start()
+    b.start()
+    try:
+        a.send(b.uuid, ModuleMessage("lb", "ping", {"n": 1}, source=a.uuid))
+        deadline = time.monotonic() + 10.0
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert got and got[0].type == "ping"
+        assert M.FAULTS_INJECTED.labels("dcn.drop_tx").value \
+            >= injected_before + 1
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_exec_crash_fails_one_batch_typed_lane_survives():
+    """serve.exec.crash: the faulted batch's waiter gets the typed
+    `internal` error; the NEXT request on the same lane succeeds."""
+    from freedm_tpu.serve import ServeConfig, ServeError, Service
+
+    svc = Service(ServeConfig(max_batch=2, buckets=(1, 2), cache_mb=0.0))
+    try:
+        # Warm the engine first so the crash hits a compiled path.
+        svc.request("pf", {"case": "case14", "timeout_s": 300.0})
+        FAULTS.configure("seed=2;serve.exec.crash:1:max=1")
+        with pytest.raises(ServeError) as ei:
+            svc.request("pf", {"case": "case14", "scale": 1.01,
+                               "timeout_s": 60.0})
+        assert ei.value.code == "internal"
+        # Lane survived: the very next dispatch answers normally.
+        resp = svc.request("pf", {"case": "case14", "scale": 1.02,
+                                  "timeout_s": 60.0})
+        assert resp.converged
+    finally:
+        FAULTS.reset()
+        svc.stop(drain_s=0)
+
+
+def test_cache_corruption_is_caught_by_residual_verify():
+    """serve.cache.corrupt perturbs every delta-tier candidate BEFORE
+    the float64 verify: no corrupted answer may be served — the tier
+    falls through, the answers stay correct, and the delta hit counter
+    stays frozen."""
+    import numpy as np
+
+    from freedm_tpu.serve import ServeConfig, Service
+
+    svc = Service(ServeConfig(max_batch=2, buckets=(1, 2)))
+    try:
+        n = 14
+        zeros = [0.0] * n
+        base = {"case": "case14", "timeout_s": 300.0,
+                "p_inj": zeros, "q_inj": zeros}
+        first = svc.request("pf", base)  # populates the cache
+        assert first.converged
+        # A rank-1 perturbation of the cached base injections: delta-
+        # tier traffic.  With corruption injected at rate 1, the verify
+        # must reject every candidate.
+        p = list(zeros)
+        p[2] = -0.01
+        FAULTS.configure("seed=3;serve.cache.corrupt:1:arg=0.05")
+        delta_hits_before = M.SERVE_CACHE_HITS.labels("delta").value
+        resp = svc.request("pf", {"case": "case14", "timeout_s": 300.0,
+                                  "p_inj": p, "q_inj": [0.0] * n,
+                                  "return_state": True})
+        assert resp.converged
+        assert resp.batch.tier in ("full",)  # fell through, never "delta"
+        assert M.SERVE_CACHE_HITS.labels("delta").value == delta_hits_before
+        assert resp.residual_pu < 1e-6
+        # The served voltages are a REAL solution (not the corrupted
+        # candidate): re-solving with the cache off agrees.
+        FAULTS.reset()
+        svc_off = Service(ServeConfig(max_batch=2, buckets=(1, 2),
+                                      cache_mb=0.0))
+        try:
+            ref = svc_off.request("pf", {"case": "case14",
+                                         "timeout_s": 300.0, "p_inj": p,
+                                         "q_inj": [0.0] * n,
+                                         "return_state": True})
+            np.testing.assert_allclose(resp.v, ref.v, atol=1e-6)
+        finally:
+            svc_off.stop(drain_s=0)
+    finally:
+        FAULTS.reset()
+        svc.stop(drain_s=0)
+
+
+def test_qsts_worker_crash_requeues_from_checkpoint(tmp_path):
+    """qsts.worker.crash at the first chunk boundary: the job manager
+    requeues the job, the rerun resumes from the chunk checkpoint, and
+    the final summary is a normal completion."""
+    from freedm_tpu.scenarios.jobs import JobManager
+
+    events_before = len(M.EVENTS)
+    requeued_before = M.QSTS_REQUEUED.value
+    FAULTS.configure("seed=4;qsts.worker.crash:1:max=1")
+    jm = JobManager(workers=1, checkpoint_dir=str(tmp_path)).start()
+    try:
+        out = jm.submit({"case": "case14", "scenarios": 2, "steps": 12,
+                         "chunk_steps": 4, "seed": 9,
+                         "job_key": "crashprobe"})
+        job_id = out["job_id"]
+        deadline = time.monotonic() + 300.0
+        j = {}
+        while time.monotonic() < deadline:
+            j = jm.get(job_id)
+            if j["state"] in ("completed", "failed", "cancelled"):
+                break
+            time.sleep(0.2)
+        assert j["state"] == "completed", j
+        assert j["requeues"] == 1
+        # The requeue's crash record must not survive a successful
+        # completion — "completed" with an "error" key misreads as
+        # failure to pollers.
+        assert "error" not in j
+        # The rerun RESUMED (chunk 1's checkpoint was on disk when the
+        # crash fired after chunk 1 completed).
+        assert j["summary"]["resumed_from_chunk"] >= 1
+        assert M.QSTS_REQUEUED.value == requeued_before + 1
+        tail = M.EVENTS.tail(len(M.EVENTS) - events_before)
+        assert any(e.get("event") == "qsts.requeued" for e in tail)
+    finally:
+        FAULTS.reset()
+        jm.stop()
+
+
+def test_unkeyed_job_crash_fails_instead_of_silent_restart(tmp_path):
+    from freedm_tpu.scenarios.jobs import JobManager
+
+    FAULTS.configure("seed=4;qsts.worker.crash:1:max=1")
+    jm = JobManager(workers=1, checkpoint_dir=str(tmp_path)).start()
+    try:
+        out = jm.submit({"case": "case14", "scenarios": 2, "steps": 12,
+                         "chunk_steps": 4, "seed": 9})  # no job_key
+        deadline = time.monotonic() + 300.0
+        j = {}
+        while time.monotonic() < deadline:
+            j = jm.get(out["job_id"])
+            if j["state"] in ("completed", "failed", "cancelled"):
+                break
+            time.sleep(0.2)
+        assert j["state"] == "failed", j
+        assert "qsts.worker.crash" in j["error"]
+        assert j["requeues"] == 0
+    finally:
+        FAULTS.reset()
+        jm.stop()

@@ -315,30 +315,82 @@ class _Proc:
             self.proc.wait(timeout=5)
 
 
+#: Bounded attempts for the subprocess e2e (the PR5 fleet-test
+#: discipline): multi-process + wall-clock regrouping is inherently
+#: load-sensitive, so a failed run retries on fresh ports — but never
+#: more than this many attempts total.
+E2E_ATTEMPTS = 2
+
+
 def test_federated_processes_e2e(tmp_path):
     """Two real freedm_tpu processes over real UDP: one group, power
-    migrated, a killed peer splits the group, a restart re-merges it."""
+    migrated, a killed peer splits the group, a restart re-merges it.
+
+    Readiness-polled end to end (no fixed round counts or sleeps):
+    every phase polls its own condition under a bounded deadline, a
+    child that EXITS mid-phase fails the attempt immediately instead
+    of burning the deadline, and the whole scenario retries once on
+    fresh ports — the same bounded-retry pattern the PR5 tracing
+    fleet test uses for multi-process wall-clock scenarios."""
+    last = None
+    for attempt in range(E2E_ATTEMPTS):
+        try:
+            _assert_federated_processes_e2e(tmp_path / f"attempt{attempt}")
+            return
+        except AssertionError as e:
+            last = e
+    raise last
+
+
+def _assert_federated_processes_e2e(workdir):
+    workdir.mkdir(parents=True, exist_ok=True)
     ports = free_udp_ports(2)
-    cfg_a = _write_fed_configs(tmp_path, ports, ports[0], ports[1])
-    cfg_b = _write_fed_configs(tmp_path, ports, ports[1], ports[0])
-    a = _Proc(cfg_a)
-    b = _Proc(cfg_b)
+    cfg_a = _write_fed_configs(workdir, ports, ports[0], ports[1])
+    cfg_b = _write_fed_configs(workdir, ports, ports[1], ports[0])
+    # --summary-every 5: the readiness conditions below poll the round
+    # summaries, so the summary cadence IS the polling resolution (25
+    # free-running rounds could outlive a phase deadline under load).
+    a = _Proc(cfg_a, extra=["--summary-every", "5"])
+    b = _Proc(cfg_b, extra=["--summary-every", "5"])
+
+    def alive_wait(proc, other, cond, timeout_s):
+        """wait_for that fails FAST when either child exits (a dead
+        child can never satisfy the condition — burning the rest of
+        the deadline just converts a crash into a timeout)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if cond(proc.last()):
+                return True
+            if proc.proc.poll() is not None:
+                return False
+            if other is not None and other.proc.poll() is not None:
+                return False
+            time.sleep(0.1)
+        return cond(proc.last())
+
     try:
         # Phase 1: federation forms and power flows A→B.
-        ok = a.wait_for(
-            lambda l: l.get("fed_members") == 2 and l.get("gateway_total", 0) >= 5.0
+        ok = alive_wait(
+            a, b,
+            lambda l: l.get("fed_members") == 2
+            and l.get("gateway_total", 0) >= 5.0,
+            timeout_s=90.0,
         )
         assert ok, (a.last(), b.last(), a.proc.poll(), b.proc.poll())
-        assert b.wait_for(lambda l: l.get("fed_members") == 2)
+        assert alive_wait(b, a, lambda l: l.get("fed_members") == 2,
+                          timeout_s=30.0), (b.last(), b.proc.poll())
         leader_before = a.last().get("fed_leader")
         # Phase 2: kill B — A's group must shrink to itself.
         b.kill()
-        assert a.wait_for(lambda l: l.get("fed_members") == 1), a.last()
+        assert alive_wait(a, None, lambda l: l.get("fed_members") == 1,
+                          timeout_s=90.0), (a.last(), a.proc.poll())
         # Phase 3: restart B — the groups re-merge.
         b.lines.clear()
         b.start()
-        assert a.wait_for(lambda l: l.get("fed_members") == 2), a.last()
-        assert b.wait_for(lambda l: l.get("fed_members") == 2), b.last()
+        assert alive_wait(a, b, lambda l: l.get("fed_members") == 2,
+                          timeout_s=90.0), (a.last(), b.proc.poll())
+        assert alive_wait(b, a, lambda l: l.get("fed_members") == 2,
+                          timeout_s=30.0), (b.last(), b.proc.poll())
         assert b.last().get("fed_leader") == a.last().get("fed_leader")
         assert leader_before is not None
     finally:

@@ -1,0 +1,481 @@
+"""Replica-router semantics (``freedm_tpu.serve.router``): hash-ring
+affinity stability under join/leave, retry-respects-deadline, breaker
+open/half-open/close transitions, drain completes in-flight, and the
+kill-one-of-three failover answering byte-identically via a survivor.
+
+The protocol tests (retry/breaker/drain) run against scripted STUB
+replicas — plain HTTP servers with programmable behavior — so they pin
+router semantics without paying solver compiles.  The failover
+byte-identity test runs three REAL serve stacks.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+
+from freedm_tpu.core import metrics as M
+from freedm_tpu.core.metrics import BackgroundHttpServer
+from freedm_tpu.serve.router import (
+    HashRing,
+    Router,
+    RouterConfig,
+    RouterServer,
+)
+
+# ---------------------------------------------------------------------------
+# stub replicas
+# ---------------------------------------------------------------------------
+
+
+class StubReplica:
+    """A scripted replica: ``behavior`` keys steer every request.
+
+    ``fail_500`` — answer that many requests with a typed internal 500;
+    ``sleep_s`` — stall each POST; ``draining`` — reported on /healthz;
+    ``refuse`` — close the listener entirely (connection refused).
+    """
+
+    def __init__(self, **behavior):
+        self.behavior = dict(behavior)
+        self.posts = 0
+        self.budgets = []  # X-Deadline-Budget-S header per request
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj):
+                data = (json.dumps(obj) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._send(200, {
+                    "ok": True,
+                    "draining": stub.behavior.get("draining", False),
+                })
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                stub.posts += 1
+                stub.budgets.append(
+                    self.headers.get("X-Deadline-Budget-S")
+                )
+                if stub.behavior.get("sleep_s"):
+                    time.sleep(stub.behavior["sleep_s"])
+                if stub.behavior.get("overloaded"):
+                    self._send(429, {"error": {"type": "overloaded",
+                                               "detail": "scripted"}})
+                    return
+                if stub.behavior.get("fail_500", 0) > 0:
+                    stub.behavior["fail_500"] -= 1
+                    self._send(500, {"error": {"type": "internal",
+                                               "detail": "scripted"}})
+                    return
+                self._send(200, {"ok": True, "echo": json.loads(body)})
+
+        self.server = BackgroundHttpServer(H, port=0).start()
+        self.port = self.server.port
+        self.id = f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        self.server.stop()
+
+
+def _post(port, case, timeout_s=5.0, client_timeout=30.0):
+    body = json.dumps({"case": case, "timeout_s": timeout_s}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/pf", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=client_timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        payload = json.loads(e.read())
+        headers = dict(e.headers)
+        e.close()
+        return e.code, payload, headers
+
+
+# ---------------------------------------------------------------------------
+# hash-ring affinity
+# ---------------------------------------------------------------------------
+
+
+def test_ring_affinity_stable_under_leave_and_join():
+    ring = HashRing(vnodes=64)
+    members = ["10.0.0.1:1", "10.0.0.2:2", "10.0.0.3:3"]
+    for m in members:
+        ring.add(m)
+    keys = [f"case{i}" for i in range(300)]
+    owners = {k: ring.owner(k) for k in keys}
+    assert set(owners.values()) == set(members)  # every replica owns range
+
+    # LEAVE: only the departed member's keys move.
+    ring.remove(members[1])
+    for k in keys:
+        if owners[k] != members[1]:
+            assert ring.owner(k) == owners[k], k
+        else:
+            assert ring.owner(k) != members[1]
+    # JOIN back: the original mapping returns exactly.
+    ring.add(members[1])
+    assert {k: ring.owner(k) for k in keys} == owners
+
+    # The preference list starts at the owner and covers every member.
+    pref = ring.preference(keys[0])
+    assert pref[0] == owners[keys[0]]
+    assert sorted(pref) == sorted(members)
+
+
+def test_router_routes_same_case_to_same_replica():
+    a, b = StubReplica(), StubReplica()
+    router = Router([a.id, b.id], RouterConfig())
+    srv = RouterServer(router, port=0)
+    srv._server.start()  # no prober: deterministic stub accounting
+    try:
+        served = set()
+        for _ in range(4):
+            code, _, headers = _post(srv.port, "caseAffinity")
+            assert code == 200
+            served.add(headers.get("X-Served-By"))
+        assert len(served) == 1  # affinity held across repeats
+        assert served.pop() == router.ring.owner("caseAffinity")
+    finally:
+        srv._server.stop()
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# retry budget
+# ---------------------------------------------------------------------------
+
+
+def test_retry_respects_deadline_budget():
+    """Dead replicas: the router retries with backoff but NEVER past
+    the request's own deadline — a typed answer arrives promptly after
+    the budget, not after some unrelated retry cap."""
+    a = StubReplica()
+    a.stop()  # connection refused from here on
+    router = Router([a.id], RouterConfig(
+        breaker_failures=1000,  # keep the breaker out of this test
+        retry_base_s=0.01,
+    ))
+    srv = RouterServer(router, port=0)
+    srv._server.start()
+    try:
+        t0 = time.monotonic()
+        code, payload, headers = _post(srv.port, "case14", timeout_s=0.6)
+        elapsed = time.monotonic() - t0
+        assert payload["error"]["type"] == "deadline_exceeded"
+        assert code == 504
+        # Bounded promptly by the budget (generous slack for CI).
+        assert 0.5 <= elapsed < 3.0, elapsed
+        assert M.ROUTER_RETRIES.value >= 1
+    finally:
+        srv._server.stop()
+
+
+def test_deadline_budget_header_propagates_and_shrinks():
+    a = StubReplica(fail_500=1)
+    router = Router([a.id], RouterConfig(
+        breaker_failures=1000, retry_base_s=0.05, retry_cap_s=0.05,
+    ))
+    try:
+        reply = router.route(
+            "/v1/pf",
+            json.dumps({"case": "x", "timeout_s": 4.0}).encode(),
+        )
+        assert reply.status == 200
+        budgets = [float(b) for b in a.budgets]
+        assert len(budgets) == 2  # the 500, then the retry
+        assert budgets[0] <= 4.0
+        assert budgets[1] < budgets[0]  # the budget SHRANK across retry
+    finally:
+        a.stop()
+
+
+def test_per_replica_429_fails_over_immediately():
+    """An overloaded owner must not be hammered until the deadline:
+    the request fails over to the next ring replica at once, and a
+    fully-shedding fleet propagates the typed 429 promptly."""
+    a = StubReplica(overloaded=True)
+    b = StubReplica()
+    router = Router([a.id, b.id], RouterConfig(retry_base_s=0.01))
+    case = next(f"case{i}" for i in range(200)
+                if router.ring.owner(f"case{i}") == a.id)
+    try:
+        t0 = time.monotonic()
+        reply = router.route(
+            "/v1/pf", json.dumps({"case": case, "timeout_s": 20}).encode()
+        )
+        assert reply.status == 200 and reply.served_by == b.id
+        assert time.monotonic() - t0 < 2.0  # no backoff burn
+        assert a.posts == 1  # asked once, then skipped for the request
+
+        # The WHOLE fleet shedding: typed 429 back to the client,
+        # promptly, with Retry-After — never a 504 deadline burn.
+        b.behavior["overloaded"] = True
+        t0 = time.monotonic()
+        reply = router.route(
+            "/v1/pf", json.dumps({"case": case, "timeout_s": 20}).encode()
+        )
+        assert reply.status == 429
+        assert json.loads(reply.body)["error"]["type"] == "overloaded"
+        assert reply.retry_after is not None
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_typed_client_errors_pass_through_unretried():
+    a = StubReplica()
+    router = Router([a.id], RouterConfig())
+    try:
+        # Unknown workload: router-side typed 400, no proxy at all.
+        reply = router.route("/v1/nope", json.dumps({"case": "x"}).encode())
+        assert reply.status == 400
+        assert json.loads(reply.body)["error"]["type"] == "invalid_request"
+        assert a.posts == 0
+        # Missing case: also router-side.
+        reply = router.route("/v1/pf", b"{}")
+        assert reply.status == 400
+    finally:
+        a.stop()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_open_half_open_close_transitions():
+    a = StubReplica()
+    b = StubReplica()
+    router = Router([a.id, b.id], RouterConfig(
+        breaker_failures=2, breaker_cooldown_s=0.3, retry_base_s=0.005,
+    ))
+    # Find a case owned by A so its failures are what we script.
+    case = next(f"case{i}" for i in range(200)
+                if router.ring.owner(f"case{i}") == a.id)
+    a.stop()  # A is dead: connection refused
+    try:
+        # Two requests -> two A-failures -> breaker OPEN (answers still
+        # arrive via failover to B).
+        for _ in range(2):
+            reply = router.route(
+                "/v1/pf",
+                json.dumps({"case": case, "timeout_s": 5}).encode(),
+            )
+            assert reply.status == 200
+            assert reply.served_by == b.id
+        assert router.states()[a.id]["breaker"] == "open"
+        assert M.ROUTER_FAILOVERS.value >= 2
+
+        # While OPEN (inside cooldown) A is never tried again.
+        posts_before = b.posts
+        reply = router.route(
+            "/v1/pf", json.dumps({"case": case, "timeout_s": 5}).encode()
+        )
+        assert reply.status == 200 and reply.served_by == b.id
+        assert b.posts == posts_before + 1
+
+        # Revive A on the SAME port, wait out the cooldown: the next
+        # request is the half-open trial, succeeds, and CLOSES it.
+        a2 = _revive(a.port)
+        try:
+            time.sleep(0.35)
+            reply = router.route(
+                "/v1/pf",
+                json.dumps({"case": case, "timeout_s": 5}).encode(),
+            )
+            assert reply.status == 200 and reply.served_by == a.id
+            assert router.states()[a.id]["breaker"] == "closed"
+        finally:
+            a2.stop()
+    finally:
+        b.stop()
+
+
+def _revive(port):
+    """A fresh stub bound to a specific (just-freed) port."""
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, obj):
+            data = (json.dumps(obj) + "\n").encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            self._send(200, {"ok": True, "draining": False})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(n)
+            self._send(200, {"ok": True, "revived": True})
+
+    return BackgroundHttpServer(H, port=port).start()
+
+
+def test_all_replicas_open_sheds_typed_503_with_retry_after():
+    a = StubReplica()
+    a.stop()
+    router = Router([a.id], RouterConfig(
+        breaker_failures=1, breaker_cooldown_s=60.0, retry_base_s=0.005,
+    ))
+    shed_before = M.ROUTER_SHED.value
+    # First request opens the breaker (and dies on the deadline);
+    # second finds NO admittable replica -> typed unavailable shed.
+    router.route("/v1/pf", json.dumps({"case": "x", "timeout_s": 0.2}).encode())
+    reply = router.route(
+        "/v1/pf", json.dumps({"case": "x", "timeout_s": 5}).encode()
+    )
+    assert reply.status == 503
+    assert json.loads(reply.body)["error"]["type"] == "unavailable"
+    assert reply.retry_after is not None and int(reply.retry_after) >= 1
+    assert M.ROUTER_SHED.value > shed_before
+
+
+# ---------------------------------------------------------------------------
+# drain
+# ---------------------------------------------------------------------------
+
+
+def test_drained_replica_stops_receiving_new_work_inflight_completes():
+    a = StubReplica(sleep_s=0.4)
+    b = StubReplica()
+    router = Router([a.id, b.id], RouterConfig())
+    case = next(f"case{i}" for i in range(200)
+                if router.ring.owner(f"case{i}") == a.id)
+    try:
+        results = {}
+
+        def inflight():
+            results["reply"] = router.route(
+                "/v1/pf",
+                json.dumps({"case": case, "timeout_s": 10}).encode(),
+            )
+
+        t = threading.Thread(target=inflight)
+        t.start()
+        time.sleep(0.1)  # the request is now sleeping inside A
+        router.drain(a.id)
+        # An active probe must NOT undo the administrative drain (A's
+        # own /healthz still says draining:false — the router-side
+        # decision outranks it).
+        router.probe_once()
+        assert router.states()[a.id]["draining"] is True
+        # New work for A's range fails over to B immediately...
+        reply = router.route(
+            "/v1/pf", json.dumps({"case": case, "timeout_s": 5}).encode()
+        )
+        assert reply.status == 200 and reply.served_by == b.id
+        # ...while the in-flight request COMPLETES on A (drain never
+        # cuts accepted work).
+        t.join(timeout=5)
+        assert results["reply"].status == 200
+        assert results["reply"].served_by == a.id
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_prober_marks_draining_replica_from_healthz():
+    a = StubReplica(draining=True)
+    b = StubReplica()
+    router = Router([a.id, b.id], RouterConfig())
+    try:
+        router.probe_once()
+        assert router.states()[a.id]["draining"] is True
+        case = next(f"case{i}" for i in range(200)
+                    if router.ring.owner(f"case{i}") == a.id)
+        reply = router.route(
+            "/v1/pf", json.dumps({"case": case, "timeout_s": 5}).encode()
+        )
+        assert reply.status == 200 and reply.served_by == b.id
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# kill-one-of-three: byte-identical answers via the survivor
+# ---------------------------------------------------------------------------
+
+
+def test_kill_one_of_three_survivor_answers_byte_identical():
+    """Three REAL serve stacks behind the router: kill the replica that
+    owns case14 mid-session; the re-routed request must return the
+    byte-identical solver answer (the receipt aside) from a survivor."""
+    from freedm_tpu.serve import ServeConfig, ServeServer, Service
+
+    stacks = []
+    try:
+        for _ in range(3):
+            svc = Service(ServeConfig(max_batch=4, buckets=(1, 2, 4)))
+            srv = ServeServer(svc, port=0).start()
+            stacks.append((svc, srv))
+        router = Router(
+            [f"127.0.0.1:{srv.port}" for _, srv in stacks],
+            RouterConfig(breaker_failures=1, retry_base_s=0.01),
+        )
+        rs = RouterServer(router, port=0)
+        rs._server.start()
+        try:
+            body = {"case": "case14", "return_state": True,
+                    "timeout_s": 300.0}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{rs.port}/v1/pf",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=310) as r:
+                first = json.loads(r.read())
+                owner = r.headers.get("X-Served-By")
+            assert owner == router.ring.owner("case14")
+            # Kill the owner (server AND service): abrupt, no drain.
+            victim = next(
+                (svc, srv) for svc, srv in stacks
+                if f"127.0.0.1:{srv.port}" == owner
+            )
+            victim[1].stop()
+            victim[0].stop(drain_s=0)
+            with urllib.request.urlopen(req, timeout=310) as r:
+                second = json.loads(r.read())
+                survivor = r.headers.get("X-Served-By")
+            assert survivor != owner
+            # Byte-identical solver answer: same case, same flat start,
+            # same compiled program — only the batching receipt may
+            # differ between replicas.
+            first.pop("batch")
+            second.pop("batch")
+            assert first == second
+        finally:
+            rs._server.stop()
+    finally:
+        for svc, srv in stacks:
+            try:
+                srv.stop()
+                svc.stop(drain_s=0)
+            except Exception:
+                pass

@@ -325,6 +325,24 @@ def _post(port, path, payload):
             return e.code, json.loads(e.read())
 
 
+def _post_with_headers(port, path, payload):
+    """Like :func:`_post` but also returns the response headers (the
+    Retry-After satellite asserts on them)."""
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        payload = json.loads(e.read())
+        hdrs = dict(e.headers)
+        e.close()
+        return e.code, payload, hdrs
+
+
 def test_http_roundtrip_and_typed_errors(svc):
     srv = ServeServer(svc, port=0).start()
     try:
@@ -481,16 +499,78 @@ def test_http_overload_sheds_with_429():
     srv = ServeServer(svc2, port=0).start()
     try:
         fut = svc2.submit("pf", {"case": "case14"})  # fills the only slot
-        code, d = _post(srv.port, "/v1/pf", {"case": "case14"})
+        code, d, headers = _post_with_headers(
+            srv.port, "/v1/pf", {"case": "case14"}
+        )
         assert code == 429 and d["error"]["type"] == "overloaded"
+        # Typed backpressure carries the back-off hint (ISSUE 12).
+        assert int(headers["Retry-After"]) >= 1
         shed = M.REGISTRY.get("serve_shed_total")
         assert shed.value >= 1
-        svc2.stop()  # drains the queued ticket with a typed shutdown
+        # drain_s=0: the batcher of this service never runs, so the
+        # admitted ticket can only resolve via the shutdown path.
+        svc2.stop(drain_s=0)
         assert isinstance(fut.exception(timeout=5), ShuttingDown)
         with pytest.raises(ShuttingDown):
             svc2.submit("pf", {"case": "case14"})
+        # Not-yet-admitted work over HTTP: typed 503 + Retry-After.
+        code, d, headers = _post_with_headers(
+            srv.port, "/v1/pf", {"case": "case14"}
+        )
+        assert code == 503 and d["error"]["type"] == "shutting_down"
+        assert int(headers["Retry-After"]) >= 1
     finally:
         srv.stop()
+
+
+def test_graceful_stop_drains_admitted_work():
+    """The drain satellite: stop() lets already-admitted tickets FINISH
+    (typed shutting_down is only for work submitted after the seal)."""
+    svc2 = Service(ServeConfig(max_batch=2, buckets=(1, 2), cache_mb=0.0))
+    try:
+        fut = svc2.submit("pf", {"case": "case14", "timeout_s": 300.0})
+        svc2.stop()  # default drain: the admitted solve completes
+        resp = fut.result(timeout=30.0)
+        assert resp.converged
+        with pytest.raises(ShuttingDown):
+            svc2.submit("pf", {"case": "case14"})
+    finally:
+        svc2.stop(drain_s=0)  # idempotent
+
+
+def test_healthz_reports_draining_after_begin_drain(svc):
+    srv = ServeServer(svc, port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=30
+        ) as r:
+            assert json.loads(r.read())["draining"] is False
+        srv.begin_drain()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=30
+        ) as r:
+            assert json.loads(r.read())["draining"] is True
+    finally:
+        srv.stop()
+
+
+def test_deadline_budget_clamps_timeout():
+    from freedm_tpu.serve.http import apply_deadline_budget
+
+    p = {"case": "case14", "timeout_s": 30.0}
+    apply_deadline_budget(p, "2.5")
+    assert p["timeout_s"] == 2.5
+    p = {"case": "case14", "timeout_s": 1.0}
+    apply_deadline_budget(p, "2.5")  # budget LARGER: timeout kept
+    assert p["timeout_s"] == 1.0
+    p = {"case": "case14"}
+    apply_deadline_budget(p, "2.5")  # no timeout: budget becomes it
+    assert p["timeout_s"] == 2.5
+    p = {"case": "case14", "timeout_s": 30.0}
+    apply_deadline_budget(p, "garbage")  # unparseable: ignored
+    apply_deadline_budget(p, "-1")
+    apply_deadline_budget(p, None)
+    assert p["timeout_s"] == 30.0
 
 
 # ---------------------------------------------------------------------------
