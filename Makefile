@@ -1,6 +1,6 @@
 # Developer/CI entry points.
 #
-#   make check   - static pass: byte-compile + pyflakes + gridlint
+#   make check   - static pass: byte-compile + pyflakes + gridlint + gridprobe
 #   make test    - the tier-1 pytest line from ROADMAP.md
 #
 # `check` degrades gracefully when pyflakes is not installed (the
@@ -8,7 +8,11 @@
 # lint.  gridlint (freedm_tpu/tools/gridlint.py) is stdlib-only, so it
 # always runs — it enforces the project invariants pyflakes cannot see
 # (jit purity, hot-path syncs, config/doc threading, lock order; see
-# docs/static_analysis.md).
+# docs/static_analysis.md).  gridprobe (freedm_tpu/tools/gridprobe.py)
+# audits the compiler IR of every registered jitted program (dtype
+# flow, host transfers, constant capture, donation readiness) and
+# diffs the checked-in program inventory; it needs jax, so it skips
+# gracefully in a bare container the same way pyflakes does.
 
 # `make test` uses `set -o pipefail`, which dash (the default /bin/sh on
 # Debian-family systems) rejects.
@@ -16,9 +20,9 @@ SHELL := /bin/bash
 
 PY ?= python
 
-.PHONY: check compile lint gridlint test
+.PHONY: check compile lint gridlint gridprobe test
 
-check: compile lint gridlint
+check: compile lint gridlint gridprobe
 
 compile:
 	$(PY) -m compileall -q freedm_tpu tests bench.py
@@ -32,6 +36,13 @@ lint:
 
 gridlint:
 	$(PY) -m freedm_tpu.tools.gridlint freedm_tpu tests bench.py
+
+gridprobe:
+	@if $(PY) -c "import jax" 2>/dev/null; then \
+		env JAX_PLATFORMS=cpu $(PY) -m freedm_tpu.tools.gridprobe; \
+	else \
+		echo "jax not installed; skipping gridprobe (gridlint still ran)"; \
+	fi
 
 test:
 	set -o pipefail; rm -f /tmp/_t1.log; \

@@ -1377,6 +1377,36 @@ def bench_quick() -> dict:
     }
 
 
+def _gridprobe_snapshot() -> dict:
+    """Program-inventory stamps for the snapshot: how many distinct
+    jitted programs gridprobe audits and their summed XLA cost-analysis
+    FLOP estimate (tools/ir_inventory.json — read, not re-traced: the
+    checked-in file IS the audited state of this tree).  Rides along in
+    every snapshot so the perf trajectory can correlate throughput
+    changes with program-set changes (an accidental extra shape bucket
+    shows up here before it shows up as a recompile stall)."""
+    import pathlib
+
+    inv = (pathlib.Path(__file__).resolve().parent
+           / "freedm_tpu" / "tools" / "ir_inventory.json")
+    try:
+        d = json.loads(inv.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        # Same schema as the normal path — trajectory tooling must be
+        # able to index both keys across every snapshot.
+        return {"gridprobe_programs_total": 0,
+                "gridprobe_inventory_gflops": 0.0}
+    progs = d.get("programs", {})
+    total = sum(
+        p.get("flops", 0.0) for p in progs.values()
+        if isinstance(p.get("flops"), (int, float)) and p["flops"] > 0
+    )
+    return {
+        "gridprobe_programs_total": len(progs),
+        "gridprobe_inventory_gflops": round(total / 1e9, 6),
+    }
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="freedm_tpu headline benchmarks")
     ap.add_argument(
@@ -1495,6 +1525,9 @@ def main(argv=None) -> None:
     # Registry snapshot: the BENCH trajectory gains solver-iteration /
     # residual / serving columns without new bench code.
     obj["metrics"] = REGISTRY.snapshot()
+    # IR program-set stamps (gridprobe inventory): both names carry no
+    # perf-gate direction fragment, so they record without gating.
+    obj["gridprobe"] = _gridprobe_snapshot()
     print(json.dumps(obj))
 
 

@@ -162,6 +162,19 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "shape-bucket) jit compile accounting, device-"
                          "memory peaks, host hot-path timers (profile_* "
                          "metrics + the /profile route)")
+    ap.add_argument("--probe-inventory", default=None, metavar="PATH",
+                    help="gridprobe program-inventory JSON the CI diff "
+                         "runs against (repo-root relative; default "
+                         "freedm_tpu/tools/ir_inventory.json)")
+    ap.add_argument("--probe-const-mb", type=float, default=None,
+                    metavar="MB",
+                    help="gridprobe GP003 threshold: captured constants "
+                         "at/above this many MB are findings "
+                         "(default 0.25)")
+    ap.add_argument("--probe-flops-tol", type=float, default=None,
+                    metavar="R",
+                    help="gridprobe inventory drift tolerance for the "
+                         "scalar columns (flops/bytes/eqns; default 0.5)")
     ap.add_argument("--slo-enabled", action="store_true", default=None,
                     help="enable the in-process SLO monitor (burn-rate "
                          "windows over the metrics registry; breaches "
@@ -322,6 +335,9 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("metrics_port", "metrics_port"), ("events_log", "events_log"),
         ("trace_log", "trace_log"), ("profile_metrics", "profile_metrics"),
         ("pf_backend", "pf_backend"),
+        ("probe_inventory", "probe_inventory"),
+        ("probe_const_mb", "probe_const_mb"),
+        ("probe_flops_tol", "probe_flops_tol"),
         ("slo_enabled", "slo_enabled"),
         ("slo_fast_window_s", "slo_fast_window_s"),
         ("slo_slow_window_s", "slo_slow_window_s"),

@@ -343,6 +343,14 @@ class GlobalConfig:
     # burn windows, slo.breach/slo.recovered journal events, an /slo
     # route on the metrics server, and a stall watchdog over the serve
     # dispatcher and QSTS workers.
+    # IR auditing (freedm_tpu.tools.gridprobe): the checked-in program
+    # inventory the CI diff runs against (relative to the repo root),
+    # the GP003 constant-capture threshold (MB), and the relative
+    # drift tolerance for the inventory's scalar columns (flops /
+    # bytes / eqn counts; structural columns compare exactly).
+    probe_inventory: str = "freedm_tpu/tools/ir_inventory.json"
+    probe_const_mb: float = 0.25
+    probe_flops_tol: float = 0.5
     slo_enabled: bool = False
     slo_fast_window_s: float = 30.0
     slo_slow_window_s: float = 300.0

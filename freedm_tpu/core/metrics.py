@@ -856,6 +856,11 @@ GRIDLINT_FINDINGS = REGISTRY.counter(
     "gridlint findings by rule id, recorded when the linter runs "
     "in-process (CI static step, self-lint test)",
     labels=("rule",))
+GRIDPROBE_FINDINGS = REGISTRY.counter(
+    "gridprobe_findings_total",
+    "gridprobe IR-audit findings by rule id, recorded when the probe "
+    "runs in-process (CI static step, self-audit test)",
+    labels=("rule",))
 
 
 def observe_pf_result(solver: str, result) -> None:

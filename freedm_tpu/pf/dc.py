@@ -121,26 +121,32 @@ def make_dc_solver(sys: BusSystem, dtype=None, lu=None) -> DcSolver:
     def _flows(theta):
         return (theta[..., f_idx] - theta[..., t_idx]) * w
 
+    # The LU pair rides as a runtime ARGUMENT of the jitted operators,
+    # not a closure constant: captured factors fold 8n² bytes into
+    # every compiled program — 32 MB per topology at 2000 buses — and
+    # the serving cache hands this solver its OWN factor pair, which
+    # must not be duplicated into the compile payload (gridprobe GP003
+    # pins this; same discipline as pf/krylov.py's preconditioner).
     @jax.jit
-    def solve(p=None) -> DcResult:
+    def _solve_impl(lu_f, pj) -> DcResult:
         with jax.default_matmul_precision("highest"):
-            pj = p0 if p is None else jnp.asarray(p, rdtype)
             rhs = jnp.where(th_free > 0, pj, 0.0)
             if rhs.ndim == 1:
-                theta = jax.scipy.linalg.lu_solve(lu, rhs)
+                theta = jax.scipy.linalg.lu_solve(lu_f, rhs)
             else:
                 # [L, n] lanes: ONE multi-RHS triangular solve.
-                theta = jax.scipy.linalg.lu_solve(lu, rhs.T).T
+                theta = jax.scipy.linalg.lu_solve(lu_f, rhs.T).T
             return DcResult(theta=theta, flows=_flows(theta))
 
+    def solve(p=None) -> DcResult:
+        return _solve_impl(lu, p0 if p is None else jnp.asarray(p, rdtype))
+
     @jax.jit
-    def screen_outages(outages, p=None) -> DcScreenResult:
+    def _screen_impl(lu_f, ks, pj) -> DcScreenResult:
         with jax.default_matmul_precision("highest"):
-            ks = jnp.asarray(outages)
             k = ks.shape[0]
-            pj = p0 if p is None else jnp.asarray(p, rdtype)
             rhs = jnp.where(th_free > 0, pj, 0.0)
-            theta0 = jax.scipy.linalg.lu_solve(lu, rhs)
+            theta0 = jax.scipy.linalg.lu_solve(lu_f, rhs)
             # Masked update columns a_k = e_f·mask_f − e_t·mask_t for
             # the REQUESTED branches only ([n, k] — never [n, m]), and
             # their base-factor solves in one multi-RHS pass.
@@ -150,7 +156,7 @@ def make_dc_solver(sys: BusSystem, dtype=None, lu=None) -> DcSolver:
                 .at[f_idx[ks], lanes].add(mask_f[ks])
                 .at[t_idx[ks], lanes].add(-mask_t[ks])
             )
-            z = jax.scipy.linalg.lu_solve(lu, a_cols)  # [n, k]
+            z = jax.scipy.linalg.lu_solve(lu_f, a_cols)  # [n, k]
             wk = w[ks]
             a_dot_th = theta0[f_idx[ks]] * mask_f[ks] - theta0[t_idx[ks]] * mask_t[ks]
             a_dot_z = (
@@ -176,6 +182,18 @@ def make_dc_solver(sys: BusSystem, dtype=None, lu=None) -> DcSolver:
                 theta=theta_k, flows=flows, severity=severity,
                 islanded=islanded,
             )
+
+    def screen_outages(outages, p=None) -> DcScreenResult:
+        return _screen_impl(
+            lu, jnp.asarray(outages),
+            p0 if p is None else jnp.asarray(p, rdtype),
+        )
+
+    # gridprobe seam: the jitted operators, LU pair as an argument.
+    solve.probe_target = lambda: (_solve_impl, (lu, p0))
+    screen_outages.probe_target = lambda: (
+        _screen_impl, (lu, jnp.arange(min(4, m)), p0)
+    )
 
     return DcSolver(
         solve=solve, screen_outages=screen_outages, n_bus=n, n_branch=m

@@ -459,12 +459,22 @@ def make_krylov_solver(
 
     # Tracing (core.tracing): pf.solve spans, first call tagged as the
     # jit-compile hit; a no-op while tracing is disabled.
-    return (
-        tracing.traced_solver("krylov", solve,
-                              tags={"pf_backend": "matrix_free"}),
-        tracing.traced_solver("krylov", solve_fixed,
-                              tags={"pf_backend": "matrix_free"}),
-    )
+    solve_w = tracing.traced_solver("krylov", solve,
+                                    tags={"pf_backend": "matrix_free"})
+    fixed_w = tracing.traced_solver("krylov", solve_fixed,
+                                    tags={"pf_backend": "matrix_free"})
+
+    # gridprobe seam: the inner jitted program with the preconditioner
+    # pair as runtime ARGUMENTS — tracing the outer closure instead
+    # would fold the pair into trace-time constants and misreport
+    # exactly the capture hazard this module's arg-threading avoids.
+    def _probe_target():
+        x0, ps0, qs0 = _prep(None, None, None, None)
+        return _solve_impl, (_bp_inv, _bq_inv, x0, ps0, qs0,
+                             jnp.ones(sys.n_branch, rdtype))
+
+    solve_w.probe_target = _probe_target
+    return (solve_w, fixed_w)
 
 
 def _mesh_batched_krylov(sys, impl, bp_inv, bq_inv, v_free, v_set,
@@ -613,4 +623,7 @@ def true_mismatch(sys: BusSystem, result: KrylovResult, status=None) -> float:
     v_free = sys.bus_type == PQ
     fp = np.where(th_free, p - sys.p_inj, 0.0)
     fq = np.where(v_free, q - sys.q_inj, 0.0)
-    return float(max(np.max(np.abs(fp)), np.max(np.abs(fq))))
+    # np.float64 (a float subclass — callers unchanged) so the gridprobe
+    # F64_SURFACES evaluation check has dtype evidence of the oracle's
+    # double-precision computation.
+    return np.float64(max(np.max(np.abs(fp)), np.max(np.abs(fq))))

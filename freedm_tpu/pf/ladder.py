@@ -251,10 +251,14 @@ def make_ladder_solver(
     # wall time on the profiling registry; both a no-op while disabled.
     # Calls under vmap/jit (the serve VVC engine, QSTS feeder chunks)
     # record nothing.
-    return (
-        tracing.traced_solver("ladder", solve),
-        tracing.traced_solver("ladder", solve_fixed),
+    solve_w = tracing.traced_solver("ladder", solve)
+    fixed_w = tracing.traced_solver("ladder", solve_fixed)
+
+    # gridprobe seam: the jitted sweep with the feeder's own loads.
+    solve_w.probe_target = lambda: (
+        _solve, (cplx.as_c(feeder.s_load, dtype=rdtype), None)
     )
+    return (solve_w, fixed_w)
 
 
 def _mesh_batched_ladder(impl, rdtype, mesh, batch_spec):

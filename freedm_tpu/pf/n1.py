@@ -477,4 +477,6 @@ def _make_smw_n1_screen(
         with jax.default_matmul_precision("highest"):
             return jax.vmap(_solve_lane)(jnp.asarray(outages))
 
+    # gridprobe seam: the screen program itself at a small lane count.
+    screen.probe_target = lambda: (screen, (jnp.arange(min(4, m)),))
     return screen

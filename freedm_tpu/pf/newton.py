@@ -234,8 +234,6 @@ def make_newton_solver(
         f_q = jnp.where(v_free > 0, q_calc - q_sched, v - v_set)
         return jnp.concatenate([f_p, f_q])
 
-    eye2n = jnp.eye(2 * n)
-
     def _newton_step(x, y, p_sched, q_sched):
         """One NR update with the hand-assembled polar Jacobian.
 
@@ -270,7 +268,12 @@ def make_newton_solver(
         j2 = -c_mat + jnp.diag(p_calc)
         ll = a_mat / v[None, :] + jnp.diag(q_calc / v)
         jac = jnp.block([[h, nn], [j2, ll]])
-        jac = jnp.where(free[:, None] > 0, jac, eye2n.astype(jac.dtype))
+        # The pinned-row identity is built IN-PROGRAM (iota, not a
+        # closure constant): a captured jnp.eye(2n) would fold 8·(2n)²
+        # bytes into every compiled program — 3.2 GB at 10k buses
+        # (gridprobe GP003 pins this).
+        jac = jnp.where(free[:, None] > 0, jac,
+                        jnp.eye(2 * n, dtype=jac.dtype))
         dx = jnp.linalg.solve(jac, -f)
         return x + dx, jnp.max(jnp.abs(f * free))
 
@@ -349,11 +352,19 @@ def make_newton_solver(
     # ``pf.solve`` span, the first one tagged with its jit-compile hit
     # and every one tagged with the Jacobian backend.  Disabled tracing
     # is one attribute check per call.
-    return (
-        tracing.traced_solver("newton", solve, tags={"pf_backend": "dense"}),
-        tracing.traced_solver("newton", solve_fixed,
-                              tags={"pf_backend": "dense"}),
-    )
+    solve_w = tracing.traced_solver("newton", solve,
+                                    tags={"pf_backend": "dense"})
+    fixed_w = tracing.traced_solver("newton", solve_fixed,
+                                    tags={"pf_backend": "dense"})
+
+    # gridprobe seam (tools/ir_rules/registry.py): the actual jitted
+    # program plus flat-start example arguments, so the IR auditor
+    # traces what production runs — not a re-derivation of it.
+    def _probe_target():
+        return solve, (p_sched0, q_sched0, None, None, None)
+
+    solve_w.probe_target = _probe_target
+    return (solve_w, fixed_w)
 
 
 def record_result(result: NewtonResult, solver: str = "newton") -> None:

@@ -463,7 +463,15 @@ def make_sparse_newton_solver(
 
     # pf.solve spans carry pf_backend=sparse so trace reports attribute
     # dense vs sparse time; first call still tags the jit-compile hit.
-    return (
-        tracing.traced_solver("newton", solve, tags=tags),
-        tracing.traced_solver("newton", solve_fixed, tags=tags),
-    )
+    solve_w = tracing.traced_solver("newton", solve, tags=tags)
+    fixed_w = tracing.traced_solver("newton", solve_fixed, tags=tags)
+
+    # gridprobe seam: the inner jitted program with the preconditioner
+    # pair as runtime ARGUMENTS (same rationale as pf/krylov.py — the
+    # outer closure would misreport the pair as captured constants).
+    def _probe_target():
+        x0, ps0, qs0, st0 = _prep(None, None, None, None, None)
+        return _solve_impl, (_bp_inv, _bq_inv, x0, ps0, qs0, st0)
+
+    solve_w.probe_target = _probe_target
+    return (solve_w, fixed_w)

@@ -1,0 +1,621 @@
+"""gridprobe: jaxpr/HLO-level program auditor for freedm_tpu.
+
+gridlint (PR 8) enforces invariants on the *source text*; the contracts
+gating the next perf work — which dtypes actually flow through each
+traced program, what each program captures as constants, which buffers
+could be donated, and how many distinct programs XLA compiles — live in
+the *compiler IR*.  gridprobe traces every entrypoint declared in
+:data:`freedm_tpu.tools.ir_rules.registry.PROGRAM_REGISTRY` to jaxpr
+(and lowered HLO for cost analysis) on the CPU backend with x64
+enabled, runs the IR rules (GP001 dtype-flow, GP002 host-transfer,
+GP003 constant-capture, GP004 donation-readiness) over each, checks the
+host-side float64 oracle surfaces by evaluation, and diffs a **program
+inventory** — per-program arg/result dtypes+shapes, primitive counts,
+and XLA cost-analysis FLOP/byte estimates — against the checked-in
+``freedm_tpu/tools/ir_inventory.json`` (GP006), so a silent
+program-count or FLOP blowup fails the build with a readable delta.
+A registry entry that no longer builds is itself a finding (GP005).
+
+Usage::
+
+    python -m freedm_tpu.tools.gridprobe                  # audit + diff
+    python -m freedm_tpu.tools.gridprobe --write-inventory
+    python -m freedm_tpu.tools.gridprobe --format=json
+    python -m freedm_tpu.tools.gridprobe --list-programs
+
+Exit codes: 0 clean, 1 findings, 2 bad invocation/internal error —
+the same contract as gridlint.  Suppression is declaration, not
+comments: a program opts into a mixed-precision boundary
+(``allow_dtypes`` + ``boundary_reason``) or out of a rule
+(``suppress``) in the registry, where review sees it.  Policy:
+docs/static_analysis.md ("IR auditing").
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# The probe is CPU-only by design (deterministic inventory, no device
+# needed); pin the platform before anything imports jax.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from freedm_tpu.tools.ir_rules import all_ir_rules
+from freedm_tpu.tools.ir_rules.base import (
+    F64Surface,
+    Finding,
+    ProgramSpec,
+    TracedProgram,
+    aval_str,
+)
+
+INVENTORY_VERSION = 1
+
+
+def repo_root() -> Path:
+    """The repo root the default inventory path resolves against (the
+    parent of the installed ``freedm_tpu`` package)."""
+    import freedm_tpu
+
+    return Path(freedm_tpu.__file__).resolve().parent.parent
+
+
+def config_defaults(config_path: Optional[str] = None
+                    ) -> Tuple[str, float, float]:
+    """(inventory path, const_mb, flops_tol) from GlobalConfig — the
+    ``probe-*`` config keys, so embedders and the CLI agree.  Pass a
+    ``freedm.cfg`` path (gridprobe's ``--config``) to honor an
+    operator's configured values; otherwise the dataclass defaults."""
+    from freedm_tpu.core.config import GlobalConfig
+
+    cfg = (GlobalConfig.from_file(config_path) if config_path
+           else GlobalConfig())
+    return cfg.probe_inventory, cfg.probe_const_mb, cfg.probe_flops_tol
+
+
+class ProbeResult:
+    """Findings plus the traced programs and the freshly built
+    inventory (the ``artifacts`` analogue of gridlint's LintResult)."""
+
+    def __init__(self, findings: List[Finding],
+                 programs: List[TracedProgram],
+                 inventory: dict):
+        self.findings = findings
+        self.programs = programs
+        self.inventory = inventory
+
+    @property
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "findings": [f.to_dict() for f in self.findings],
+            "stats": {
+                "programs": len(self.programs),
+                "findings_total": len(self.findings),
+                "findings_by_rule": self.by_rule,
+                "inventory": self.inventory,
+            },
+        }
+
+
+# -- registry loading --------------------------------------------------------
+
+def load_registry(module: Optional[str] = None,
+                  registry_file: Optional[str] = None):
+    """(PROGRAM_REGISTRY, F64_SURFACES) from the default module, a
+    dotted module name, or a plain python file (fixture tests)."""
+    if registry_file:
+        spec = importlib.util.spec_from_file_location(
+            "_gridprobe_registry", registry_file
+        )
+        if spec is None or spec.loader is None:
+            raise RuntimeError(f"cannot load registry file {registry_file!r}")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(
+            module or "freedm_tpu.tools.ir_rules.registry"
+        )
+    programs = list(getattr(mod, "PROGRAM_REGISTRY", ()))
+    surfaces = list(getattr(mod, "F64_SURFACES", ()))
+    return programs, surfaces
+
+
+# -- tracing -----------------------------------------------------------------
+
+def trace_spec(spec: ProgramSpec) -> TracedProgram:
+    """Build and trace one registry entry (jaxpr + lowered cost).
+
+    One trace serves both views: ``jit(fn).trace()`` yields the closed
+    jaxpr for the rules/inventory AND the lowering for cost analysis —
+    tracing is the dominant probe cost, so paying it once per program
+    roughly halves every ``make check``.  Falls back to the two-pass
+    ``make_jaxpr`` + ``lower`` on jax versions without ``.trace``
+    (structurally identical output, verified for jit-of-jit too).
+    """
+    import jax
+
+    fn, args = spec.build()
+    traced = None
+    try:
+        traced = jax.jit(fn).trace(*args)
+        closed = traced.jaxpr
+    except AttributeError:
+        closed = jax.make_jaxpr(fn)(*args)
+    lowered = None
+    cost: dict = {}
+    try:
+        lowered = (traced.lower() if traced is not None
+                   else jax.jit(fn).lower(*args))
+        raw = lowered.cost_analysis()
+        if isinstance(raw, (list, tuple)):  # older jax: one per computation
+            raw = raw[0] if raw else {}
+        if isinstance(raw, dict):
+            cost = {
+                "flops": float(raw.get("flops", -1.0)),
+                "bytes_accessed": float(raw.get("bytes accessed", -1.0)),
+            }
+    except Exception:
+        # Cost analysis is best-effort (backend-dependent); the jaxpr
+        # rules and the structural inventory never depend on it.
+        cost = {"flops": -1.0, "bytes_accessed": -1.0}
+    return TracedProgram(spec, closed, lowered=lowered, cost=cost)
+
+
+def _float_leaves(value):
+    """Floating numpy leaves of a host-oracle output (tuples walked).
+    Builtin python floats are deliberately NOT leaves: they carry no
+    dtype evidence of the internal computation, so a surface returning
+    only builtins is vacuous — the engine flags it (GP005) and the
+    oracle must return numpy float64 instead (``np.float64`` is a
+    ``float`` subclass, so callers are unaffected)."""
+    import numpy as np
+
+    if isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _float_leaves(v)
+    elif isinstance(value, np.ndarray) and np.issubdtype(
+            value.dtype, np.floating):
+        yield value
+    elif isinstance(value, np.floating):
+        yield value
+
+
+def check_surface(surface: F64Surface) -> List[Finding]:
+    """Evaluate one host f64 oracle surface: every floating output leaf
+    must be float64 (GP001 at the value level — numpy oracles have no
+    jaxpr to walk)."""
+    import numpy as np
+
+    try:
+        fn, args = surface.build()
+        out = fn(*args)
+    except Exception as e:
+        return [Finding(
+            "GP005", surface.where, 1, 0,
+            f"[{surface.name}] f64 surface failed to build/evaluate: {e!r}",
+            "fix or re-register the surface in ir_rules/registry.py",
+        )]
+    findings = []
+    leaves = list(_float_leaves(out))
+    if not leaves:
+        # A surface whose output carries no dtype evidence cannot be
+        # checked — an unfalsifiable check must fail loudly, not pass.
+        return [Finding(
+            "GP005", surface.where, 1, 0,
+            f"[{surface.name}] f64 surface returned no numpy floating "
+            f"leaves to check (builtin float is dtype-blind)",
+            "return numpy float64 from the oracle (np.float64 is a "
+            "float subclass — callers are unaffected)",
+        )]
+    for leaf in leaves:
+        if leaf.dtype != np.float64:
+            findings.append(Finding(
+                "GP001", surface.where, 1, 0,
+                f"[{surface.name}] host float64 oracle surface returns "
+                f"{leaf.dtype.name} (silent demotion)",
+                "the oracle must compute and return numpy float64 "
+                "regardless of input dtypes",
+            ))
+    return findings
+
+
+# -- inventory ---------------------------------------------------------------
+
+def _sig6(v: float) -> float:
+    """6-significant-digit rounding: keeps the checked-in file stable
+    against sub-ulp cost-model noise without hiding real drift."""
+    return float(f"{float(v):.6g}")
+
+
+def build_inventory(programs: List[TracedProgram],
+                    surfaces_out: Dict[str, List[str]]) -> dict:
+    import jax
+
+    progs = {}
+    for tp in programs:
+        prims = tp.primitive_counts()
+        progs[tp.spec.name] = {
+            "where": tp.spec.where,
+            "args": [aval_str(a) for a in tp.in_avals],
+            "results": [aval_str(a) for a in tp.out_avals],
+            "eqns": sum(prims.values()),
+            "primitives": dict(sorted(prims.items())),
+            "consts_bytes": tp.consts_bytes(),
+            "flops": _sig6(tp.cost.get("flops", -1.0)),
+            "bytes_accessed": _sig6(tp.cost.get("bytes_accessed", -1.0)),
+            "donation_candidates": [
+                list(c) for c in tp.donation_candidates()
+            ],
+        }
+    return {
+        "version": INVENTORY_VERSION,
+        "jax": jax.__version__,  # recorded for humans, never compared
+        "x64": bool(jax.config.jax_enable_x64),
+        "programs": dict(sorted(progs.items())),
+        "f64_surfaces": dict(sorted(surfaces_out.items())),
+    }
+
+
+#: Absolute slack per scalar column, applied BEFORE the relative
+#: tolerance: a zero-baseline column (e.g. a program with no consts)
+#: must not turn an 8-byte lowering change into infinite drift — the
+#: jax-version noise the relative tolerance is documented to absorb.
+_ABS_SLACK = {
+    "eqns": 16.0,
+    "consts_bytes": 4096.0,
+    "flops": 4096.0,
+    "bytes_accessed": 4096.0,
+}
+
+
+def _rel_drift(cur: float, rec: float, slack: float) -> Optional[float]:
+    """Relative drift of two scalar columns; None when not comparable
+    (either side missing/negative — cost analysis unavailable) or when
+    the absolute change is within the column's slack."""
+    if cur is None or rec is None or cur < 0 or rec < 0:
+        return None
+    if abs(cur - rec) <= slack:
+        return None
+    if rec == 0:
+        return float("inf")
+    return abs(cur - rec) / abs(rec)
+
+
+def diff_inventory(current: dict, recorded: dict, flops_tol: float,
+                   inventory_rel: str) -> List[Finding]:
+    """GP006: readable findings for every way the traced program set
+    drifted from the checked-in inventory."""
+
+    def f(message: str, hint: str = "") -> Finding:
+        return Finding("GP006", inventory_rel, 1, 0, message, hint or (
+            "if the change is intended, regenerate with "
+            "`python -m freedm_tpu.tools.gridprobe --write-inventory` "
+            "and commit the diff"
+        ))
+
+    findings: List[Finding] = []
+    cur_p = current.get("programs", {})
+    rec_p = recorded.get("programs", {})
+    for name in sorted(set(rec_p) - set(cur_p)):
+        findings.append(f(
+            f"program `{name}` is in the inventory but no longer traced "
+            f"(registry entry removed/renamed?)"
+        ))
+    for name in sorted(set(cur_p) - set(rec_p)):
+        findings.append(f(
+            f"program `{name}` is traced but not in the inventory "
+            f"(new program / new shape bucket?)"
+        ))
+    for name in sorted(set(cur_p) & set(rec_p)):
+        cur, rec = cur_p[name], rec_p[name]
+        for col in ("args", "results"):
+            if cur[col] != rec[col]:
+                findings.append(f(
+                    f"program `{name}` {col} drifted: "
+                    f"{rec[col]} -> {cur[col]}"
+                ))
+        for col in ("eqns", "consts_bytes", "flops", "bytes_accessed"):
+            drift = _rel_drift(cur.get(col), rec.get(col),
+                               _ABS_SLACK.get(col, 0.0))
+            if drift is not None and drift > flops_tol:
+                findings.append(f(
+                    f"program `{name}` {col} drifted "
+                    f"{rec.get(col)} -> {cur.get(col)} "
+                    f"({drift:+.0%} vs the {flops_tol:.0%} tolerance)"
+                ))
+    cur_s = current.get("f64_surfaces", {})
+    rec_s = recorded.get("f64_surfaces", {})
+    for name in sorted(set(rec_s) - set(cur_s)):
+        findings.append(f(f"f64 surface `{name}` no longer registered"))
+    for name in sorted(set(cur_s) - set(rec_s)):
+        findings.append(f(f"f64 surface `{name}` not in the inventory"))
+    return findings
+
+
+# -- the probe ---------------------------------------------------------------
+
+def run_probe(
+    registry: Optional[str] = None,
+    registry_file: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+    const_mb: Optional[float] = None,
+    flops_tol: Optional[float] = None,
+    inventory_path: Optional[str] = None,
+    inventory_mode: str = "check",  # "check" | "write" | "skip"
+    config_path: Optional[str] = None,
+) -> ProbeResult:
+    """Programmatic entry: trace the registry, run the IR rules, and
+    (by default) diff the checked-in inventory."""
+    import jax
+
+    # Deterministic inventory contract: CPU backend + x64, regardless
+    # of how the host process was launched.  The env pin at module
+    # import handles fresh processes; environments whose interpreter
+    # start-up pre-imports jax with a device platform need the config
+    # route (harmless when the backend is already CPU; best-effort when
+    # an embedder already initialized a device backend).
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    jax.config.update("jax_enable_x64", True)
+
+    cfg_inv, cfg_const, cfg_tol = config_defaults(config_path)
+    const_mb = cfg_const if const_mb is None else const_mb
+    flops_tol = cfg_tol if flops_tol is None else flops_tol
+    inv_rel = inventory_path or cfg_inv
+    inv_path = Path(inv_rel)
+    if not inv_path.is_absolute():
+        inv_path = repo_root() / inv_path
+
+    specs, surfaces = load_registry(registry, registry_file)
+    findings: List[Finding] = []
+    programs: List[TracedProgram] = []
+    for spec in specs:
+        if not (repo_root() / spec.where).exists():
+            findings.append(Finding(
+                "GP005", spec.where, 1, 0,
+                f"[{spec.name}] registry entry points at a module that "
+                f"does not exist",
+                "fix the spec's `where` path in ir_rules/registry.py",
+            ))
+        try:
+            programs.append(trace_spec(spec))
+        except Exception as e:
+            findings.append(Finding(
+                "GP005", spec.where, 1, 0,
+                f"[{spec.name}] registry entry failed to build/trace: "
+                f"{type(e).__name__}: {e}",
+                "the registered entrypoint was renamed or its build "
+                "broke — fix the entry (orphaned entries are findings "
+                "by design, like GL002's HOT_PATHS)",
+            ))
+        if (spec.allow_dtypes and not spec.boundary_reason):
+            findings.append(Finding(
+                "GP005", spec.where, 1, 0,
+                f"[{spec.name}] declares a mixed-precision boundary "
+                f"without a boundary_reason",
+                "the declaration is the suppression — say why "
+                "(docs/static_analysis.md, declared-boundary policy)",
+            ))
+
+    selected = all_ir_rules(const_mb=const_mb)
+    if rules:
+        wanted = set(rules)
+        selected = [r for r in selected if r.id in wanted]
+    for tp in programs:
+        for rule in selected:
+            if rule.id in tp.spec.suppress:
+                continue
+            findings.extend(rule.check(tp))
+
+    surfaces_out: Dict[str, List[str]] = {}
+    # Surfaces are evaluated whenever GP001/GP005 run OR the inventory
+    # is in play (their registered set is part of the recorded state —
+    # a --rules subset must not masquerade as a surface removal).
+    if (rules is None or {"GP001", "GP005"} & set(rules)
+            or inventory_mode in ("check", "write")):
+        for surface in surfaces:
+            sfs = check_surface(surface)
+            findings.extend(sfs)
+            if not any(x.rule == "GP005" for x in sfs):
+                surfaces_out[surface.name] = ["checked-f64"]
+
+    inventory = build_inventory(programs, surfaces_out)
+    if inventory_mode == "write":
+        inv_path.parent.mkdir(parents=True, exist_ok=True)
+        inv_path.write_text(
+            json.dumps(inventory, indent=1, sort_keys=True) + "\n",
+            encoding="utf-8",
+        )
+    elif inventory_mode == "check":
+        try:
+            rel = inv_path.relative_to(repo_root()).as_posix()
+        except ValueError:
+            rel = str(inv_path)
+        if not inv_path.exists():
+            findings.append(Finding(
+                "GP006", rel, 1, 0,
+                "inventory file does not exist",
+                "generate it with `python -m freedm_tpu.tools.gridprobe "
+                "--write-inventory` and commit it",
+            ))
+        else:
+            try:
+                recorded = json.loads(inv_path.read_text(encoding="utf-8"))
+            except ValueError as e:
+                findings.append(Finding(
+                    "GP006", rel, 1, 0,
+                    f"inventory file is not valid JSON: {e}",
+                    "regenerate with --write-inventory",
+                ))
+            else:
+                findings.extend(
+                    diff_inventory(inventory, recorded, flops_tol, rel)
+                )
+
+    # ``--rules`` scopes EVERY finding — per-program rules, surface
+    # checks, and the engine-level GP005/GP006 — so an iterating
+    # developer gets exactly the signal they asked for (default runs
+    # pass no subset and see everything).
+    if rules:
+        wanted_ids = set(rules)
+        findings = [f for f in findings if f.rule in wanted_ids]
+    findings.sort(key=Finding.sort_key)
+    return ProbeResult(findings, programs, inventory)
+
+
+# -- output / CLI ------------------------------------------------------------
+
+def record_metrics(result: ProbeResult) -> None:
+    """``gridprobe_findings_total{rule=...}`` on the process registry,
+    mirroring gridlint's contract."""
+    try:
+        from freedm_tpu.core import metrics as obs
+    except Exception:
+        return
+    for rule_id, count in sorted(result.by_rule.items()):
+        obs.GRIDPROBE_FINDINGS.labels(rule_id).inc(count)
+
+
+def render_text(result: ProbeResult) -> str:
+    lines = []
+    for f in result.findings:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}")
+        if f.hint:
+            lines.append(f"    hint: {f.hint}")
+    by_rule = ", ".join(f"{k}={v}" for k, v in sorted(result.by_rule.items()))
+    if result.findings:
+        lines.append(
+            f"gridprobe: {len(result.findings)} finding(s) over "
+            f"{len(result.programs)} program(s) [{by_rule}]"
+        )
+    else:
+        lines.append(
+            f"gridprobe: clean ({len(result.programs)} program(s) traced)"
+        )
+    return "\n".join(lines)
+
+
+def render_github(result: ProbeResult) -> str:
+    lines = []
+    for f in result.findings:
+        msg = f.message + (f" (hint: {f.hint})" if f.hint else "")
+        msg = msg.replace("%", "%25").replace("\n", "%0A")
+        lines.append(
+            f"::error file={f.path},line={f.line},col={f.col},"
+            f"title={f.rule}::{msg}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gridprobe",
+        description="jaxpr/HLO-level program auditor (GP001-GP006) "
+                    "with a CI-diffed program inventory",
+    )
+    ap.add_argument("-c", "--config", default=None, metavar="PATH",
+                    help="freedm.cfg to read the probe-inventory / "
+                         "probe-const-mb / probe-flops-tol keys from "
+                         "(flags below override; default: built-in "
+                         "defaults)")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text", help="output format (default text)")
+    ap.add_argument("--rules", default=None, metavar="IDS",
+                    help="comma-separated rule ids to run (default all)")
+    ap.add_argument("--registry", default=None, metavar="MODULE",
+                    help="dotted registry module (default "
+                         "freedm_tpu.tools.ir_rules.registry)")
+    ap.add_argument("--registry-file", default=None, metavar="PATH",
+                    help="plain python registry file (fixture tests)")
+    ap.add_argument("--inventory", default=None, metavar="PATH",
+                    help="inventory JSON path (default: the "
+                         "probe-inventory config key, relative to the "
+                         "repo root)")
+    ap.add_argument("--write-inventory", action="store_true",
+                    help="regenerate the inventory file instead of "
+                         "diffing it (commit the result)")
+    ap.add_argument("--no-inventory", action="store_true",
+                    help="skip the inventory diff (rules only)")
+    ap.add_argument("--const-mb", type=float, default=None, metavar="MB",
+                    help="GP003 capture threshold (default: the "
+                         "probe-const-mb config key)")
+    ap.add_argument("--flops-tol", type=float, default=None, metavar="R",
+                    help="relative drift tolerance for the inventory's "
+                         "scalar columns (default: the probe-flops-tol "
+                         "config key)")
+    ap.add_argument("--list-programs", action="store_true",
+                    help="print the registered program names and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_programs:
+        try:
+            specs, surfaces = load_registry(args.registry,
+                                            args.registry_file)
+        except Exception as e:
+            print(f"gridprobe: cannot load registry: {e!r}",
+                  file=sys.stderr)
+            return 2
+        for spec in specs:
+            tags = []
+            if spec.f64:
+                tags.append("f64")
+            if spec.allow_dtypes:
+                tags.append("boundary:" + ",".join(sorted(spec.allow_dtypes)))
+            print(f"{spec.name}  ({spec.where})"
+                  + (f"  [{' '.join(tags)}]" if tags else ""))
+        for surface in surfaces:
+            print(f"{surface.name}  ({surface.where})  [f64-surface]")
+        return 0
+
+    mode = ("write" if args.write_inventory
+            else "skip" if args.no_inventory else "check")
+    rules = ([r.strip() for r in args.rules.split(",")]
+             if args.rules else None)
+    try:
+        result = run_probe(
+            registry=args.registry,
+            registry_file=args.registry_file,
+            rules=rules,
+            const_mb=args.const_mb,
+            flops_tol=args.flops_tol,
+            inventory_path=args.inventory,
+            inventory_mode=mode,
+            config_path=args.config,
+        )
+    except Exception as e:  # internal error must not masquerade as clean
+        print(f"gridprobe: internal error: {e!r}", file=sys.stderr)
+        return 2
+    record_metrics(result)
+    if args.format == "json":
+        print(json.dumps(result.to_dict(), indent=2, sort_keys=True))
+    elif args.format == "github":
+        out = render_github(result)
+        if out:
+            print(out)
+        print(render_text(result), file=sys.stderr)
+    else:
+        print(render_text(result))
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. `gridprobe ... | head` — not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

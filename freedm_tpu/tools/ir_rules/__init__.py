@@ -1,0 +1,39 @@
+"""gridprobe IR-rule catalogue.
+
+| ID    | Invariant                                                        |
+|-------|------------------------------------------------------------------|
+| GP001 | dtype flow: f64 surfaces stay f64; bf16/f16 only inside declared boundaries |
+| GP002 | host transfer: no callback-shaped primitives inside traced programs |
+| GP003 | constant capture: no closure constant >= the size threshold      |
+| GP004 | donation readiness: declared donatable args have aliasable results |
+| GP005 | registry orphan: every registry entry builds and traces (engine-level) |
+| GP006 | inventory drift: traced program set matches tools/ir_inventory.json (engine-level) |
+
+GP005/GP006 are emitted by the engine (:mod:`freedm_tpu.tools.gridprobe`)
+itself — they are properties of the registry and the checked-in
+inventory, not of any one traced program.  Adding a rule mirrors
+gridlint: subclass :class:`~freedm_tpu.tools.ir_rules.base.IrRule`,
+implement ``check(program)``, append it here, document it in
+docs/static_analysis.md, and burn down what it finds before merging.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from freedm_tpu.tools.ir_rules.base import IrRule
+
+
+def all_ir_rules(const_mb: float = 0.25) -> List[IrRule]:
+    """Fresh rule instances, in reporting order."""
+    from freedm_tpu.tools.ir_rules.constant_capture import ConstantCapture
+    from freedm_tpu.tools.ir_rules.donation import DonationReadiness
+    from freedm_tpu.tools.ir_rules.dtype_flow import DtypeFlow
+    from freedm_tpu.tools.ir_rules.host_transfer import HostTransfer
+
+    return [
+        DtypeFlow(),
+        HostTransfer(),
+        ConstantCapture(const_mb=const_mb),
+        DonationReadiness(),
+    ]

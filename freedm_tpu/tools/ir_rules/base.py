@@ -1,0 +1,229 @@
+"""Shared engine types for gridprobe: program specs, traced IR, rules.
+
+gridlint (:mod:`freedm_tpu.tools.lint_rules`) audits the *source text*;
+gridprobe audits the *compiler IR* — the jaxpr and lowered HLO of every
+registered jitted entrypoint.  The shapes here mirror the lint engine
+deliberately (``Finding`` is the same class, rules subclass a base with
+``id``/``name``/``hint``/``check``) so the two tools share one UX, but
+the unit of analysis is a traced **program**, not a parsed file.
+
+A :class:`ProgramSpec` declares one entrypoint: a name, the source
+module the findings point at, a zero-argument ``build`` returning
+``(fn, args)`` to trace, and the program's *declared contracts* —
+whether it is a float64 surface (GP001), which low-precision dtypes it
+is allowed to touch and why (the declared mixed-precision boundary),
+and which argument indices it declares donation-ready (GP004).  The
+declarations ARE the suppression mechanism: gridprobe has no line-level
+disables because IR findings have no source line — a program opts out
+of a rule by declaring the boundary, visibly, in the registry.
+
+Everything traces on the CPU backend with x64 enabled, so the audited
+dtype flow is the float64 contract flow the solver tests and the serve
+cache's residual oracles rely on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, Tuple
+
+from freedm_tpu.tools.lint_rules.base import Finding
+
+#: Reduced-precision float dtype names GP001 polices.
+LOW_PRECISION_FLOATS = ("bfloat16", "float16")
+
+#: Dtypes a float64 contract surface may be silently demoted to.
+DEMOTION_TARGETS = ("float32", "bfloat16", "float16")
+
+
+@dataclass(frozen=True)
+class ProgramSpec:
+    """One registered jitted entrypoint and its declared contracts."""
+
+    #: Inventory key, e.g. ``"pf/newton/dense"``.
+    name: str
+    #: Repo-relative path findings for this program point at.
+    where: str
+    #: Zero-arg builder returning ``(fn, args)``: a jax-traceable
+    #: callable plus the positional example arguments to trace it with.
+    #: May raise — a failed build is a GP005 registry-orphan finding.
+    build: Callable[[], Tuple[Callable, tuple]]
+    #: Declared float64 contract surface: every float that flows through
+    #: must stay f64 (GP001 flags demotions and non-f64 float results).
+    f64: bool = False
+    #: Declared mixed-precision boundary: low-precision dtype names
+    #: (``"bfloat16"``...) this program is ALLOWED to touch.  Requires
+    #: ``boundary_reason`` — the declaration is the visible suppression.
+    allow_dtypes: FrozenSet[str] = frozenset()
+    #: Why the boundary exists (e.g. "preconditioner streams bf16").
+    boundary_reason: str = ""
+    #: Argument indices declared donation-ready: each must have an
+    #: aliasable (same dtype+shape) result buffer (GP004).
+    donatable: Tuple[int, ...] = ()
+    #: Rule ids this program opts out of entirely, mapped to the reason
+    #: (the registry-level analogue of a gridlint disable comment).
+    suppress: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class F64Surface:
+    """A host-side float64 oracle surface (numpy, not traceable).
+
+    GP001 cannot walk a jaxpr for these, so it *evaluates* them and
+    asserts every floating output leaf is float64 — the same "no silent
+    demotion" contract, checked at the value level.
+    """
+
+    name: str
+    where: str
+    build: Callable[[], Tuple[Callable, tuple]]
+
+
+def _iter_nested_jaxprs(value) -> List[object]:
+    """Jaxpr objects reachable from one eqn param value (ClosedJaxpr,
+    bare Jaxpr, or tuples/lists of either — cond branches etc.)."""
+    out: List[object] = []
+    if hasattr(value, "jaxpr") and hasattr(value, "consts"):
+        out.append(value)  # ClosedJaxpr
+    elif hasattr(value, "eqns") and hasattr(value, "invars"):
+        out.append(value)  # bare Jaxpr
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            out.extend(_iter_nested_jaxprs(v))
+    return out
+
+
+def walk_eqns(closed_jaxpr):
+    """Every eqn in a closed jaxpr, recursing through pjit bodies,
+    scan/while/cond sub-jaxprs, and custom_jvp wrappers."""
+    stack = [closed_jaxpr.jaxpr]
+    seen = set()
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        for eqn in j.eqns:
+            yield eqn
+            for v in eqn.params.values():
+                for sub in _iter_nested_jaxprs(v):
+                    stack.append(getattr(sub, "jaxpr", sub))
+
+
+def collect_consts(closed_jaxpr) -> List[object]:
+    """All constants baked into a traced program: the top-level closed
+    jaxpr's consts plus those of every nested ClosedJaxpr (a jit-of-jit
+    trace hoists differently across jax versions — walk both)."""
+    consts: List[object] = []
+    seen_ids = set()
+
+    def _add(cs):
+        for c in cs:
+            if id(c) not in seen_ids:
+                seen_ids.add(id(c))
+                consts.append(c)
+
+    _add(closed_jaxpr.consts)
+    stack = [closed_jaxpr.jaxpr]
+    seen = set()
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        for eqn in j.eqns:
+            for v in eqn.params.values():
+                for sub in _iter_nested_jaxprs(v):
+                    if hasattr(sub, "consts"):
+                        _add(sub.consts)
+                    stack.append(getattr(sub, "jaxpr", sub))
+    return consts
+
+
+def aval_str(aval) -> str:
+    """Deterministic short form of an abstract value: ``f64[30,2]``."""
+    try:
+        return aval.str_short()
+    except Exception:
+        return str(aval)
+
+
+def var_dtype_name(v) -> Optional[str]:
+    """Dtype name of a jaxpr var/literal's aval, None for non-arrays."""
+    aval = getattr(v, "aval", None)
+    dt = getattr(aval, "dtype", None)
+    return getattr(dt, "name", None)
+
+
+class TracedProgram:
+    """One spec's traced IR plus the derived views the rules consume."""
+
+    def __init__(self, spec: ProgramSpec, closed_jaxpr, lowered=None,
+                 cost: Optional[dict] = None):
+        self.spec = spec
+        self.closed_jaxpr = closed_jaxpr
+        self.lowered = lowered
+        self.cost = cost or {}
+        self.in_avals = list(closed_jaxpr.in_avals)
+        self.out_avals = list(closed_jaxpr.out_avals)
+        self.consts = collect_consts(closed_jaxpr)
+
+    def eqns(self):
+        return walk_eqns(self.closed_jaxpr)
+
+    def primitive_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for eqn in self.eqns():
+            name = eqn.primitive.name
+            out[name] = out.get(name, 0) + 1
+        return out
+
+    def consts_bytes(self) -> int:
+        total = 0
+        for c in self.consts:
+            nbytes = getattr(c, "nbytes", None)
+            if nbytes is None:
+                size = getattr(c, "size", 0)
+                itemsize = getattr(getattr(c, "dtype", None), "itemsize", 0)
+                nbytes = int(size) * int(itemsize)
+            total += int(nbytes)
+        return total
+
+    def donation_candidates(self) -> List[Tuple[int, int, str]]:
+        """Greedy (arg, result) pairs with identical dtype+shape — the
+        buffers jit could alias with ``donate_argnums`` (the feed-in
+        for cross-iteration buffer reuse).  Scalars are skipped: there
+        is nothing worth donating there."""
+        out: List[Tuple[int, int, str]] = []
+        used = set()
+        for i, a in enumerate(self.in_avals):
+            if not getattr(a, "shape", ()):  # scalar
+                continue
+            for j, r in enumerate(self.out_avals):
+                if j in used:
+                    continue
+                if (getattr(a, "dtype", None) == getattr(r, "dtype", None)
+                        and getattr(a, "shape", None) == getattr(r, "shape", None)):
+                    out.append((i, j, aval_str(a)))
+                    used.add(j)
+                    break
+        return out
+
+
+class IrRule:
+    """Base: one IR invariant with an ID, a one-line hint, and a
+    per-program check (the engine iterates programs and filters the
+    spec's ``suppress`` declarations)."""
+
+    id = "GP000"
+    name = "base"
+    hint = ""
+
+    def check(self, program: TracedProgram) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, spec: ProgramSpec, message: str,
+                hint: Optional[str] = None) -> Finding:
+        return Finding(self.id, spec.where, 1, 0,
+                       f"[{spec.name}] {message}",
+                       self.hint if hint is None else hint)

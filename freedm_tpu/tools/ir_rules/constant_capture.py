@@ -1,0 +1,52 @@
+"""GP003 — constant capture: closure-captured arrays above a size
+threshold folded into the program as constants.
+
+A jax array referenced from inside a jitted function but created
+outside it becomes a *program constant*: it serializes into the compile
+payload, duplicates in device memory per program, and — because a new
+(case, topology) builds a new program — multiplies per topology.
+``pf/krylov.py`` documents the burn: 400 MB of bf16 preconditioner as a
+closure constant at 10k buses, which is why both Krylov paths thread
+the pair as runtime ARGUMENTS instead.  This rule pins that discipline
+for every registered program: any single captured constant at or above
+the threshold (``--probe-const-mb``, config ``probe-const-mb``) is a
+finding.
+
+Small captures (masks, index vectors, scheduled injections) are the
+normal and correct way to bake per-case structure into a program — the
+threshold, not a blanket ban, is the invariant.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from freedm_tpu.tools.lint_rules.base import Finding
+from freedm_tpu.tools.ir_rules.base import IrRule, TracedProgram
+
+
+class ConstantCapture(IrRule):
+    id = "GP003"
+    name = "constant-capture"
+    hint = ("thread the array as a runtime argument (the pf/krylov.py "
+            "preconditioner pattern) or build it inside the program "
+            "(iota/eye); raise probe-const-mb only for a documented "
+            "per-topology artifact")
+
+    def __init__(self, const_mb: float = 0.25):
+        self.const_bytes = int(const_mb * 1024 * 1024)
+
+    def check(self, program: TracedProgram) -> Iterable[Finding]:
+        for c in program.consts:
+            nbytes = getattr(c, "nbytes", 0) or 0
+            if nbytes >= self.const_bytes:
+                shape = tuple(getattr(c, "shape", ()))
+                dtype = getattr(getattr(c, "dtype", None), "name", "?")
+                yield self.finding(
+                    program.spec,
+                    f"captured constant {dtype}{list(shape)} "
+                    f"({nbytes / 1e6:.2f} MB >= "
+                    f"{self.const_bytes / 1e6:.2f} MB threshold) is folded "
+                    f"into the compiled program (recompile/memory hazard "
+                    f"per topology)",
+                )

@@ -1,0 +1,59 @@
+"""GP004 — donation readiness: declared donatable buffers must have an
+aliasable result.
+
+The MFU roadmap item (bf16/f32 inner GMRES + buffer donation across
+Newton iterations and serve dispatches) needs to know, per program,
+which argument buffers XLA could alias with a same-dtype-same-shape
+result — those are the HBM round trips donation would delete.  The
+engine computes the candidate pairs for every program and records them
+in the inventory (``donation_candidates``); this rule checks only the
+*declarations*: a spec that marks an argument index ``donatable`` when
+no result buffer can alias it has drifted from the program it
+describes — the same self-checking-registry posture as GL002's
+``HOT_PATHS`` orphan findings.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from freedm_tpu.tools.lint_rules.base import Finding
+from freedm_tpu.tools.ir_rules.base import IrRule, TracedProgram, aval_str
+
+
+class DonationReadiness(IrRule):
+    id = "GP004"
+    name = "donation-readiness"
+    hint = ("align the spec's donatable indices with the program: an "
+            "index is donation-ready only when some result has the "
+            "same dtype+shape (see the inventory's donation_candidates)")
+
+    def check(self, program: TracedProgram) -> Iterable[Finding]:
+        spec = program.spec
+        if not spec.donatable:
+            return
+        n_args = len(program.in_avals)
+        for idx in spec.donatable:
+            if idx >= n_args:
+                yield self.finding(
+                    spec,
+                    f"donatable index {idx} is out of range (program has "
+                    f"{n_args} array arguments)",
+                )
+                continue
+            # Check the DECLARED index directly against the results —
+            # the inventory's greedy candidate pairing is arbitrary in
+            # arg order, and two same-shaped arguments must not make
+            # the later one look non-donatable.
+            a = program.in_avals[idx]
+            aliasable = any(
+                getattr(a, "dtype", None) == getattr(r, "dtype", None)
+                and getattr(a, "shape", None) == getattr(r, "shape", None)
+                for r in program.out_avals
+            )
+            if not aliasable:
+                yield self.finding(
+                    spec,
+                    f"argument {idx} ({aval_str(program.in_avals[idx])}) is "
+                    f"declared donatable but no result buffer can alias it",
+                )

@@ -1,0 +1,114 @@
+"""GP001 — dtype flow: declared float64 surfaces stay f64; no stray
+low-precision floats outside a declared mixed-precision boundary.
+
+The contract this pins (docs/solvers.md, docs/serving.md): accuracy
+claims never rest on reduced-precision self-evaluation.  The residual
+oracles (``pf/krylov.host_injections``/``true_mismatch``), the serve
+cache's delta-verify gate, and the tolerance tests all run in float64 —
+and the *traced* programs feeding them must not silently demote on the
+way.  Concretely, per program:
+
+- ``spec.f64`` programs: any ``convert_element_type`` from float64 down
+  to f32/bf16/f16 is a finding, and any float program *result* that is
+  not f64 is a finding — unless the target dtype is in the spec's
+  declared ``allow_dtypes`` boundary (e.g. the bf16 preconditioner
+  stream in ``pf/krylov.py``, which only steers convergence and is
+  explicitly documented as precision-irrelevant).
+- every program: any bf16/f16 value appearing anywhere in the IR
+  outside a declared boundary is a finding.  This is exactly the fence
+  the planned bf16/f32 inner-GMRES work (ROADMAP "attack the 1.95%
+  MFU") needs already standing: when mixed-precision inners land, they
+  land as *declared* boundaries, and anything XLA sneaks in beyond the
+  declaration fails the build.
+
+Findings aggregate per (program, kind, dtype) with occurrence counts —
+one demotion pattern repeated through a scan body is one finding.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Tuple
+
+from freedm_tpu.tools.lint_rules.base import Finding
+from freedm_tpu.tools.ir_rules.base import (
+    DEMOTION_TARGETS,
+    LOW_PRECISION_FLOATS,
+    IrRule,
+    TracedProgram,
+    aval_str,
+    var_dtype_name,
+)
+
+
+class DtypeFlow(IrRule):
+    id = "GP001"
+    name = "dtype-flow"
+    hint = ("keep the f64 contract end-to-end, or declare the boundary: "
+            "add the dtype to the spec's allow_dtypes with a "
+            "boundary_reason in ir_rules/registry.py "
+            "(docs/static_analysis.md, declared-boundary policy)")
+
+    def check(self, program: TracedProgram) -> Iterable[Finding]:
+        spec = program.spec
+        allow = set(spec.allow_dtypes)
+        demotions: Dict[Tuple[str, str], int] = {}
+        low_seen: Dict[str, int] = {}
+
+        for eqn in program.eqns():
+            if (spec.f64
+                    and eqn.primitive.name == "convert_element_type"):
+                src = var_dtype_name(eqn.invars[0]) if eqn.invars else None
+                dst = getattr(eqn.params.get("new_dtype"), "name", None)
+                if (src == "float64" and dst in DEMOTION_TARGETS
+                        and dst not in allow):
+                    demotions[(src, dst)] = demotions.get((src, dst), 0) + 1
+            for out in eqn.outvars:
+                dt = var_dtype_name(out)
+                if dt in LOW_PRECISION_FLOATS and dt not in allow:
+                    low_seen[dt] = low_seen.get(dt, 0) + 1
+
+        # Arguments and captured constants are IR too: a bf16 input or
+        # const whose only consumer upcasts it would produce no bf16
+        # OUTVAR, yet low-precision data is flowing through the program
+        # — the boundary must still be declared.
+        for i, aval in enumerate(program.in_avals):
+            dt = getattr(getattr(aval, "dtype", None), "name", None)
+            if dt in LOW_PRECISION_FLOATS and dt not in allow:
+                yield self.finding(
+                    spec,
+                    f"program argument {i} is {aval_str(aval)} — "
+                    f"{dt} outside a declared mixed-precision boundary",
+                )
+        for c in program.consts:
+            dt = getattr(getattr(c, "dtype", None), "name", None)
+            if dt in LOW_PRECISION_FLOATS and dt not in allow:
+                shape = list(getattr(c, "shape", ()))
+                yield self.finding(
+                    spec,
+                    f"captured constant {dt}{shape} sits outside a "
+                    f"declared mixed-precision boundary",
+                )
+
+        for (src, dst), count in sorted(demotions.items()):
+            yield self.finding(
+                spec,
+                f"float64 contract surface demotes {src} -> {dst} "
+                f"({count} site(s) in the traced IR)",
+            )
+        for dt, count in sorted(low_seen.items()):
+            yield self.finding(
+                spec,
+                f"{dt} appears at {count} IR site(s) outside a declared "
+                f"mixed-precision boundary",
+            )
+
+        if spec.f64:
+            for i, aval in enumerate(program.out_avals):
+                dt = getattr(getattr(aval, "dtype", None), "name", "")
+                if dt.startswith("float") and dt != "float64" \
+                        and dt not in allow:
+                    yield self.finding(
+                        spec,
+                        f"float64 contract surface returns result {i} as "
+                        f"{aval_str(aval)} (silent output demotion)",
+                    )

@@ -1,0 +1,46 @@
+"""GP002 — host transfer: no callback-shaped primitives inside traced
+programs.
+
+A ``pure_callback``/``io_callback``/``debug_callback`` (or an infeed/
+outfeed) inside a jitted solver body forces a device→host→device round
+trip *per execution* — exactly the sync class GL002 polices at the
+source level for the dispatch loops, enforced here at the IR level
+where a helper three layers down can smuggle one in.  Host-side oracles
+(``host_injections``, the cache verify gate) are DESIGNED to run on
+host — after the program returns, on materialized arrays — never inside
+the program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable
+
+from freedm_tpu.tools.lint_rules.base import Finding
+from freedm_tpu.tools.ir_rules.base import IrRule, TracedProgram
+
+#: Primitive names that move data across the host boundary mid-program.
+HOST_TRANSFER_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "infeed", "outfeed",
+})
+
+
+class HostTransfer(IrRule):
+    id = "GP002"
+    name = "host-transfer"
+    hint = ("move the host work outside the traced program (call it on "
+            "the materialized result, like true_mismatch / the cache "
+            "verify gate), or compute it in-graph")
+
+    def check(self, program: TracedProgram) -> Iterable[Finding]:
+        seen: Dict[str, int] = {}
+        for eqn in program.eqns():
+            name = eqn.primitive.name
+            if name in HOST_TRANSFER_PRIMITIVES:
+                seen[name] = seen.get(name, 0) + 1
+        for name, count in sorted(seen.items()):
+            yield self.finding(
+                program.spec,
+                f"host-transfer primitive `{name}` appears {count} "
+                f"time(s) inside the traced program",
+            )

@@ -1,0 +1,295 @@
+"""PROGRAM_REGISTRY: every jitted entrypoint gridprobe audits.
+
+The IR-level analogue of GL002's ``HOT_PATHS``: each entry names one
+compiled program the framework actually dispatches — the solver cores,
+the DC screen + SMW delta pair the serving cache leans on, the N-1
+screen, the QSTS chunk bodies, the serve engines' shape-bucket
+programs, and the LB auction round — together with its declared
+contracts (f64 surface?, allowed mixed-precision boundary, donation
+declarations).  An entry that no longer builds is itself a finding
+(GP005), so the registry cannot silently rot.
+
+Case sizes are picked to keep a full probe cheap on the CPU backend
+while still being LARGE enough that the capture/dtype hazards the rules
+police are real at trace time (e.g. the dense-Newton entry runs at 118
+buses, where a captured identity matrix would already trip GP003's
+default threshold).  Everything traces with x64 enabled, so the audited
+flow is the float64 contract flow.
+
+``F64_SURFACES`` lists the *host-side* float64 oracles the serve cache
+and the solver accuracy claims rest on — numpy code gridprobe cannot
+trace, so GP001 checks them by evaluation: every floating output leaf
+must be float64.
+
+Builders import lazily and construct solvers the same way production
+does; where a solver's real program takes its heavy artifacts as
+runtime arguments (the krylov/sparse preconditioner pair, the FDLF/DC
+factor pairs), the entry traces through the solver's ``probe_target``
+seam so the audit sees the actual jit boundary, not an outer closure
+that would misreport arguments as captured constants.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from freedm_tpu.tools.ir_rules.base import F64Surface, ProgramSpec
+
+#: Shared boundary reason for the bf16 preconditioner stream
+#: (pf/krylov.py module docstring: M⁻¹ only steers convergence; the
+#: iterates, residuals and JVPs stay in the working dtype).
+_BF16_PRECOND = ("preconditioner streams bf16 by design; Newton "
+                 "iterates/residuals stay f64 (pf/krylov.py)")
+
+
+def _bus_case(name: str):
+    from freedm_tpu.serve.service import _resolve_bus_case
+
+    return _resolve_bus_case(name)
+
+
+def _probe(solver) -> Tuple:
+    target = getattr(solver, "probe_target", None)
+    if target is None:
+        raise RuntimeError(
+            f"solver {solver!r} exposes no probe_target seam "
+            f"(registry orphaned by a refactor?)"
+        )
+    return target()
+
+
+# -- builders ---------------------------------------------------------------
+
+def _newton_dense():
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.newton import make_newton_solver
+
+    solve, _ = make_newton_solver(synthetic_mesh(118), backend="dense")
+    return _probe(solve)
+
+
+def _newton_sparse():
+    from freedm_tpu.pf.sparse import make_sparse_newton_solver
+
+    solve, _ = make_sparse_newton_solver(_bus_case("case_ieee30"))
+    return _probe(solve)
+
+
+def _krylov():
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.krylov import make_krylov_solver
+
+    solve, _ = make_krylov_solver(synthetic_mesh(40), inner_iters=8)
+    return _probe(solve)
+
+
+def _fdlf():
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.fdlf import make_fdlf_solver
+
+    solve, _ = make_fdlf_solver(synthetic_mesh(200))
+    return _probe(solve)
+
+
+def _ladder():
+    from freedm_tpu.grid.cases import vvc_9bus
+    from freedm_tpu.pf.ladder import make_ladder_solver
+
+    solve, _ = make_ladder_solver(vvc_9bus())
+    return _probe(solve)
+
+
+def _dc_solve():
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.dc import make_dc_solver
+
+    return _probe(make_dc_solver(synthetic_mesh(200)).solve)
+
+
+def _dc_screen():
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.dc import make_dc_solver
+
+    return _probe(make_dc_solver(synthetic_mesh(200)).screen_outages)
+
+
+def _n1_smw():
+    from freedm_tpu.pf.n1 import make_n1_screen
+
+    screen = make_n1_screen(_bus_case("case_ieee30"), backend="dense")
+    return _probe(screen)
+
+
+def _cache_delta():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from freedm_tpu.pf.krylov import build_fdlf_precond
+    from freedm_tpu.serve.cache import _build_delta_program
+    from freedm_tpu.utils import cplx
+
+    sys_ = _bus_case("case_ieee30")
+    rdtype = cplx.default_rdtype(None)
+    precond = build_fdlf_precond(sys_, dtype=rdtype, kind="lu")
+    correct = _build_delta_program(sys_, precond, tol=1e-8, max_sweeps=8,
+                                   rdtype=rdtype)
+    n = sys_.n_bus
+    theta0 = jnp.zeros(n, rdtype)
+    v0 = jnp.ones(n, rdtype)
+    p = jnp.asarray(np.asarray(sys_.p_inj), rdtype)
+    q = jnp.asarray(np.asarray(sys_.q_inj), rdtype)
+    return correct, (theta0, v0, p, q)
+
+
+def _serve_pf_bucket():
+    import numpy as np
+
+    from freedm_tpu.serve.service import PowerFlowEngine
+
+    eng = PowerFlowEngine("case14", backend="dense")
+    bucket, n = 4, eng.n_bus
+    p = np.broadcast_to(eng._p0, (bucket, n)).copy()
+    q = np.broadcast_to(eng._q0, (bucket, n)).copy()
+    v0 = np.broadcast_to(eng._v0_flat, (bucket, n)).copy()
+    th0 = np.zeros((bucket, n))
+    return eng._batched, (p, q, v0, th0)
+
+
+def _serve_vvc_bucket():
+    import numpy as np
+
+    from freedm_tpu.serve.service import VVCEngine
+
+    eng = VVCEngine("vvc_9bus")
+    return eng._batched, (np.zeros((2, eng.nb, 3)),)
+
+
+def _qsts_spec(case: str):
+    from freedm_tpu.scenarios.engine import QstsEngine, StudySpec
+
+    return QstsEngine(StudySpec(
+        case=case, scenarios=2, steps=8, chunk_steps=4, seed=7,
+    ))
+
+
+def _qsts_bus_chunk():
+    eng = _qsts_spec("case14")
+    fn = eng._build_bus_chunk(4)
+    p, q = eng._bus_injections(0, 4)
+    return fn, (eng.initial_state(), p, q)
+
+
+def _qsts_feeder_chunk():
+    eng = _qsts_spec("vvc_9bus")
+    fn = eng._build_feeder_chunk(4)
+    s_re, s_im = eng._feeder_injections(0, 4)
+    return fn, (eng.initial_state(), s_re, s_im)
+
+
+def _lb_round():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from freedm_tpu.modules.lb import lb_round
+
+    # The superstep feeds the auction float32 state (parallel/
+    # superstep.py) — trace at the production dtype.
+    n = 16
+    rng = np.random.RandomState(3)
+    net = jnp.asarray(rng.uniform(-2, 2, n), jnp.float32)
+    gw = jnp.zeros(n, jnp.float32)
+    mask = jnp.ones((n, n), jnp.float32)
+    step = 1.0  # migration_step is a build-time scalar, not traced
+    return jax.jit(lambda a, b, c: lb_round(a, b, c, step)), (net, gw, mask)
+
+
+#: Every registered jitted entrypoint (see module docstring).
+PROGRAM_REGISTRY: List[ProgramSpec] = [
+    ProgramSpec("pf/newton/dense", "freedm_tpu/pf/newton.py",
+                _newton_dense, f64=True),
+    ProgramSpec("pf/newton/sparse", "freedm_tpu/pf/sparse.py",
+                _newton_sparse, f64=True,
+                allow_dtypes=frozenset({"bfloat16"}),
+                boundary_reason=_BF16_PRECOND),
+    ProgramSpec("pf/krylov", "freedm_tpu/pf/krylov.py",
+                _krylov, f64=True,
+                allow_dtypes=frozenset({"bfloat16"}),
+                boundary_reason=_BF16_PRECOND),
+    ProgramSpec("pf/fdlf", "freedm_tpu/pf/fdlf.py", _fdlf, f64=True),
+    ProgramSpec("pf/ladder", "freedm_tpu/pf/ladder.py", _ladder, f64=True),
+    ProgramSpec("pf/dc/solve", "freedm_tpu/pf/dc.py", _dc_solve, f64=True),
+    ProgramSpec("pf/dc/screen", "freedm_tpu/pf/dc.py", _dc_screen, f64=True),
+    ProgramSpec("pf/n1/smw", "freedm_tpu/pf/n1.py", _n1_smw, f64=True),
+    ProgramSpec("serve/cache/delta", "freedm_tpu/serve/cache.py",
+                _cache_delta, f64=True),
+    ProgramSpec("serve/pf/bucket4", "freedm_tpu/serve/service.py",
+                _serve_pf_bucket, f64=True),
+    ProgramSpec("serve/vvc/bucket2", "freedm_tpu/serve/service.py",
+                _serve_vvc_bucket, f64=True),
+    ProgramSpec("qsts/bus_chunk", "freedm_tpu/scenarios/engine.py",
+                _qsts_bus_chunk, f64=True),
+    ProgramSpec("qsts/feeder_chunk", "freedm_tpu/scenarios/engine.py",
+                _qsts_feeder_chunk, f64=True),
+    ProgramSpec("lb/auction_round", "freedm_tpu/modules/lb.py",
+                _lb_round, f64=False),
+]
+
+
+# -- host-side float64 oracle surfaces --------------------------------------
+
+def _host_injections_surface():
+    import numpy as np
+
+    from freedm_tpu.pf.krylov import host_injections
+
+    sys_ = _bus_case("case_ieee30")
+    n = sys_.n_bus
+    return host_injections, (sys_, np.zeros(n, np.float32),
+                             np.ones(n, np.float32))
+
+
+def _true_mismatch_surface():
+    import numpy as np
+
+    from freedm_tpu.pf.krylov import KrylovResult, true_mismatch
+
+    sys_ = _bus_case("case_ieee30")
+    n = sys_.n_bus
+    # float32 INPUTS on purpose: the oracle must promote, not inherit.
+    res = KrylovResult(
+        v=np.ones(n, np.float32), theta=np.zeros(n, np.float32),
+        p=np.zeros(n, np.float32), q=np.zeros(n, np.float32),
+        iterations=np.int32(0), converged=np.bool_(False),
+        mismatch=np.float32(1.0),
+    )
+    return true_mismatch, (sys_, res)
+
+
+def _cache_verify_surface():
+    import numpy as np
+
+    from freedm_tpu.serve.cache import CaseEntry
+
+    sys_ = _bus_case("case_ieee30")
+    n = sys_.n_bus
+    entry = CaseEntry("case_ieee30", sys_, "dense", "probe")
+    # float32 INPUTS on purpose: the verify gate must promote to f64.
+    return entry.verify, (
+        np.zeros(n, np.float32), np.ones(n, np.float32),
+        np.asarray(sys_.p_inj, np.float64),
+        np.asarray(sys_.q_inj, np.float64),
+    )
+
+
+#: Host float64 oracle surfaces: the krylov accuracy oracle and the
+#: serve cache's residual-verify gate (every residual-verify site the
+#: delta tier and the solver claims rely on routes through these).
+F64_SURFACES: List[F64Surface] = [
+    F64Surface("pf/krylov/host_injections", "freedm_tpu/pf/krylov.py",
+               _host_injections_surface),
+    F64Surface("pf/krylov/true_mismatch", "freedm_tpu/pf/krylov.py",
+               _true_mismatch_surface),
+    F64Surface("serve/cache/verify", "freedm_tpu/serve/cache.py",
+               _cache_verify_surface),
+]

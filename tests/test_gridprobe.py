@@ -1,0 +1,563 @@
+"""gridprobe tests: one violating + one clean fixture per IR rule
+(GP001-GP004), the registry-orphan finding (GP005), inventory
+round-trip + deliberate-drift rejection (GP006), the repo-wide
+self-audit-clean contract, and the GP003 burn-down pins (the dense
+Newton identity, the FDLF/DC factor pairs, and the krylov/sparse
+preconditioner pair all reach their programs as runtime arguments or
+in-program values, never as large captured constants).
+
+Fixture registries are small python files written into ``tmp_path`` and
+loaded via ``--registry-file`` — the same seam the CI negative step
+uses, so ``main()`` exit codes are proven end-to-end.
+"""
+
+import json
+import pathlib
+import textwrap
+
+import numpy as np
+import pytest
+
+from freedm_tpu.tools.gridprobe import main, run_probe
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+HEADER = """
+    import jax
+    import jax.numpy as jnp
+    from freedm_tpu.tools.ir_rules.base import ProgramSpec
+    F64_SURFACES = []
+"""
+
+
+def _registry(tmp_path, body, name="reg.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(HEADER) + textwrap.dedent(body))
+    return str(p)
+
+
+def _run(path, *args):
+    return main(["--registry-file", path, "--no-inventory", *args])
+
+
+def _findings(path, **kw):
+    return run_probe(registry_file=path, inventory_mode="skip",
+                     **kw).findings
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# GP001 dtype flow
+# ---------------------------------------------------------------------------
+
+GP001_BAD = """
+    def build():
+        def demote(x):
+            return (x.astype(jnp.float32) * 2).astype(jnp.float64)
+        return demote, (jnp.ones(4, jnp.float64),)
+    PROGRAM_REGISTRY = [
+        ProgramSpec("fix/demote", "freedm_tpu/pf/newton.py", build,
+                    f64=True),
+    ]
+"""
+
+GP001_CLEAN = """
+    def build():
+        return (lambda x: x * 2.0), (jnp.ones(4, jnp.float64),)
+    PROGRAM_REGISTRY = [
+        ProgramSpec("fix/pure64", "freedm_tpu/pf/newton.py", build,
+                    f64=True),
+    ]
+"""
+
+GP001_BF16_BOUNDARY = """
+    def build():
+        def mixed(x, m):
+            return (m @ x.astype(jnp.bfloat16)).astype(jnp.float64)
+        return mixed, (jnp.ones(4, jnp.float64),
+                       jnp.eye(4, dtype=jnp.bfloat16))
+    PROGRAM_REGISTRY = [
+        ProgramSpec("fix/mixed", "freedm_tpu/pf/newton.py", build,
+                    f64=True, allow_dtypes=frozenset({"bfloat16"}),
+                    boundary_reason="declared bf16 stream (test)"),
+    ]
+"""
+
+
+def test_gp001_flags_f64_demotion(tmp_path):
+    findings = _findings(_registry(tmp_path, GP001_BAD))
+    assert _rules_of(findings) == ["GP001"]
+    assert "float64 -> float32" in findings[0].message
+
+
+def test_gp001_clean_f64_flow(tmp_path):
+    assert _findings(_registry(tmp_path, GP001_CLEAN)) == []
+
+
+def test_gp001_bf16_needs_declared_boundary(tmp_path):
+    # Same program, boundary declared -> clean; undeclared -> findings.
+    clean = _findings(_registry(tmp_path, GP001_BF16_BOUNDARY))
+    assert clean == []
+    undeclared = GP001_BF16_BOUNDARY.replace(
+        "allow_dtypes=frozenset({\"bfloat16\"}),\n", ""
+    ).replace("boundary_reason=\"declared bf16 stream (test)\"", "")
+    findings = _findings(_registry(tmp_path, undeclared, name="reg2.py"))
+    assert "GP001" in _rules_of(findings)
+    assert any("bfloat16" in f.message for f in findings)
+
+
+def test_gp001_host_surface_demotion(tmp_path):
+    reg = _registry(tmp_path, """
+        import numpy as np
+        from freedm_tpu.tools.ir_rules.base import F64Surface
+        PROGRAM_REGISTRY = []
+        def bad_oracle():
+            return (lambda x: np.asarray(x, np.float32)), \\
+                (np.ones(3, np.float64),)
+        F64_SURFACES = [
+            F64Surface("fix/oracle", "freedm_tpu/pf/krylov.py",
+                       bad_oracle),
+        ]
+    """)
+    findings = _findings(reg)
+    assert _rules_of(findings) == ["GP001"]
+    assert "float32" in findings[0].message
+
+
+def test_dtype_blind_surface_is_a_finding(tmp_path):
+    # A surface returning only builtin floats carries no dtype evidence
+    # — an unfalsifiable check must fail loudly (GP005), not pass.
+    reg = _registry(tmp_path, """
+        import numpy as np
+        from freedm_tpu.tools.ir_rules.base import F64Surface
+        PROGRAM_REGISTRY = []
+        def blind_oracle():
+            return (lambda x: float(np.sum(x))), (np.ones(3, np.float32),)
+        F64_SURFACES = [
+            F64Surface("fix/blind", "freedm_tpu/pf/krylov.py",
+                       blind_oracle),
+        ]
+    """)
+    findings = _findings(reg)
+    assert _rules_of(findings) == ["GP005"]
+    assert "no numpy floating leaves" in findings[0].message
+
+
+def test_gp001_flags_low_precision_args_and_consts(tmp_path):
+    # bf16 entering as an ARGUMENT or CONSTANT whose only consumer
+    # upcasts it is still low-precision data in the IR — the boundary
+    # must be declared even when no bf16 outvar exists.
+    reg = _registry(tmp_path, """
+        def arg_build():
+            return (lambda x: x.astype(jnp.float64) * 2.0), \\
+                (jnp.ones(4, jnp.bfloat16),)
+        def const_build():
+            c = jnp.ones(4, jnp.bfloat16)
+            return jax.jit(lambda x: x + c.astype(jnp.float64)), \\
+                (jnp.ones(4, jnp.float64),)
+        PROGRAM_REGISTRY = [
+            ProgramSpec("fix/bf16arg", "freedm_tpu/pf/newton.py",
+                        arg_build, f64=True),
+            ProgramSpec("fix/bf16const", "freedm_tpu/pf/newton.py",
+                        const_build, f64=True),
+        ]
+    """)
+    findings = _findings(reg)
+    assert _rules_of(findings) == ["GP001"]
+    msgs = " ".join(f.message for f in findings)
+    assert "argument 0" in msgs and "captured constant" in msgs
+
+
+# ---------------------------------------------------------------------------
+# GP002 host transfer
+# ---------------------------------------------------------------------------
+
+GP002_BAD = """
+    import numpy as np
+    def build():
+        def f(x):
+            out = jax.ShapeDtypeStruct(x.shape, x.dtype)
+            return jax.pure_callback(lambda v: np.asarray(v), out, x)
+        return f, (jnp.ones(3),)
+    PROGRAM_REGISTRY = [
+        ProgramSpec("fix/cb", "freedm_tpu/pf/newton.py", build),
+    ]
+"""
+
+
+def test_gp002_flags_callbacks_and_main_exits_1(tmp_path, capsys):
+    reg = _registry(tmp_path, GP002_BAD)
+    findings = _findings(reg)
+    assert _rules_of(findings) == ["GP002"]
+    assert "pure_callback" in findings[0].message
+    assert _run(reg) == 1
+    out = capsys.readouterr().out
+    assert "GP002" in out
+
+
+# ---------------------------------------------------------------------------
+# GP003 constant capture
+# ---------------------------------------------------------------------------
+
+GP003_BAD = """
+    def build():
+        big = jnp.zeros(200_000)  # 1.6 MB f64 closure constant
+        return jax.jit(lambda x: x + big.sum()), (jnp.ones(3),)
+    PROGRAM_REGISTRY = [
+        ProgramSpec("fix/capture", "freedm_tpu/pf/newton.py", build),
+    ]
+"""
+
+GP003_CLEAN = """
+    def build():
+        # Same bytes, threaded as a runtime ARGUMENT (the krylov
+        # preconditioner discipline) -> not a program constant.
+        return (jax.jit(lambda x, big: x + big.sum()),
+                (jnp.ones(3), jnp.zeros(200_000)))
+    PROGRAM_REGISTRY = [
+        ProgramSpec("fix/arg", "freedm_tpu/pf/newton.py", build),
+    ]
+"""
+
+
+def test_gp003_flags_large_capture(tmp_path):
+    findings = _findings(_registry(tmp_path, GP003_BAD))
+    assert _rules_of(findings) == ["GP003"]
+    assert "1.60 MB" in findings[0].message
+
+
+def test_gp003_arg_threading_is_clean(tmp_path):
+    assert _findings(_registry(tmp_path, GP003_CLEAN)) == []
+
+
+# ---------------------------------------------------------------------------
+# GP004 donation readiness
+# ---------------------------------------------------------------------------
+
+GP004_BAD = """
+    def build():
+        return (lambda x: jnp.sum(x)), (jnp.ones(5),)
+    PROGRAM_REGISTRY = [
+        ProgramSpec("fix/donate", "freedm_tpu/pf/newton.py", build,
+                    donatable=(0,)),
+    ]
+"""
+
+GP004_CLEAN = """
+    def build():
+        return (lambda x: x * 2.0), (jnp.ones(5),)
+    PROGRAM_REGISTRY = [
+        ProgramSpec("fix/donate_ok", "freedm_tpu/pf/newton.py", build,
+                    donatable=(0,)),
+    ]
+"""
+
+
+def test_gp004_declared_donation_without_alias(tmp_path):
+    findings = _findings(_registry(tmp_path, GP004_BAD))
+    assert _rules_of(findings) == ["GP004"]
+    assert "no result buffer can alias" in findings[0].message
+
+
+def test_gp004_checks_declared_index_not_greedy_pairing(tmp_path):
+    # Two same-shaped arguments, one result: the inventory's greedy
+    # pairing gives the candidate to arg 0, but declaring arg 1
+    # donatable is still legitimate — the rule checks the declared
+    # index directly against the results.
+    reg = _registry(tmp_path, """
+        def build():
+            return (lambda x, y: x + y), (jnp.ones(5), jnp.ones(5))
+        PROGRAM_REGISTRY = [
+            ProgramSpec("fix/second_arg", "freedm_tpu/pf/newton.py",
+                        build, donatable=(1,)),
+        ]
+    """)
+    assert _findings(reg) == []
+
+
+def test_rules_subset_scopes_engine_findings_too(tmp_path):
+    # A broken builder is a GP005 finding on default runs, but a
+    # --rules GP003 iteration loop must see only GP003.
+    reg = _registry(tmp_path, GP005_ORPHAN)
+    assert _rules_of(_findings(reg)) == ["GP005"]
+    assert _findings(reg, rules=["GP003"]) == []
+    assert _run(reg, "--rules", "GP003") == 0
+
+
+def test_gp004_aliasable_declaration_is_clean_and_recorded(tmp_path):
+    res = run_probe(registry_file=_registry(tmp_path, GP004_CLEAN),
+                    inventory_mode="skip")
+    assert res.findings == []
+    cands = res.inventory["programs"]["fix/donate_ok"][
+        "donation_candidates"]
+    assert cands and cands[0][:2] == [0, 0]
+
+
+# ---------------------------------------------------------------------------
+# GP005 registry orphan
+# ---------------------------------------------------------------------------
+
+GP005_ORPHAN = """
+    def build():
+        from freedm_tpu.pf.newton import make_newton_solver_RENAMED
+        return make_newton_solver_RENAMED, ()
+    PROGRAM_REGISTRY = [
+        ProgramSpec("fix/orphan", "freedm_tpu/pf/newton.py", build),
+    ]
+"""
+
+
+def test_gp005_orphaned_registry_entry(tmp_path):
+    findings = _findings(_registry(tmp_path, GP005_ORPHAN))
+    assert _rules_of(findings) == ["GP005"]
+    assert "failed to build/trace" in findings[0].message
+
+
+def test_gp005_missing_where_path_and_undocumented_boundary(tmp_path):
+    reg = _registry(tmp_path, """
+        def build():
+            return (lambda x: x), (jnp.ones(2),)
+        PROGRAM_REGISTRY = [
+            ProgramSpec("fix/nowhere", "freedm_tpu/pf/NO_SUCH.py", build),
+            ProgramSpec("fix/noreason", "freedm_tpu/pf/newton.py", build,
+                        allow_dtypes=frozenset({"bfloat16"})),
+        ]
+    """)
+    findings = _findings(reg)
+    assert _rules_of(findings) == ["GP005"]
+    msgs = " ".join(f.message for f in findings)
+    assert "does not exist" in msgs
+    assert "boundary_reason" in msgs
+
+
+# ---------------------------------------------------------------------------
+# GP006 inventory round-trip + drift rejection
+# ---------------------------------------------------------------------------
+
+def test_gp006_inventory_roundtrip_and_drift(tmp_path, capsys):
+    reg = _registry(tmp_path, GP001_CLEAN)
+    inv = tmp_path / "inv.json"
+    # Missing inventory is itself a finding (nothing to diff against).
+    assert main(["--registry-file", reg, "--inventory", str(inv)]) == 1
+    capsys.readouterr()
+    # Write, then re-check: identical trace must round-trip clean.
+    assert main(["--registry-file", reg, "--inventory", str(inv),
+                 "--write-inventory"]) == 0
+    capsys.readouterr()
+    assert main(["--registry-file", reg, "--inventory", str(inv)]) == 0
+    capsys.readouterr()
+    # Deliberate dtype drift in a throwaway copy -> exit 1, GP006,
+    # readable delta naming the program.
+    d = json.loads(inv.read_text())
+    d["programs"]["fix/pure64"]["args"][0] = \
+        d["programs"]["fix/pure64"]["args"][0].replace("float64", "float32")
+    drift = tmp_path / "drift.json"
+    drift.write_text(json.dumps(d))
+    rc = main(["--registry-file", reg, "--inventory", str(drift),
+               "--format=json"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    rules = {f["rule"] for f in out["findings"]}
+    assert rules == {"GP006"}
+    assert any("args drifted" in f["message"] for f in out["findings"])
+
+
+def test_gp006_program_set_drift(tmp_path, capsys):
+    reg = _registry(tmp_path, GP001_CLEAN)
+    inv = tmp_path / "inv.json"
+    assert main(["--registry-file", reg, "--inventory", str(inv),
+                 "--write-inventory"]) == 0
+    capsys.readouterr()
+    # A program in the inventory that is no longer traced (and one
+    # traced but unrecorded) both produce readable GP006 findings.
+    d = json.loads(inv.read_text())
+    d["programs"]["fix/ghost"] = d["programs"]["fix/pure64"]
+    inv.write_text(json.dumps(d))
+    rc = main(["--registry-file", reg, "--inventory", str(inv)])
+    assert rc == 1
+    assert "no longer traced" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Repo-wide self-audit + burn-down pins
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def self_audit():
+    """One full probe of the real registry, shared by the assertions
+    below (traces all ~14 registered programs once)."""
+    return run_probe(inventory_mode="check")
+
+
+def test_repo_self_audit_clean(self_audit):
+    assert self_audit.findings == [], "\n".join(
+        f"{f.rule} {f.path}: {f.message}" for f in self_audit.findings
+    )
+
+
+def test_checked_in_inventory_exists_and_matches_version(self_audit):
+    path = REPO / "freedm_tpu" / "tools" / "ir_inventory.json"
+    recorded = json.loads(path.read_text())
+    assert recorded["version"] == self_audit.inventory["version"]
+    assert recorded["x64"] is True
+    assert set(recorded["programs"]) == set(
+        self_audit.inventory["programs"])
+
+
+def test_f64_surfaces_cover_residual_verify_sites(self_audit):
+    # The acceptance contract: the krylov accuracy oracle and the serve
+    # cache's delta-verify gate are BOTH registered f64 surfaces.
+    names = set(self_audit.inventory["f64_surfaces"])
+    assert {"pf/krylov/host_injections", "pf/krylov/true_mismatch",
+            "serve/cache/verify"} <= names
+
+
+def _program(self_audit, name):
+    for tp in self_audit.programs:
+        if tp.spec.name == name:
+            return tp
+    raise AssertionError(f"program {name} not traced")
+
+
+def test_burn_down_newton_identity_not_captured(self_audit):
+    # Pre-fix, pf/newton.py captured jnp.eye(2n) as a closure constant
+    # (445 KB at the registry's 118-bus case; 3.2 GB at 10k buses).
+    # The identity is now built in-program — no const above 100 KB.
+    tp = _program(self_audit, "pf/newton/dense")
+    biggest = max((getattr(c, "nbytes", 0) for c in tp.consts), default=0)
+    assert biggest < 100_000, f"largest captured const {biggest} bytes"
+
+
+def test_burn_down_fdlf_and_dc_factors_ride_as_arguments(self_audit):
+    # Pre-fix, the FDLF B'/B'' LU pair and the DC screen's B' LU were
+    # closure constants (320 KB each at the registry's 200-bus case,
+    # 64/32 MB per topology at 2000 buses).  They now thread as runtime
+    # arguments: multiple array args, small residual consts.
+    for name in ("pf/fdlf", "pf/dc/solve", "pf/dc/screen"):
+        tp = _program(self_audit, name)
+        assert len(tp.in_avals) >= 2, name
+        biggest = max((getattr(c, "nbytes", 0) for c in tp.consts),
+                      default=0)
+        assert biggest < 100_000, f"{name}: largest const {biggest} bytes"
+
+
+def test_krylov_bf16_boundary_is_argument_threaded(self_audit):
+    # The declared bf16 boundary is the preconditioner PAIR, and it
+    # enters as arguments (not constants): the first two in_avals are
+    # bfloat16 squares.
+    tp = _program(self_audit, "pf/krylov")
+    assert [a.dtype.name for a in tp.in_avals[:2]] == \
+        ["bfloat16", "bfloat16"]
+
+
+def test_fdlf_solver_still_correct_after_arg_threading():
+    # The GP003 burn-down rewired fdlf's jit boundary; pin numerics:
+    # solve/vmap-over-status behave exactly as before the refactor.
+    import jax
+    import jax.numpy as jnp
+
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.fdlf import make_fdlf_solver
+    from freedm_tpu.pf.krylov import true_mismatch
+    from freedm_tpu.pf.newton import make_newton_solver
+
+    sys_ = synthetic_mesh(30, seed=2)
+    solve, _ = make_fdlf_solver(sys_)
+    r = solve()
+    assert bool(r.converged)
+    assert true_mismatch(sys_, r) < 1e-7
+    # status-traced path (outage) + vmap over a status batch.
+    status = np.ones(sys_.n_branch)
+    status[0] = 0.0
+    r1 = solve(status=status)
+    assert float(r1.mismatch) < 1e-6
+    batch = jnp.asarray(np.stack([np.ones(sys_.n_branch), status]))
+    rb = jax.vmap(lambda s: solve(status=s))(batch)
+    assert np.allclose(np.asarray(rb.v)[1], np.asarray(r1.v), atol=1e-9)
+    # Cross-check against dense Newton on the base case.
+    nsolve, _ = make_newton_solver(sys_, backend="dense")
+    rn = nsolve()
+    assert np.allclose(np.asarray(r.v), np.asarray(rn.v), atol=1e-6)
+
+
+def test_dc_solver_still_correct_after_arg_threading():
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.dc import make_dc_solver
+
+    sys_ = synthetic_mesh(30, seed=2)
+    dc = make_dc_solver(sys_)
+    single = dc.solve()
+    lanes = dc.solve(np.stack([np.asarray(sys_.p_inj)] * 3))
+    assert np.allclose(np.asarray(lanes.theta)[0],
+                       np.asarray(single.theta), atol=1e-12)
+    scr = dc.screen_outages(np.arange(4))
+    assert scr.theta.shape == (4, sys_.n_bus)
+    assert np.all(np.isfinite(np.asarray(scr.severity))
+                  | np.asarray(scr.islanded))
+
+
+def test_probe_config_keys_reach_the_probe(tmp_path):
+    # The probe-* GlobalConfig keys are live, not dead plumbing: a cfg
+    # file raising probe-const-mb above the fixture's 1.6 MB capture
+    # silences GP003 for the same registry.
+    reg = _registry(tmp_path, GP003_BAD)
+    assert _rules_of(_findings(reg)) == ["GP003"]
+    cfg = tmp_path / "freedm.cfg"
+    cfg.write_text("probe-const-mb = 2.0\n")
+    res = run_probe(registry_file=reg, inventory_mode="skip",
+                    config_path=str(cfg))
+    assert res.findings == []
+
+
+def test_gp006_zero_baseline_scalar_has_absolute_slack(tmp_path, capsys):
+    # A program whose recorded consts_bytes is 0 must tolerate a
+    # few-byte lowering change (jax-version noise), while a real blowup
+    # past both the slack and the relative tolerance still fails.
+    reg = _registry(tmp_path, GP001_CLEAN)
+    inv = tmp_path / "inv.json"
+    assert main(["--registry-file", reg, "--inventory", str(inv),
+                 "--write-inventory"]) == 0
+    capsys.readouterr()
+    d = json.loads(inv.read_text())
+    prog = d["programs"]["fix/pure64"]
+    assert prog["consts_bytes"] == 0
+    prog["consts_bytes"] = 8  # 8-byte noise vs a zero baseline: pass
+    inv.write_text(json.dumps(d))
+    assert main(["--registry-file", reg, "--inventory", str(inv)]) == 0
+    capsys.readouterr()
+    prog["consts_bytes"] = 10_000_000  # a real blowup: fail
+    inv.write_text(json.dumps(d))
+    assert main(["--registry-file", reg, "--inventory", str(inv)]) == 1
+    assert "consts_bytes drifted" in capsys.readouterr().out
+
+
+def test_rules_subset_filters_surface_findings(tmp_path):
+    reg = _registry(tmp_path, """
+        import numpy as np
+        from freedm_tpu.tools.ir_rules.base import F64Surface
+        PROGRAM_REGISTRY = []
+        def bad_oracle():
+            return (lambda x: np.asarray(x, np.float32)), \\
+                (np.ones(3, np.float64),)
+        F64_SURFACES = [
+            F64Surface("fix/oracle", "freedm_tpu/pf/krylov.py",
+                       bad_oracle),
+        ]
+    """)
+    # GP001 selected -> the surface demotion reports ...
+    assert _rules_of(_findings(reg, rules=["GP001"])) == ["GP001"]
+    # ... excluded -> it must not leak through a GP002-only run.
+    assert _findings(reg, rules=["GP002"]) == []
+
+
+def test_list_programs_and_internal_error_exit(tmp_path, capsys):
+    assert main(["--list-programs"]) == 0
+    out = capsys.readouterr().out
+    assert "pf/newton/dense" in out and "f64-surface" in out
+    # A broken registry file is a 2 (internal error), never a clean 0.
+    bad = tmp_path / "broken.py"
+    bad.write_text("this is not python ][")
+    assert main(["--registry-file", str(bad), "--no-inventory"]) == 2
