@@ -148,14 +148,20 @@ def bench_mc_1024(maker=make_newton_solver, max_iter=6):
 
 def bench_nr_10k_mesh():
     """The 10k-bus MESHED solve (VERDICT r4 item 1): matrix-free
-    Newton-GMRES with the FDLF-inverse preconditioner (``pf/krylov``).
-    Returns (ms/solve, f64-oracle mismatch) — the oracle is evaluated on
-    host in double precision so the reported accuracy is real, not f32
+    Newton-GMRES with the FDLF preconditioner (``pf/krylov``; the
+    ``kind="auto"`` pair — LU at this size on every backend, which is
+    the fix for the bf16 inverse pair's ~400 MB blowup).  Returns
+    (ms/solve, f64-oracle mismatch) — the oracle is evaluated on host
+    in double precision so the reported accuracy is real, not f32
     evaluation noise."""
+    from freedm_tpu.pf.krylov import build_fdlf_precond
+
     sys_ = synthetic_mesh(10_000, seed=4, load_mw=2.0, chord_frac=0.3)
+    pre = build_fdlf_precond(sys_, kind="auto")
     # inner=16 measured both faster and slightly more accurate than the
     # default 24 at this size (178 vs 212 ms, 8.7e-6 vs 9.8e-6 true).
-    solve, _ = make_krylov_solver(sys_, max_iter=15, inner_iters=16)
+    solve, _ = make_krylov_solver(sys_, max_iter=15, inner_iters=16,
+                                  precond=pre)
     r = solve()
     assert bool(r.converged), f"10k mesh diverged: {float(r.mismatch)}"
     record_result(r)  # already host-side via the assert — no extra sync
@@ -163,7 +169,8 @@ def bench_nr_10k_mesh():
     return dt * 1000.0, true_mismatch(sys_, r)
 
 
-def bench_nr_2k_krylov_lanes(lanes=256, outer=8, inner=16):
+def bench_nr_2k_krylov_lanes(lanes=256, outer=8, inner=16,
+                             precision="auto"):
     """Lane-batched full-accuracy NR at 2k buses (VERDICT r4 item 5):
     vmap over per-lane injections turns the preconditioner matvec into
     an MXU matmul and amortizes every kernel launch.  Returns
@@ -171,10 +178,13 @@ def bench_nr_2k_krylov_lanes(lanes=256, outer=8, inner=16):
     preconditioner matvecs (outer·inner applications of two [n, n]
     matrices per lane) against v5e's 197 TFLOP/s bf16 peak — solver
     workloads are latency/launch-bound, so single-digit MFU is the
-    honest number, not a typo."""
+    honest number, not a typo.  ``precision`` threads --pf-precision
+    (the mfu section measures "mixed" explicitly)."""
     sys_ = synthetic_mesh(2000, seed=4, load_mw=2.0, chord_frac=1.0)
     n = sys_.n_bus
-    _, solve_fixed = make_krylov_solver(sys_, max_iter=outer, inner_iters=inner)
+    _, solve_fixed = make_krylov_solver(sys_, max_iter=outer,
+                                        inner_iters=inner,
+                                        precision=precision)
     rng = np.random.default_rng(0)
     scale = rng.uniform(0.9, 1.1, (lanes, 1))
     p = jnp.asarray(scale * sys_.p_inj[None, :])
@@ -217,6 +227,101 @@ def bench_n1_2000bus_krylov(k=256):
     record_result(r)
     dt = _time(lambda: screen(status), lambda r: r.v, reps=5)
     return dt * 1000.0
+
+
+#: r05 baseline for the flagship krylov lane throughput — the
+#: denominator of the gated ``nr_2000bus_krylov_lane_speedup`` row
+#: (ISSUE 14 acceptance: >= 5x, i.e. >= 9380 lane solves/s, or the
+#: >= 10% MFU alternative).
+KRYLOV_LANE_RATE_R05 = 1876.0
+
+
+def bench_krylov_donation(outer=8, inner=16):
+    """Donation on/off head-to-head: the same 2000-bus matrix-free
+    solver (shared preconditioner build, identical math) compiled with
+    and without ``donate_argnums`` on its iteration program.  What
+    donation deletes is the result-buffer allocation + HBM round trip
+    per solve; the ratio is the honest measure of how much that was
+    costing on this backend."""
+    from freedm_tpu.pf.krylov import build_fdlf_precond
+
+    sys_ = synthetic_mesh(2000, seed=4, load_mw=2.0, chord_frac=1.0)
+    pre = build_fdlf_precond(sys_)
+    on, _ = make_krylov_solver(sys_, max_iter=outer, inner_iters=inner,
+                               precond=pre, donate=True)
+    off, _ = make_krylov_solver(sys_, max_iter=outer, inner_iters=inner,
+                                precond=pre, donate=False)
+    r = on()
+    assert bool(r.converged), "donation head-to-head diverged"
+    ms_on = _time(on, lambda r: r.v, reps=5) * 1000.0
+    ms_off = _time(off, lambda r: r.v, reps=5) * 1000.0
+    return {
+        "nr_2000bus_krylov_donation_on_ms": round(ms_on, 2),
+        "nr_2000bus_krylov_donation_off_ms": round(ms_off, 2),
+        "nr_2000bus_krylov_donation_speedup": round(ms_off / ms_on, 3),
+    }
+
+
+def bench_mfu(lanes=256, with_10k=False) -> dict:
+    """``--sections mfu``: the solver-core MFU attack rows (ROADMAP
+    "Raw speed"; ISSUE 14 acceptance gates).
+
+    - the flagship krylov lane batch at ``--pf-precision mixed`` (the
+      production default on tpu/gpu): lane throughput, model MFU, and
+      the speedup ratio against the r05 baseline
+      (:data:`KRYLOV_LANE_RATE_R05`) that ``perf_gate`` pins with
+      ``--floor nr_2000bus_krylov_lane_speedup=5``;
+    - the same batch at ``--pf-precision f64`` — the in-process
+      mixed-vs-f64 ratio, so the mixed win is measured against the
+      same s-step core, not against history alone;
+    - mixed-vs-f64 solution agreement + identical convergence flags
+      (the tolerance contract, asserted here as well as in tests);
+    - the 10k-bus mesh wall (``--mfu-10k``; gated ceiling
+      ``--floor nr_10000bus_mesh_solve_ms=60``) with its host-f64
+      oracle mismatch;
+    - the donation on/off head-to-head.
+    """
+    out: dict = {}
+    rate_mixed, mfu = bench_nr_2k_krylov_lanes(lanes=lanes,
+                                               precision="mixed")
+    rate_f64, _ = bench_nr_2k_krylov_lanes(lanes=lanes, precision="f64")
+    out.update({
+        "nr_2000bus_krylov_batch_lanes": lanes,
+        "nr_2000bus_krylov_batch256_lane_solves_per_sec": round(
+            rate_mixed, 1),
+        "nr_2000bus_krylov_mfu_pct": round(mfu, 2),
+        "nr_2000bus_krylov_lane_speedup": round(
+            rate_mixed / KRYLOV_LANE_RATE_R05, 2),
+        "nr_2000bus_krylov_f64_lane_solves_per_sec": round(rate_f64, 1),
+        "nr_2000bus_krylov_mixed_vs_f64_speedup": round(
+            rate_mixed / rate_f64, 2),
+    })
+
+    # Mixed-vs-f64 equivalence at the bench's own scale: identical
+    # convergence flags, solutions inside the documented 2e-4 pu bound.
+    sys_ = synthetic_mesh(2000, seed=4, load_mw=2.0, chord_frac=1.0)
+    sm, _ = make_krylov_solver(sys_, max_iter=15, precision="mixed")
+    sf, _ = make_krylov_solver(sys_, max_iter=15, precision="f64")
+    rm, rf = sm(), sf()
+    assert bool(rm.converged) == bool(rf.converged), \
+        "mixed changed the convergence verdict"
+    dv = float(jnp.max(jnp.abs(rm.v - rf.v)))
+    record_result(rm)  # fallback lanes land on pf_precision_fallbacks
+    out.update({
+        "mixed_vs_f64_max_dv_pu": float(f"{dv:.2e}"),
+        "mixed_within_tolerance": bool(dv < 2e-4),
+        "mixed_fallback_iterations": int(np.asarray(rm.fallbacks)),
+    })
+
+    if with_10k:
+        nr10k_ms, nr10k_true = bench_nr_10k_mesh()
+        out.update({
+            "nr_10000bus_mesh_solve_ms": round(nr10k_ms, 1),
+            "nr_10000bus_mesh_true_mismatch_pu": float(
+                f"{nr10k_true:.2e}"),
+        })
+    out.update(bench_krylov_donation())
+    return out
 
 
 def bench_lb_256():
@@ -1412,7 +1517,10 @@ def main(argv=None) -> None:
     ap.add_argument(
         "--sections", default="solvers,serve,qsts",
         help="comma list of sections to run: solvers, serve, qsts, quick, "
-             "mesh, sparse, cache (default solvers,serve,qsts; quick is "
+             "mesh, sparse, cache, mfu (default solvers,serve,qsts; mfu is "
+             "the solver-core MFU gate set — krylov lane throughput at "
+             "mixed precision, mixed-vs-f64 head-to-head, donation "
+             "on/off, and with --mfu-10k the 10k-bus wall; quick is "
              "the CI perf-gate subset; mesh is the device-scaling sweep — "
              "force virtual CPU devices with "
              "XLA_FLAGS=--xla_force_host_platform_device_count=N; sparse "
@@ -1428,19 +1536,29 @@ def main(argv=None) -> None:
                          "solves — ~10 min on a 2-vCPU host, milliseconds "
                          "on a TPU; the 2000-bus acceptance rows always "
                          "run)")
+    ap.add_argument("--mfu-lanes", type=int, default=256, metavar="N",
+                    help="lane count for the mfu section's krylov batch "
+                         "(default 256 — the gated row; shrink it for a "
+                         "CPU smoke run)")
+    ap.add_argument("--mfu-10k", action="store_true",
+                    help="include the mfu section's 10k-bus mesh wall row "
+                         "(the <60 ms acceptance ceiling; minutes on a "
+                         "small CPU host, like --sparse-10k)")
     args = ap.parse_args(argv)
     sections = {s.strip() for s in args.sections.split(",") if s.strip()}
     unknown = sections - {"solvers", "serve", "qsts", "quick", "mesh",
-                          "sparse", "cache"}
+                          "sparse", "cache", "mfu"}
     if unknown or not sections:
         raise SystemExit(
             f"--sections needs a non-empty subset of solvers,serve,qsts,"
-            f"quick,mesh,sparse,cache; got {args.sections!r}"
+            f"quick,mesh,sparse,cache,mfu; got {args.sections!r}"
         )
 
     obj: dict = {}
     if "serve" in sections:
         obj["serve"] = bench_serve(duration_s=args.serve_duration)
+    if "mfu" in sections:
+        obj["mfu"] = bench_mfu(lanes=args.mfu_lanes, with_10k=args.mfu_10k)
     if "cache" in sections:
         obj["cache"] = bench_cache()
     if "qsts" in sections:
@@ -1509,6 +1627,17 @@ def main(argv=None) -> None:
         obj["vs_baseline"] = (
             round(c["serve_cache_delta_speedup"] / 3.0, 2)
             if c["serve_cache_delta_speedup"] else None
+        )
+    elif "metric" not in obj and "mfu" in obj:
+        # mfu-only invocation: the headline is the krylov lane speedup
+        # over the r05 baseline (ISSUE 14 acceptance: >= 5x, or the
+        # >= 10% MFU alternative).
+        m = obj["mfu"]
+        obj["metric"] = "nr_2000bus_krylov_lane_speedup"
+        obj["value"] = m["nr_2000bus_krylov_lane_speedup"]
+        obj["unit"] = "x vs r05 f64 inner"
+        obj["vs_baseline"] = round(
+            m["nr_2000bus_krylov_lane_speedup"] / 5.0, 2
         )
     elif "metric" not in obj and "mesh" in obj:
         # mesh-only invocation: the headline is QSTS throughput speedup

@@ -271,6 +271,15 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "pattern-reuse Krylov solves, or auto by case "
                          "size (default auto; serves the pf/N-1 engines "
                          "and the QSTS scenario default)")
+    ap.add_argument("--pf-precision", default=None,
+                    choices=("f64", "mixed", "auto"),
+                    help="inner-solve precision for the Krylov-based "
+                         "power-flow backends: f64 full-precision inner "
+                         "GMRES, mixed f32 inner under the working-dtype "
+                         "acceptance oracle with per-lane f64 fallback, "
+                         "or auto by backend (default auto; serves the "
+                         "pf/N-1 engines and the QSTS scenario default, "
+                         "docs/solvers.md)")
     ap.add_argument("--qsts-workers", type=int, default=None, metavar="N",
                     help="background workers for QSTS scenario jobs "
                          "(default 1; jobs ride the serve port)")
@@ -335,6 +344,7 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("metrics_port", "metrics_port"), ("events_log", "events_log"),
         ("trace_log", "trace_log"), ("profile_metrics", "profile_metrics"),
         ("pf_backend", "pf_backend"),
+        ("pf_precision", "pf_precision"),
         ("probe_inventory", "probe_inventory"),
         ("probe_const_mb", "probe_const_mb"),
         ("probe_flops_tol", "probe_flops_tol"),
@@ -653,6 +663,7 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             cache_ttl_s=cfg.serve_cache_ttl_s,
             delta_max_rank=cfg.serve_delta_max_rank,
             pf_backend=cfg.pf_backend,
+            pf_precision=cfg.pf_precision,
             # --mesh-devices also shards the engines' solver lanes
             # (docs/scaling.md); 0 keeps every engine single-device.
             mesh_devices=mesh_n,

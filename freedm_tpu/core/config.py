@@ -303,6 +303,14 @@ class GlobalConfig:
     # crossover).  Threads through the serve engines AND the QSTS
     # scenario engine default (docs/solvers.md).
     pf_backend: str = "auto"
+    # Inner-solve precision for the Krylov-based power-flow backends
+    # (pf/krylov.py, pf/sparse.py): "f64" runs the inner GMRES in the
+    # working dtype, "mixed" runs it in f32 under the working-dtype
+    # masked-mismatch acceptance oracle with per-lane f64 fallback
+    # (docs/solvers.md "Mixed precision"), "auto" picks mixed on
+    # tpu/gpu and f64 on cpu.  Same threading convention as
+    # pf-backend: serve engines + QSTS scenario default.
+    pf_precision: str = "auto"
     # QSTS scenario jobs (freedm_tpu.scenarios), exposed on the serve
     # port as POST /v1/qsts + GET /v1/jobs/<id>: background worker
     # count (the solvers share one device — 1 is the right default),

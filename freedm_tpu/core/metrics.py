@@ -677,9 +677,16 @@ PF_ITERATIONS = REGISTRY.histogram(
 PF_RESIDUAL = REGISTRY.gauge(
     "pf_residual_pu", "Final masked power mismatch of the last recorded solve",
     labels=("solver",))
+PF_FALLBACKS = REGISTRY.counter(
+    "pf_precision_fallbacks_total",
+    "Newton iterations re-run at full precision after a mixed-precision "
+    "inner solve stalled a lane (--pf-precision mixed; summed over lanes "
+    "from already-materialized result tuples)",
+    labels=("solver",))
 for _solver in ("newton", "fdlf", "krylov"):
     PF_ITERATIONS.labels(_solver)
     PF_RESIDUAL.labels(_solver)
+    PF_FALLBACKS.labels(_solver)
 
 # -- broker / runtime -------------------------------------------------------
 BROKER_ROUNDS = REGISTRY.counter(
@@ -876,6 +883,11 @@ def observe_pf_result(solver: str, result) -> None:
     its = np.ravel(np.asarray(result.iterations))
     PF_ITERATIONS.labels(solver).observe(its)
     PF_RESIDUAL.labels(solver).set(float(np.max(np.asarray(result.mismatch))))
+    fb = getattr(result, "fallbacks", None)
+    if fb is not None:
+        total = int(np.sum(np.asarray(fb)))
+        if total:
+            PF_FALLBACKS.labels(solver).inc(total)
 
 
 def reset_for_tests() -> None:

@@ -39,6 +39,36 @@ without ever materializing J:
 * **Inexact Newton.**  The inner iteration runs a fixed
   ``inner_iters`` sweeps (no data-dependent control flow); the outer
   loop self-corrects whatever the inner solve leaves.
+* **s-step (blocked) orthogonalization.**  The inner GMRES generates
+  ``block_size`` basis candidates per step (a normalized power chain of
+  the preconditioned operator) and orthogonalizes them as a block: one
+  tall-skinny GEMM pair against the stored basis plus a ridge-guarded
+  Cholesky-QR with a reorthogonalization pass (:func:`_pgmres_block`).
+  The latency-bound one-vector-at-a-time matvec/dot recurrence of the
+  classic cycle (:func:`_pgmres`, kept as the scalar reference) becomes
+  batched GEMM work — the shape the MXU wants.
+* **Mixed-precision inner solves** (``precision="mixed"``, the
+  ``--pf-precision`` key).  The Arnoldi matvecs and preconditioner
+  applies run in float32 (bf16 preconditioner storage as before) under
+  the default matmul precision, while the outer Newton step keeps the
+  float64/working-dtype masked-mismatch test as the ACCEPTANCE oracle:
+  every mixed update is re-evaluated at full precision against the
+  lane's best iterate (Newton is legitimately non-monotone far from
+  the solution, so progress is windowed — ``_MIXED_STALL_STEPS``
+  consecutive no-progress steps, not one), and a stalled lane falls
+  back to the full-precision inner solve from its best iterate for
+  its remaining Newton iterations (per-lane under ``vmap`` — batched
+  ``while_loop`` lanes mask independently).  A bad low-precision
+  solve can therefore never change the convergence contract — only
+  cost retries, counted on the result's ``fallbacks`` field and the
+  ``pf_precision_fallbacks_total`` metric.
+* **Buffer donation.**  The jitted iteration programs declare
+  ``donate_argnums`` on the scheduled-injection buffers (which alias
+  the realized p/q results), so steady-state solves re-use HBM instead
+  of round-tripping fresh result allocations; the convenience wrappers
+  defensively copy caller arrays so donation never destroys a buffer
+  the caller still owns (gridprobe GP004 audits the declarations
+  against the compiled programs).
 
 Accuracy envelope (measured): in float64 (CPU tests) the solver reaches
 1e-8-level mismatch and matches the dense Newton oracle to 1e-14.  In
@@ -64,6 +94,7 @@ north-star scale) converges the same way — 6 Newton iterations,
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional
 
 import jax
@@ -77,6 +108,63 @@ from freedm_tpu.utils import cplx
 
 
 _NS_TARGET = 0.05  # ‖I − A·X‖_max good enough for a preconditioner
+
+#: ``--pf-precision`` vocabulary: ``"f64"`` runs the inner GMRES in the
+#: working dtype (the classic path), ``"mixed"`` runs it in f32 under
+#: default matmul precision with the full-precision masked-mismatch
+#: acceptance oracle + per-lane fallback, ``"auto"`` picks ``"mixed"``
+#: on matmul-rich backends (tpu/gpu) and ``"f64"`` on cpu.
+PF_PRECISIONS = ("f64", "mixed", "auto")
+
+
+def resolve_precision(precision: str, backend: Optional[str] = None) -> str:
+    """Resolve a ``--pf-precision`` value to ``"f64"`` or ``"mixed"``
+    (typed error on unknown values).  ``backend`` defaults to the live
+    jax backend; pass it explicitly in tests to pin either branch."""
+    if precision not in PF_PRECISIONS:
+        raise ValueError(
+            f"unknown pf precision {precision!r} "
+            f"(have: {', '.join(PF_PRECISIONS)})"
+        )
+    if precision == "auto":
+        backend = backend or jax.default_backend()
+        return "mixed" if backend in ("tpu", "gpu") else "f64"
+    return precision
+
+
+#: Mixed-precision acceptance oracle: after every mixed Newton update
+#: the FULL-precision masked mismatch is re-evaluated; a step counts as
+#: progress only if it shrank the lane's best-so-far mismatch below
+#: this fraction.  Newton is legitimately non-monotone far from the
+#: solution (a 2000-bus f32 flat start overshoots on its second step
+#: before converging), so single-step rejection would kill healthy
+#: trajectories — progress is judged against the BEST iterate instead.
+_MIXED_ACCEPT_RATIO = 0.9
+
+#: ...and a lane falls back to the full-precision inner solve once
+#: this many CONSECUTIVE mixed steps fail the progress test — resuming
+#: from its best full-precision-evaluated iterate, so a stalled mixed
+#: phase costs at most this many wasted Newton steps.
+_MIXED_STALL_STEPS = 2
+
+#: ``kind="auto"`` bus-count threshold: at and above this many buses
+#: the explicit-inverse pair is a liability even on the MXU — the bf16
+#: storage alone is 2·2n² bytes (~400 MB at 10k buses, the blowup this
+#: constant fixes) and the Newton–Schulz build is O(n³) GEMM sweeps —
+#: so ``auto`` selects the LU factor pair instead.  Below it the
+#: streaming-inverse trade documented in the module docstring holds
+#: (the pair stays ≤ ~67 MB at 4096 buses).
+PRECOND_INVERSE_MAX_BUSES = 4096
+
+
+def default_precond_kind(n_bus: int) -> str:
+    """The kind an UNSPECIFIED ``build_fdlf_precond`` build resolves
+    to: explicit inverses below :data:`PRECOND_INVERSE_MAX_BUSES`
+    buses, the LU pair at/above — the quadratic bf16-pair blowup is
+    backend-independent, so the guard must cover the default
+    construction paths (every solver that builds its own pair), not
+    just callers who opt into ``kind="auto"``."""
+    return "inverse" if n_bus < PRECOND_INVERSE_MAX_BUSES else "lu"
 
 
 @jax.jit
@@ -154,18 +242,25 @@ class FdlfPrecond(NamedTuple):
 
 
 #: ``kind`` vocabulary for :func:`build_fdlf_precond`; "auto" picks
-#: "inverse" on matmul-rich backends (tpu/gpu) and "lu" on cpu.
+#: "lu" on cpu (the Newton–Schulz GEMM build only amortizes on a
+#: systolic array) AND at/above :data:`PRECOND_INVERSE_MAX_BUSES` buses
+#: on any backend (the bf16 inverse pair blows up quadratically —
+#: ~400 MB at 10k buses); "inverse" everywhere else.
 PRECOND_KINDS = ("inverse", "lu", "auto")
 
 
-def _resolve_precond_kind(kind: str) -> str:
+def _resolve_precond_kind(kind: str, n_bus: int = 0,
+                          backend: Optional[str] = None) -> str:
     if kind not in PRECOND_KINDS:
         raise ValueError(
             f"unknown preconditioner kind {kind!r} "
             f"(have: {', '.join(PRECOND_KINDS)})"
         )
     if kind == "auto":
-        return "lu" if jax.default_backend() == "cpu" else "inverse"
+        backend = backend or jax.default_backend()
+        if backend == "cpu" or n_bus >= PRECOND_INVERSE_MAX_BUSES:
+            return "lu"
+        return "inverse"
     return kind
 
 
@@ -182,24 +277,35 @@ def build_fdlf_precond(
     sys: BusSystem,
     dtype: Optional[jnp.dtype] = None,
     precond_dtype: jnp.dtype = jnp.bfloat16,
-    kind: str = "inverse",
+    kind: Optional[str] = None,
 ):
     """Build the FDLF preconditioner pair (see :class:`FdlfPrecond`).
 
     The classic decoupled approximation J ≈ blockdiag(diag(V)·B′,
-    diag(V)·B″), built once per (case, dtype).  ``kind="inverse"``
-    inverts both matrices (Newton–Schulz GEMMs with a host LAPACK
-    fallback, :func:`_precond_inv`) and stores them in
+    diag(V)·B″), built once per (case, dtype).  ``kind=None`` (the
+    default) resolves by case size alone
+    (:func:`default_precond_kind`: inverse below
+    :data:`PRECOND_INVERSE_MAX_BUSES` buses, LU at/above — the
+    quadratic bf16-pair blowup guard covers default builds).
+    ``kind="inverse"`` inverts both matrices (Newton–Schulz GEMMs
+    with a host LAPACK fallback, :func:`_precond_inv`) and stores
+    them in
     ``precond_dtype``; ``kind="lu"`` LU-factorizes them in the working
     dtype (``precond_dtype`` is ignored — triangular solves need the
-    full-precision factors); ``kind="auto"`` picks by backend.  Both
+    full-precision factors); ``kind="auto"`` picks by backend and case
+    size (LU on cpu, and at/above :data:`PRECOND_INVERSE_MAX_BUSES`
+    buses on any backend, where the bf16 inverse pair's 2·2n² bytes —
+    ~400 MB at 10k buses — stops being a bandwidth win).  Both
     the matrix-free solver here and the BCSR sparse path
     (:mod:`freedm_tpu.pf.sparse`) accept a prebuilt pair via their
     ``precond=`` argument, so one build can serve several solvers on
     the same case.
     """
     rdtype = cplx.default_rdtype(dtype)
-    kind = _resolve_precond_kind(kind)
+    if kind is None:
+        kind = default_precond_kind(sys.n_bus)
+    else:
+        kind = _resolve_precond_kind(kind, n_bus=sys.n_bus)
     parts = decoupled_parts(sys, rdtype)
     with jax.default_matmul_precision("highest"):
         b_p = parts.b_prime(None)
@@ -276,6 +382,106 @@ def _pgmres(a_op, m_op, b, m: int):
     return z_store.T @ y
 
 
+def _pgmres_block(a_op, m_op, b, m: int, s: int = 4):
+    """s-step right-preconditioned GMRES, one cycle, block-orthogonalized.
+
+    Communication-avoiding form of :func:`_pgmres` (same search space,
+    same guarded-breakdown posture, same dense least-squares finish):
+
+    - **s-vector generation per step.**  Each block produces ``s``
+      candidates by a normalized power chain of the preconditioned
+      operator starting from the newest basis vector — the serial
+      matvec/precondition chain is inherent to Krylov, but everything
+      around it batches.
+    - **Blocked orthogonalization.**  The whole ``[s, n]`` candidate
+      block orthogonalizes against the stored basis via one tall-skinny
+      GEMM pair, twice (the classic two-pass correction), then
+      orthonormalizes internally by ridge-guarded Cholesky-QR with a
+      reorthogonalization pass (CholQR2).  The per-iteration
+      matvec/dot/normalize recurrence of modified Gram-Schmidt — ``m``
+      kernel-launch-bound round trips — becomes ``m/s`` GEMM steps.
+    - **Exact least-squares finish without Hessenberg bookkeeping.**
+      Every generated direction's preconditioned vector ``z_j`` and its
+      image ``w_j = A z_j`` are recorded as computed, so the GMRES
+      minimizer over the span is ``min_y ‖b − W y‖`` directly; with
+      ``b = β v₀`` and the candidates orthogonalized into the basis V,
+      that equals the small dense problem ``min_y ‖β e₁ − (V Wᵀ) y‖``
+      — one GEMM for the projection, one ``lstsq``, ``x = Zᵀ y``.
+
+    ``m`` is rounded up to a multiple of ``s`` (the Krylov dimension
+    actually built).  Dead chains (breakdown: the space is exhausted)
+    freeze exactly like :func:`_pgmres`'s guarded normalizations —
+    their stored vectors zero out and the least squares ignores them.
+    """
+    dtype = b.dtype
+    nvec = b.shape[0]
+    s = max(1, min(int(s), int(m)))
+    nb = -(-int(m) // s)
+    mm = nb * s
+    tiny = jnp.asarray(jnp.finfo(dtype).tiny, dtype)
+    eps = jnp.asarray(jnp.finfo(dtype).eps, dtype)
+    brk = jnp.asarray(1e-30, dtype)
+    beta = jnp.linalg.norm(b)
+    safe_beta = jnp.maximum(beta, tiny)
+
+    v_basis = jnp.zeros((mm + 1, nvec), dtype).at[0].set(b / safe_beta)
+    z_store = jnp.zeros((mm, nvec), dtype)
+    w_store = jnp.zeros((mm, nvec), dtype)
+    valid = jnp.zeros(mm + 1, dtype).at[0].set(1.0)
+    eye_s = jnp.eye(s, dtype=dtype)
+
+    def block(carry, k):
+        v_basis, z_store, w_store, valid, alive = carry
+        j0 = k * s
+        u = jax.lax.dynamic_index_in_dim(v_basis, j0, keepdims=False)
+        zs, ws = [], []
+        a = alive * jax.lax.dynamic_index_in_dim(valid, j0, keepdims=False)
+        for _ in range(s):  # the serial chain; s is static and small
+            z = m_op(u)
+            w = a_op(z)
+            zs.append(z * a)
+            ws.append(w * a)
+            nrm = jnp.linalg.norm(w)
+            a = a * (nrm > brk).astype(dtype)
+            u = w / jnp.maximum(nrm, tiny)
+        z_blk = jnp.stack(zs)
+        w_blk = jnp.stack(ws)
+        z_store = jax.lax.dynamic_update_slice(z_store, z_blk, (j0, 0))
+        w_store = jax.lax.dynamic_update_slice(w_store, w_blk, (j0, 0))
+        # Two-pass block orthogonalization against the valid basis —
+        # [s, mm+1] x [mm+1, n] GEMMs, not per-vector matvecs.
+        mask = valid * (jnp.arange(mm + 1) <= j0).astype(dtype)
+        vb = v_basis * mask[:, None]
+        q = w_blk
+        for _ in range(2):
+            q = q - (q @ vb.T) @ vb
+        # CholQR2: Gram + Cholesky + triangular solve, twice.  The ridge
+        # keeps a dead row (exhausted space) from breaking the factor;
+        # dead rows are masked out of the basis afterwards.
+        newv = jnp.ones(s, dtype)
+        for _ in range(2):
+            g = q @ q.T
+            d = jnp.diagonal(g)
+            newv = newv * (d > brk).astype(dtype)
+            ridge = jnp.maximum(jnp.max(d), tiny) * eps * s + tiny
+            l_fac = jnp.linalg.cholesky(g + ridge * eye_s)
+            q = jax.scipy.linalg.solve_triangular(l_fac, q, lower=True)
+        q = jnp.where(jnp.isfinite(q), q, 0.0) * newv[:, None]
+        v_basis = jax.lax.dynamic_update_slice(v_basis, q, (j0 + 1, 0))
+        valid = jax.lax.dynamic_update_slice(valid, newv, (j0 + 1,))
+        return (v_basis, z_store, w_store, valid, a), None
+
+    (v_basis, z_store, w_store, valid, _), _ = jax.lax.scan(
+        block,
+        (v_basis, z_store, w_store, valid, jnp.asarray(1.0, dtype)),
+        jnp.arange(nb),
+    )
+    h_mat = (v_basis * valid[:, None]) @ w_store.T
+    rhs = jnp.zeros(mm + 1, dtype).at[0].set(beta)
+    y, *_ = jnp.linalg.lstsq(h_mat, rhs)
+    return z_store.T @ y
+
+
 class KrylovResult(NamedTuple):
     """Power-flow solution in per-unit (matrix-free variant of
     :class:`freedm_tpu.pf.newton.NewtonResult` — same fields)."""
@@ -287,6 +493,9 @@ class KrylovResult(NamedTuple):
     iterations: jax.Array
     converged: jax.Array
     mismatch: jax.Array
+    #: [] int32: Newton iterations re-run at full precision after the
+    #: mixed-precision inner solve stalled a lane (0 on the f64 path).
+    fallbacks: jax.Array
 
 
 def make_krylov_solver(
@@ -297,10 +506,13 @@ def make_krylov_solver(
     dtype: Optional[jnp.dtype] = None,
     precond_dtype: jnp.dtype = jnp.bfloat16,
     precond=None,
+    precision: str = "auto",
+    block_size: int = 4,
+    donate: bool = True,
     mesh=None,
     batch_spec=None,
 ):
-    """Compile the matrix-free Newton solver with Richardson inner.
+    """Compile the matrix-free Newton solver with s-step GMRES inner.
 
     Returns ``(solve, solve_fixed)`` with the same call signature as
     :func:`freedm_tpu.pf.newton.make_newton_solver` (injections, branch
@@ -308,7 +520,23 @@ def make_krylov_solver(
 
     ``inner_iters`` is the Krylov dimension of the inner solve — the
     per-Newton-step work is bounded by that many JVPs + preconditioner
-    matvecs.
+    matvecs; ``block_size`` is the s-step block the inner cycle
+    generates/orthogonalizes at a time (:func:`_pgmres_block`).
+
+    ``precision`` (the ``--pf-precision`` key): ``"f64"`` runs the
+    inner solve in the working dtype; ``"mixed"`` runs it in f32 under
+    default matmul precision with the full-precision masked-mismatch
+    acceptance oracle and per-lane f64 fallback (module docstring);
+    ``"auto"`` resolves by backend (:func:`resolve_precision`).  On the
+    fixed-iteration variant the LAST Newton step always runs at full
+    precision (the differentiable scan cannot branch per lane), so the
+    precision ladder still ends in the working dtype there.
+
+    ``donate``: declare ``donate_argnums`` on the scheduled-injection
+    buffers of the jitted iteration programs (they alias the realized
+    p/q results) — the wrappers copy caller arrays, so donation is
+    invisible to callers.  Disable only for the bench's donation
+    head-to-head.
 
     ``mesh``/``batch_spec``: as in ``make_newton_solver`` — the returns
     become lane-batched mesh-sharded solvers (leading lane axis on every
@@ -322,6 +550,7 @@ def make_krylov_solver(
     rdtype = cplx.default_rdtype(dtype)
     if tol is None:
         tol = 1e-8 if rdtype == jnp.float64 else 3e-5
+    precision = resolve_precision(precision)
     n = sys.n_bus
 
     bus_type = jnp.asarray(sys.bus_type)
@@ -363,25 +592,109 @@ def make_krylov_solver(
         return jnp.concatenate([d_th, d_v])
 
     def _newton_step(bp_inv, bq_inv, x, p_sched, q_sched, status):
-        f = _residual(x, p_sched, q_sched, status)
-
-        def jvp_op(dx):
-            return jax.jvp(
-                lambda z: _residual(z, p_sched, q_sched, status), (x,), (dx,)
-            )[1]
-
+        # jax.linearize, not per-matvec jax.jvp: the primal residual is
+        # evaluated once per Newton step and every Krylov matvec reuses
+        # the linearization instead of re-tracing the injection chain.
+        f, jvp_op = jax.linearize(
+            lambda z: _residual(z, p_sched, q_sched, status), x
+        )
         v_now = x[n:]
         precond = lambda u: _apply_precond(bp_inv, bq_inv, u, v_now)
-        dx = _pgmres(jvp_op, precond, -f, m=inner_iters)
+        dx = _pgmres_block(jvp_op, precond, -f, m=inner_iters,
+                           s=block_size)
         # Breakdown safety net: a non-finite inner solve (never observed
-        # with the guarded MGS, but f32 at 20k unknowns has surprised
-        # before) falls back to one preconditioned first-order step.
+        # with the guarded orthogonalization, but f32 at 20k unknowns
+        # has surprised before) falls back to one preconditioned
+        # first-order step.
         dx = jnp.where(jnp.all(jnp.isfinite(dx)), dx, precond(-f))
         return x + dx, jnp.max(jnp.abs(f * free))
 
+    # -- mixed-precision machinery (precision == "mixed") --------------------
+    # The inner GMRES runs in f32 under DEFAULT matmul precision (on
+    # TPU: single-pass MXU matmuls instead of the 6-pass f32-highest
+    # emulation; on any backend: half the HBM traffic when the working
+    # dtype is f64).  The outer Newton step keeps the working-dtype
+    # masked mismatch as the acceptance oracle — see _newton_step_mixed.
+    inner_dtype = jnp.float32
+    if precision == "mixed":
+        inject_lo = (
+            make_injection_fn(sys, inner_dtype)
+            if rdtype != inner_dtype else inject
+        )
+        th_free_lo = th_free.astype(inner_dtype)
+        v_free_lo = v_free.astype(inner_dtype)
+        v_set_lo = v_set.astype(inner_dtype)
+
+        def _residual_lo(x, p_sched, q_sched, status):
+            theta, v = x[:n], x[n:]
+            p_calc, q_calc = inject_lo(theta, v, status=status)
+            f_p = jnp.where(th_free_lo > 0, p_calc - p_sched, theta)
+            f_q = jnp.where(v_free_lo > 0, q_calc - q_sched, v - v_set_lo)
+            return jnp.concatenate([f_p, f_q])
+
+        def _apply_precond_lo(bp_inv, bq_inv, u, v_now_lo):
+            u_p, u_q = u[:n], u[n:]
+            s_p = jnp.where(th_free_lo > 0, u_p / v_now_lo, u_p)
+            s_q = jnp.where(v_free_lo > 0, u_q / v_now_lo, u_q)
+            d_th = _apply_half(bp_inv, s_p).astype(inner_dtype)
+            d_v = _apply_half(bq_inv, s_q).astype(inner_dtype)
+            return jnp.concatenate([d_th, d_v])
+
+        def _newton_step_mixed(bp_inv, bq_inv, x, p_sched, q_sched,
+                               status):
+            """One mixed-precision Newton update.  Returns
+            ``(x_new, err_post)``: the updated iterate (non-finite
+            inner solves fall back to one preconditioned first-order
+            step, as on the full-precision path) and its FULL-precision
+            masked mismatch — the acceptance oracle's input.  The
+            working-dtype mismatch test is never computed in reduced
+            precision, so a bad low-precision solve can only cost
+            retries, never a wrong convergence verdict."""
+            f = _residual(x, p_sched, q_sched, status)
+            x_lo = x.astype(inner_dtype)
+            ps_lo = p_sched.astype(inner_dtype)
+            qs_lo = q_sched.astype(inner_dtype)
+            st_lo = None if status is None else status.astype(inner_dtype)
+            v_now_lo = x_lo[n:]
+            with jax.default_matmul_precision("default"):
+                _, jvp_lo = jax.linearize(
+                    lambda z: _residual_lo(z, ps_lo, qs_lo, st_lo), x_lo
+                )
+                m_lo = lambda u: _apply_precond_lo(bp_inv, bq_inv, u,
+                                                   v_now_lo)
+                dx = _pgmres_block(jvp_lo, m_lo,
+                                   (-f).astype(inner_dtype),
+                                   m=inner_iters, s=block_size)
+            dx = dx.astype(rdtype)
+            v_now = x[n:]
+            dx = jnp.where(
+                jnp.all(jnp.isfinite(dx)), dx,
+                _apply_precond(bp_inv, bq_inv, -f, v_now),
+            )
+            x_new = x + dx
+            # The oracle's post-update residual duplicates what the
+            # NEXT step's linearization will evaluate — an accepted
+            # O(n + m) cost: it is the price of judging every mixed
+            # update at full precision, and it is noise next to the
+            # inner cycle's O(inner_iters · n²) preconditioner work.
+            err1 = jnp.max(jnp.abs(
+                _residual(x_new, p_sched, q_sched, status) * free
+            ))
+            return x_new, err1
+
     def _prep(p_inj, q_inj, v0, theta0):
-        p_sched = p_sched0 if p_inj is None else jnp.asarray(p_inj, rdtype)
-        q_sched = q_sched0 if q_inj is None else jnp.asarray(q_inj, rdtype)
+        # The scheduled-injection buffers are DONATED by the impl
+        # programs (they alias the realized p/q results), so the
+        # wrapper always hands over a fresh copy — the stored schedule
+        # and any caller-owned array survive every solve.
+        p_sched = jnp.array(
+            p_sched0 if p_inj is None else jnp.asarray(p_inj, rdtype),
+            copy=True,
+        )
+        q_sched = jnp.array(
+            q_sched0 if q_inj is None else jnp.asarray(q_inj, rdtype),
+            copy=True,
+        )
         v = (
             jnp.where(v_free > 0, 1.0, v_set).astype(rdtype)
             if v0 is None
@@ -390,7 +703,7 @@ def make_krylov_solver(
         theta = jnp.zeros(n, rdtype) if theta0 is None else jnp.asarray(theta0, rdtype)
         return jnp.concatenate([theta, v]), p_sched, q_sched
 
-    def _finish(x, p_sched, q_sched, status, it):
+    def _finish(x, p_sched, q_sched, status, it, fallbacks=None):
         theta, v = x[:n], x[n:]
         p_calc, q_calc = inject(theta, v, status=status)
         err = jnp.max(jnp.abs(_residual(x, p_sched, q_sched, status) * free))
@@ -402,38 +715,142 @@ def make_krylov_solver(
             iterations=jnp.asarray(it, jnp.int32),
             converged=err < tol,
             mismatch=err,
+            fallbacks=(
+                jnp.asarray(0, jnp.int32) if fallbacks is None
+                else jnp.asarray(fallbacks, jnp.int32)
+            ),
         )
 
     # The [n, n] inverse pair is passed as ARGUMENTS, not closed over:
     # closure constants are serialized into the compile payload (at 10k
     # buses that is 400 MB of bf16 — rejected by remote-compile paths
     # and duplicated in HBM otherwise); runtime arguments are neither.
-    @jax.jit
-    def _solve_impl(bp_inv, bq_inv, x, ps, qs, status):
-        with jax.default_matmul_precision("highest"):
-            def cond(carry):
-                _, it, err = carry
-                return jnp.logical_and(it < max_iter, err >= tol)
+    # The scheduled injections (args 3, 4) are donated: same dtype and
+    # shape as the realized p/q results, so XLA aliases them in place
+    # of two fresh [n] allocations per solve (GP004 audits this).
+    _donate = (3, 4) if donate else ()
 
-            def body(carry):
-                x, it, _ = carry
-                x_new, err = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
-                return (x_new, it + 1, err)
+    if precision == "mixed":
+        @functools.partial(jax.jit, donate_argnums=_donate)
+        def _solve_impl(bp_inv, bq_inv, x, ps, qs, status):
+            with jax.default_matmul_precision("highest"):
+                # Phase 1: mixed-precision Newton steps under the
+                # full-precision acceptance oracle.  Newton is
+                # legitimately non-monotone far from the solution, so
+                # progress is judged against the BEST iterate with a
+                # _MIXED_STALL_STEPS window; a stalled lane exits to
+                # phase 2 from its best iterate.  The oracle is seeded
+                # with the INITIAL iterate's full-precision mismatch,
+                # so a warm start at (or near) the solution exits
+                # before any inner solve runs and a diverging first
+                # mixed step can never masquerade as the best iterate.
+                err_in = jnp.max(jnp.abs(
+                    _residual(x, ps, qs, status) * free
+                ))
 
-            x, it, _ = jax.lax.while_loop(
-                cond, body, (x, jnp.int32(0), jnp.asarray(jnp.inf, rdtype))
-            )
-            return _finish(x, ps, qs, status, it)
+                def cond1(carry):
+                    _, _, best, it, stall = carry
+                    return jnp.logical_and(
+                        jnp.logical_and(it < max_iter, best >= tol),
+                        stall < _MIXED_STALL_STEPS,
+                    )
 
-    @jax.jit
-    def _solve_fixed_impl(bp_inv, bq_inv, x, ps, qs, status):
-        with jax.default_matmul_precision("highest"):
-            def body(x, _):
-                x_new, _ = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
-                return x_new, None
+                def body1(carry):
+                    x, x_best, best, it, stall = carry
+                    x_new, err1 = _newton_step_mixed(
+                        bp_inv, bq_inv, x, ps, qs, status
+                    )
+                    improved = err1 < _MIXED_ACCEPT_RATIO * best
+                    x_best = jnp.where(err1 < best, x_new, x_best)
+                    best = jnp.minimum(best, err1)
+                    stall = jnp.where(improved, 0, stall + 1)
+                    return (x_new, x_best, best, it + 1, stall)
 
-            x, _ = jax.lax.scan(body, x, None, length=max_iter)
-            return _finish(x, ps, qs, status, max_iter)
+                x, x_best, best, it, _ = jax.lax.while_loop(
+                    cond1, body1,
+                    (x, x, err_in, jnp.int32(0), jnp.int32(0)),
+                )
+
+                # Phase 2: full-precision fall-through for stalled (or
+                # budget-exhausted, still-unconverged) lanes, resumed
+                # from the best full-precision-evaluated iterate.
+                # Under vmap this is per-lane — converged lanes freeze
+                # in the batched while_loop — and when NO lane stalled
+                # the loop body never runs.
+                def cond2(carry):
+                    _, it, err, _ = carry
+                    return jnp.logical_and(it < max_iter, err >= tol)
+
+                def body2(carry):
+                    x, it, _, fb = carry
+                    x_new, _ = _newton_step(bp_inv, bq_inv, x, ps, qs,
+                                            status)
+                    err_post = jnp.max(jnp.abs(
+                        _residual(x_new, ps, qs, status) * free
+                    ))
+                    return (x_new, it + 1, err_post, fb + 1)
+
+                x, it, err, fb = jax.lax.while_loop(
+                    cond2, body2, (x_best, it, best, jnp.int32(0))
+                )
+                return _finish(x, ps, qs, status, it, fallbacks=fb)
+
+        @functools.partial(jax.jit, donate_argnums=_donate)
+        def _solve_fixed_impl(bp_inv, bq_inv, x, ps, qs, status):
+            with jax.default_matmul_precision("highest"):
+                # max_iter-1 unconditional mixed steps, then one
+                # full-precision polish step — the differentiable scan
+                # cannot branch per lane, so the ladder's f64 endgame
+                # is structural here.  ``fallbacks`` reports the stall
+                # signal (pre-convergence steps that failed the
+                # best-iterate progress test) rather than retries.
+                inf = jnp.asarray(jnp.inf, rdtype)
+
+                def body(carry, _):
+                    x, best, fb = carry
+                    x_new, err1 = _newton_step_mixed(
+                        bp_inv, bq_inv, x, ps, qs, status
+                    )
+                    stalled = jnp.logical_and(
+                        err1 >= _MIXED_ACCEPT_RATIO * best, best >= tol
+                    )
+                    best = jnp.minimum(best, err1)
+                    return (x_new, best, fb + stalled.astype(jnp.int32)), None
+
+                (x, _, fb), _ = jax.lax.scan(
+                    body, (x, inf, jnp.int32(0)), None,
+                    length=max(max_iter - 1, 0),
+                )
+                if max_iter > 0:
+                    x, _ = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
+                return _finish(x, ps, qs, status, max_iter, fallbacks=fb)
+    else:
+        @functools.partial(jax.jit, donate_argnums=_donate)
+        def _solve_impl(bp_inv, bq_inv, x, ps, qs, status):
+            with jax.default_matmul_precision("highest"):
+                def cond(carry):
+                    _, it, err = carry
+                    return jnp.logical_and(it < max_iter, err >= tol)
+
+                def body(carry):
+                    x, it, _ = carry
+                    x_new, err = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
+                    return (x_new, it + 1, err)
+
+                x, it, _ = jax.lax.while_loop(
+                    cond, body, (x, jnp.int32(0), jnp.asarray(jnp.inf, rdtype))
+                )
+                return _finish(x, ps, qs, status, it)
+
+        @functools.partial(jax.jit, donate_argnums=_donate)
+        def _solve_fixed_impl(bp_inv, bq_inv, x, ps, qs, status):
+            with jax.default_matmul_precision("highest"):
+                def body(x, _):
+                    x_new, _ = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
+                    return x_new, None
+
+                x, _ = jax.lax.scan(body, x, None, length=max_iter)
+                return _finish(x, ps, qs, status, max_iter)
 
     def solve(p_inj=None, q_inj=None, status=None, v0=None, theta0=None):
         x, ps, qs = _prep(p_inj, q_inj, v0, theta0)
@@ -443,6 +860,7 @@ def make_krylov_solver(
         x, ps, qs = _prep(p_inj, q_inj, v0, theta0)
         return _solve_fixed_impl(_bp_inv, _bq_inv, x, ps, qs, status)
 
+    tags = {"pf_backend": "matrix_free", "precision": precision}
     if mesh is not None:
         # Same span/compile-account contract as the unsharded returns
         # (pf.solve spans + the (krylov, "base") compile entry).
@@ -450,19 +868,17 @@ def make_krylov_solver(
             tracing.traced_solver("krylov", _mesh_batched_krylov(
                 sys, _solve_impl, _bp_inv, _bq_inv, v_free, v_set,
                 p_sched0, q_sched0, rdtype, mesh, batch_spec,
-            ), tags={"pf_backend": "matrix_free"}),
+            ), tags=tags),
             tracing.traced_solver("krylov", _mesh_batched_krylov(
                 sys, _solve_fixed_impl, _bp_inv, _bq_inv, v_free, v_set,
                 p_sched0, q_sched0, rdtype, mesh, batch_spec,
-            ), tags={"pf_backend": "matrix_free"}),
+            ), tags=tags),
         )
 
     # Tracing (core.tracing): pf.solve spans, first call tagged as the
     # jit-compile hit; a no-op while tracing is disabled.
-    solve_w = tracing.traced_solver("krylov", solve,
-                                    tags={"pf_backend": "matrix_free"})
-    fixed_w = tracing.traced_solver("krylov", solve_fixed,
-                                    tags={"pf_backend": "matrix_free"})
+    solve_w = tracing.traced_solver("krylov", solve, tags=tags)
+    fixed_w = tracing.traced_solver("krylov", solve_fixed, tags=tags)
 
     # gridprobe seam: the inner jitted program with the preconditioner
     # pair as runtime ARGUMENTS — tracing the outer closure instead
@@ -498,7 +914,7 @@ def _mesh_batched_krylov(sys, impl, bp_inv, bq_inv, v_free, v_set,
     s2 = pmesh.lane_spec(mesh, 2, batch_spec=batch_spec)
     out_specs = out_type(
         v=s2, theta=s2, p=s2, q=s2,
-        iterations=s1, converged=s1, mismatch=s1,
+        iterations=s1, converged=s1, mismatch=s1, fallbacks=s1,
     )
     prog = pmesh.shard_batched(
         lambda bp, bq, x, ps, qs, st: jax.vmap(

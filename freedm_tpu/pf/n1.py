@@ -167,6 +167,7 @@ def make_n1_screen(
     mesh=None,
     batch_spec=None,
     backend: str = "dense",
+    precision: str = "auto",
     dc_prefilter: Optional[int] = None,
 ):
     """Compile the batched N-1 screen.
@@ -194,6 +195,12 @@ def make_n1_screen(
     iteration); ``"auto"`` picks by case size
     (:func:`freedm_tpu.pf.sparse.resolve_backend`).
 
+    ``precision`` (the ``--pf-precision`` key) threads to the sparse
+    backend's GMRES inner (mixed-precision with the full-precision
+    acceptance oracle, docs/solvers.md); the SMW path's triangular
+    solves run in the working dtype regardless, so it only validates
+    there.
+
     ``dc_prefilter=k``: run the batched DC loadflow screen
     (:mod:`freedm_tpu.pf.dc`) over ALL requested outages first — one
     B′ factorization, Sherman–Morrison per lane, thousands of lanes per
@@ -204,14 +211,16 @@ def make_n1_screen(
     filter them (``secure_outages``) — the AC lanes assume
     connectivity.
     """
+    from freedm_tpu.pf.krylov import resolve_precision
     from freedm_tpu.pf.sparse import resolve_backend
 
     if resolve_backend(backend, sys.n_bus) == "sparse":
         screen = _make_sparse_n1_screen(
             sys, tol=tol, max_iter=max_iter, dtype=dtype,
-            mesh=mesh, batch_spec=batch_spec,
+            mesh=mesh, batch_spec=batch_spec, precision=precision,
         )
     else:
+        resolve_precision(precision)  # typed error on unknown values
         screen = _make_smw_n1_screen(
             sys, tol=tol, max_iter=max_iter, dtype=dtype,
             mesh=mesh, batch_spec=batch_spec,
@@ -258,7 +267,8 @@ def _with_dc_prefilter(sys, ac_screen, top_k: int, dtype):
     return screen
 
 
-def _make_sparse_n1_screen(sys, tol, max_iter, dtype, mesh, batch_spec):
+def _make_sparse_n1_screen(sys, tol, max_iter, dtype, mesh, batch_spec,
+                           precision: str = "auto"):
     """The sparse-backend screen: base case once, outage lanes as
     status-traced warm-started sparse Newton solves (one pattern, one
     preconditioner, shared by every lane)."""
@@ -285,11 +295,13 @@ def _make_sparse_n1_screen(sys, tol, max_iter, dtype, mesh, batch_spec):
     solve, _ = make_sparse_newton_solver(
         sys, tol=tol, max_iter=max_iter, dtype=dtype,
         mesh=mesh, batch_spec=batch_spec, precond=precond,
+        precision=precision,
     )
     base_solve, _ = (
         (solve, None) if mesh is None
         else make_sparse_newton_solver(
-            sys, tol=tol, max_iter=max_iter, dtype=dtype, precond=precond
+            sys, tol=tol, max_iter=max_iter, dtype=dtype, precond=precond,
+            precision=precision,
         )
     )
     base = base_solve()
@@ -448,6 +460,7 @@ def _make_smw_n1_screen(
             iterations=jnp.asarray(max_iter, jnp.int32),
             converged=err < tol,
             mismatch=err,
+            fallbacks=jnp.asarray(0, jnp.int32),
         )
 
     if mesh is not None:
@@ -458,7 +471,7 @@ def _make_smw_n1_screen(
         s2 = pmesh.lane_spec(mesh, 2, batch_spec=batch_spec)
         out_specs = NewtonResult(
             v=s2, theta=s2, p=s2, q=s2,
-            iterations=s1, converged=s1, mismatch=s1,
+            iterations=s1, converged=s1, mismatch=s1, fallbacks=s1,
         )
 
         def _local(ks):

@@ -64,6 +64,11 @@ class NewtonResult(NamedTuple):
     iterations: jax.Array  # [] int32
     converged: jax.Array  # [] bool
     mismatch: jax.Array  # [] float: max |free-equation residual|
+    #: [] int32: Newton iterations re-run at full precision after a
+    #: mixed-precision inner solve stalled (``--pf-precision mixed``,
+    #: sparse backend; always 0 on the dense/FDLF/SMW paths, which
+    #: have no reduced-precision inner to fall back from).
+    fallbacks: jax.Array
 
 
 class _LaneFills(NamedTuple):
@@ -86,7 +91,7 @@ def _newton_result_specs(mesh, batch_spec):
     s2 = lane_spec(mesh, 2, batch_spec=batch_spec)
     return NewtonResult(
         v=s2, theta=s2, p=s2, q=s2,
-        iterations=s1, converged=s1, mismatch=s1,
+        iterations=s1, converged=s1, mismatch=s1, fallbacks=s1,
     )
 
 
@@ -146,7 +151,8 @@ def s_calc(y: C, theta, v):
     return s.re, s.im
 
 
-def build_result(y: C, theta, v, it, err, tol) -> NewtonResult:
+def build_result(y: C, theta, v, it, err, tol,
+                 fallbacks=None) -> NewtonResult:
     """Assemble the shared result record from a final state."""
     p_calc, q_calc = s_calc(y, theta, v)
     return NewtonResult(
@@ -157,6 +163,10 @@ def build_result(y: C, theta, v, it, err, tol) -> NewtonResult:
         iterations=jnp.asarray(it, jnp.int32),
         converged=err < tol,
         mismatch=err,
+        fallbacks=(
+            jnp.asarray(0, jnp.int32) if fallbacks is None
+            else jnp.asarray(fallbacks, jnp.int32)
+        ),
     )
 
 
@@ -168,6 +178,7 @@ def make_newton_solver(
     mesh=None,
     batch_spec=None,
     backend: str = "dense",
+    precision: str = "auto",
 ):
     """Compile NR solvers for a bus system.
 
@@ -206,14 +217,26 @@ def make_newton_solver(
     ``"auto"`` (sparse at and above
     :data:`~freedm_tpu.pf.sparse.SPARSE_AUTO_MIN_BUSES` buses, dense
     below — the measured crossover, see docs/solvers.md).
+
+    ``precision`` (the ``--pf-precision`` config key, same threading
+    convention as ``backend``) selects the inner-solve precision on
+    the Krylov-based backends: ``"mixed"`` runs the GMRES inner in f32
+    under the working-dtype masked-mismatch acceptance oracle with
+    per-lane f64 fallback (docs/solvers.md "Mixed precision");
+    ``"f64"`` keeps the classic full-precision inner; ``"auto"`` picks
+    by backend.  The dense path has no reduced-precision inner — its
+    LU runs in the working dtype regardless — so ``precision`` only
+    validates here and the result's ``fallbacks`` stays 0.
     """
     from freedm_tpu.pf import sparse as _sparse
+    from freedm_tpu.pf.krylov import resolve_precision
 
     if _sparse.resolve_backend(backend, sys.n_bus) == "sparse":
         return _sparse.make_sparse_newton_solver(
             sys, tol=tol, max_iter=max_iter, dtype=dtype,
-            mesh=mesh, batch_spec=batch_spec,
+            mesh=mesh, batch_spec=batch_spec, precision=precision,
         )
+    resolve_precision(precision)  # typed error on unknown values
     rdtype = cplx.default_rdtype(dtype)
     if tol is None:
         tol = 1e-8 if rdtype == jnp.float64 else 3e-5
@@ -342,10 +365,10 @@ def make_newton_solver(
         return (
             tracing.traced_solver("newton", _mesh_batched(
                 solve, mesh, batch_spec, fills, out_specs, "newton"),
-                tags={"pf_backend": "dense"}),
+                tags={"pf_backend": "dense", "precision": "f64"}),
             tracing.traced_solver("newton", _mesh_batched(
                 solve_fixed, mesh, batch_spec, fills, out_specs, "newton"),
-                tags={"pf_backend": "dense"}),
+                tags={"pf_backend": "dense", "precision": "f64"}),
         )
 
     # Tracing (core.tracing, --trace-log): each call records a
@@ -353,9 +376,9 @@ def make_newton_solver(
     # and every one tagged with the Jacobian backend.  Disabled tracing
     # is one attribute check per call.
     solve_w = tracing.traced_solver("newton", solve,
-                                    tags={"pf_backend": "dense"})
+                                    tags={"pf_backend": "dense", "precision": "f64"})
     fixed_w = tracing.traced_solver("newton", solve_fixed,
-                                    tags={"pf_backend": "dense"})
+                                    tags={"pf_backend": "dense", "precision": "f64"})
 
     # gridprobe seam (tools/ir_rules/registry.py): the actual jitted
     # program plus flat-start example arguments, so the IR auditor

@@ -32,11 +32,15 @@ the SABLE-style (PAPERS.md) sparsity-aware path:
   P/Q and the four block diagonals — the same algebra the dense path's
   hand-assembled blocks collapse to, evaluated only where nonzero.
 * **Pattern-reuse sparse linear solve.**  The Newton update solves
-  J dx = −f with the right-preconditioned GMRES(m) cycle the 10k-bus
-  matrix-free solver already ships (:func:`freedm_tpu.pf.krylov._pgmres`
-  — masked double-MGS as batched matmuls, guarded breakdowns; the
+  J dx = −f with the s-step right-preconditioned GMRES cycle the
+  10k-bus matrix-free solver ships
+  (:func:`freedm_tpu.pf.krylov._pgmres_block` — blocked
+  orthogonalization as tall-skinny GEMMs + guarded Cholesky-QR; the
   stock jax GMRES and CG/BiCGStab-class inners were measured and
-  rejected there, see ``krylov.py``'s module docstring).  The operator
+  rejected there, see ``krylov.py``'s module docstring), optionally in
+  mixed precision under the working-dtype acceptance oracle
+  (``precision="mixed"`` — same ladder, fallback, and ``fallbacks``
+  accounting as ``pf/krylov.py``).  The operator
   is the BCSR matvec — two gathers, per-edge multiplies, one
   ``segment_sum`` per half-system — assembled ONCE per Newton step, so
   each Krylov iteration costs O(n + m) with no trig and no ``jvp``
@@ -66,6 +70,7 @@ bounds in docs/solvers.md; ``tests/test_sparse.py`` pins them).
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import time
 from collections import OrderedDict
@@ -78,10 +83,13 @@ import numpy as np
 from freedm_tpu.core import profiling, tracing
 from freedm_tpu.grid.bus import PQ, SLACK, BusSystem, branch_admittances
 from freedm_tpu.pf.krylov import (
+    _MIXED_ACCEPT_RATIO,
+    _MIXED_STALL_STEPS,
     _mesh_batched_krylov,
-    _pgmres,
+    _pgmres_block,
     build_fdlf_precond,
     precond_apply_half,
+    resolve_precision,
 )
 from freedm_tpu.pf.newton import NewtonResult
 from freedm_tpu.utils import cplx
@@ -223,7 +231,10 @@ def make_sparse_newton_solver(
     dtype: Optional[jnp.dtype] = None,
     precond_dtype: jnp.dtype = jnp.bfloat16,
     precond=None,
-    precond_kind: str = "inverse",
+    precond_kind: Optional[str] = None,
+    precision: str = "auto",
+    block_size: int = 4,
+    donate: bool = True,
     mesh=None,
     batch_spec=None,
 ):
@@ -237,18 +248,33 @@ def make_sparse_newton_solver(
     normally reach it through ``make_newton_solver(..., backend=...)``.
 
     ``inner_iters`` is the GMRES dimension of the inexact-Newton inner
-    solve; ``precond`` optionally passes a prebuilt
+    solve (``block_size`` its s-step block — the inner cycle is the
+    shared :func:`~freedm_tpu.pf.krylov._pgmres_block`); ``precond``
+    optionally passes a prebuilt
     :func:`~freedm_tpu.pf.krylov.build_fdlf_precond` pair.
-    ``precond_kind="inverse"`` (default) streams explicit inverses —
-    measured 3x faster PER APPLY than LU triangular solves even on the
-    CPU backend at 2000 buses, on top of being the MXU-right shape;
-    ``"lu"`` trades apply speed for an O(n³/3) factorization build
-    where the Newton–Schulz inverse iteration is infeasible (10k-bus
-    cases on CPU hosts — the bench's 10k row uses it there).
+    ``precond_kind=None`` (default) resolves by case size
+    (:func:`~freedm_tpu.pf.krylov.default_precond_kind` — inverse
+    below the bf16-pair blowup threshold, LU at/above);
+    ``"inverse"`` streams explicit inverses — measured 3x faster PER
+    APPLY than LU triangular solves even on the CPU backend at 2000
+    buses, on top of being the MXU-right shape; ``"lu"`` trades apply
+    speed for an O(n³/3) factorization build where the Newton–Schulz
+    inverse iteration is infeasible (10k-bus cases on CPU hosts — the
+    bench's 10k row uses it there); ``"auto"`` picks by backend and
+    case size.
+
+    ``precision`` (the ``--pf-precision`` key) and ``donate`` follow
+    :func:`~freedm_tpu.pf.krylov.make_krylov_solver` exactly: mixed
+    runs the inner GMRES in f32 under the working-dtype acceptance
+    oracle with per-lane f64 fallback (counted on the result's
+    ``fallbacks``), and donation aliases the scheduled-injection
+    buffers with the realized p/q results.
     """
     rdtype = cplx.default_rdtype(dtype)
     if tol is None:
         tol = 1e-8 if rdtype == jnp.float64 else 3e-5
+    precision = resolve_precision(precision)
+    inner_dtype = jnp.float32
     n = sys.n_bus
     pat = jacobian_pattern(sys)
     f_idx, t_idx, rows = pat.f, pat.t, pat.rows
@@ -348,16 +374,18 @@ def make_sparse_newton_solver(
         f_q = jnp.where(v_free > 0, jv.q_calc - q_sched, v - v_set)
         return jnp.concatenate([f_p, f_q])
 
-    def _apply_precond(bp_inv, bq_inv, u, v_now):
+    def _apply_precond(bp_inv, bq_inv, u, v_now, out_dtype=None):
         """M⁻¹u with M = blockdiag(diag(V)B′, diag(V)B″) — the same
         FDLF approximation as ``pf/krylov.py``, applied per the built
         pair's kind (inverse matvec or LU triangular solves); pinned
-        rows pass through unscaled."""
+        rows pass through unscaled.  ``out_dtype`` casts the result
+        (the mixed inner runs it in f32)."""
+        out_dtype = rdtype if out_dtype is None else out_dtype
         u_p, u_q = u[:n], u[n:]
         s_p = jnp.where(th_free > 0, u_p / v_now, u_p)
         s_q = jnp.where(v_free > 0, u_q / v_now, u_q)
-        d_th = _apply_half(bp_inv, s_p).astype(rdtype)
-        d_v = _apply_half(bq_inv, s_q).astype(rdtype)
+        d_th = _apply_half(bp_inv, s_p).astype(out_dtype)
+        d_v = _apply_half(bq_inv, s_q).astype(out_dtype)
         return jnp.concatenate([d_th, d_v])
 
     def _newton_step(bp_inv, bq_inv, x, p_sched, q_sched, status):
@@ -366,14 +394,58 @@ def make_sparse_newton_solver(
         fres = _residual_from(jv, theta, v, p_sched, q_sched)
         a_op = lambda u: _matvec(jv, u)
         m_op = lambda u: _apply_precond(bp_inv, bq_inv, u, v)
-        dx = _pgmres(a_op, m_op, -fres, m=inner_iters)
+        dx = _pgmres_block(a_op, m_op, -fres, m=inner_iters, s=block_size)
         # Same breakdown safety net as the matrix-free path.
         dx = jnp.where(jnp.all(jnp.isfinite(dx)), dx, m_op(-fres))
         return x + dx, jnp.max(jnp.abs(fres * free))
 
+    def _newton_step_mixed(bp_inv, bq_inv, x, p_sched, q_sched, status):
+        """Mixed-precision BCSR Newton update (same contract as
+        ``pf/krylov._newton_step_mixed``): values assemble once in the
+        working dtype (the residual needs them anyway), the Krylov
+        matvecs run over an f32 cast of the value fill under default
+        matmul precision, and the returned mismatch is the FULL-
+        precision test — the acceptance oracle's input."""
+        theta, v = x[:n], x[n:]
+        jv = _assemble(theta, v, status)
+        fres = _residual_from(jv, theta, v, p_sched, q_sched)
+        jv_lo = _JacValues(*(a.astype(inner_dtype) for a in jv))
+        v_lo = v.astype(inner_dtype)
+        with jax.default_matmul_precision("default"):
+            a_op = lambda u: _matvec(jv_lo, u)
+            m_op = lambda u: _apply_precond(bp_inv, bq_inv, u, v_lo,
+                                            out_dtype=inner_dtype)
+            dx = _pgmres_block(a_op, m_op, (-fres).astype(inner_dtype),
+                               m=inner_iters, s=block_size)
+        dx = dx.astype(rdtype)
+        dx = jnp.where(
+            jnp.all(jnp.isfinite(dx)), dx,
+            _apply_precond(bp_inv, bq_inv, -fres, v),
+        )
+        x_new = x + dx
+        # The oracle's post-update assembly duplicates the next step's
+        # — an accepted O(m) cost (see pf/krylov.py: the price of a
+        # full-precision verdict on every mixed update, small next to
+        # the inner cycle's preconditioner applies).
+        theta_n, v_n = x_new[:n], x_new[n:]
+        jv_n = _assemble(theta_n, v_n, status)
+        err1 = jnp.max(jnp.abs(
+            _residual_from(jv_n, theta_n, v_n, p_sched, q_sched) * free
+        ))
+        return x_new, err1
+
     def _prep(p_inj, q_inj, status, v0, theta0):
-        p_sched = p_sched0 if p_inj is None else jnp.asarray(p_inj, rdtype)
-        q_sched = q_sched0 if q_inj is None else jnp.asarray(q_inj, rdtype)
+        # Donation defense: the impls donate ps/qs (they alias the
+        # realized p/q results), so the wrapper always hands over a
+        # fresh copy — see pf/krylov.py's _prep.
+        p_sched = jnp.array(
+            p_sched0 if p_inj is None else jnp.asarray(p_inj, rdtype),
+            copy=True,
+        )
+        q_sched = jnp.array(
+            q_sched0 if q_inj is None else jnp.asarray(q_inj, rdtype),
+            copy=True,
+        )
         v = (
             jnp.where(v_free > 0, 1.0, v_set).astype(rdtype)
             if v0 is None
@@ -389,7 +461,8 @@ def make_sparse_newton_solver(
         )
         return jnp.concatenate([theta, v]), p_sched, q_sched, st
 
-    def _finish(x, p_sched, q_sched, status, it) -> NewtonResult:
+    def _finish(x, p_sched, q_sched, status, it,
+                fallbacks=None) -> NewtonResult:
         theta, v = x[:n], x[n:]
         jv = _assemble(theta, v, status)
         err = jnp.max(
@@ -403,37 +476,131 @@ def make_sparse_newton_solver(
             iterations=jnp.asarray(it, jnp.int32),
             converged=err < tol,
             mismatch=err,
+            fallbacks=(
+                jnp.asarray(0, jnp.int32) if fallbacks is None
+                else jnp.asarray(fallbacks, jnp.int32)
+            ),
         )
 
     # The preconditioner pair rides as ARGUMENTS (not closure constants)
     # for the same reason as pf/krylov.py: closure constants serialize
-    # into the compile payload and duplicate in HBM.
-    @jax.jit
-    def _solve_impl(bp_inv, bq_inv, x, ps, qs, status):
-        with jax.default_matmul_precision("highest"):
-            def cond(carry):
-                _, it, err = carry
-                return jnp.logical_and(it < max_iter, err >= tol)
+    # into the compile payload and duplicate in HBM.  The scheduled
+    # injections (args 3, 4) donate into the realized p/q results —
+    # same aliasing contract as pf/krylov.py (GP004 audits it).
+    _donate = (3, 4) if donate else ()
 
-            def body(carry):
-                x, it, _ = carry
-                x_new, err = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
-                return (x_new, it + 1, err)
+    if precision == "mixed":
+        @functools.partial(jax.jit, donate_argnums=_donate)
+        def _solve_impl(bp_inv, bq_inv, x, ps, qs, status):
+            with jax.default_matmul_precision("highest"):
+                # Two-phase ladder, exactly as pf/krylov.py: mixed
+                # steps under the best-iterate acceptance oracle
+                # (Newton is legitimately non-monotone far from the
+                # solution), then a per-lane full-precision
+                # fall-through for stalled lanes, resumed from the
+                # best iterate.  Seeded with the initial iterate's
+                # full-precision mismatch — see pf/krylov.py.
+                theta0_, v0_ = x[:n], x[n:]
+                jv0 = _assemble(theta0_, v0_, status)
+                err_in = jnp.max(jnp.abs(_residual_from(
+                    jv0, theta0_, v0_, ps, qs) * free))
 
-            x, it, _ = jax.lax.while_loop(
-                cond, body, (x, jnp.int32(0), jnp.asarray(jnp.inf, rdtype))
-            )
-            return _finish(x, ps, qs, status, it)
+                def cond1(carry):
+                    _, _, best, it, stall = carry
+                    return jnp.logical_and(
+                        jnp.logical_and(it < max_iter, best >= tol),
+                        stall < _MIXED_STALL_STEPS,
+                    )
 
-    @jax.jit
-    def _solve_fixed_impl(bp_inv, bq_inv, x, ps, qs, status):
-        with jax.default_matmul_precision("highest"):
-            def body(x, _):
-                x_new, _ = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
-                return x_new, None
+                def body1(carry):
+                    x, x_best, best, it, stall = carry
+                    x_new, err1 = _newton_step_mixed(
+                        bp_inv, bq_inv, x, ps, qs, status
+                    )
+                    improved = err1 < _MIXED_ACCEPT_RATIO * best
+                    x_best = jnp.where(err1 < best, x_new, x_best)
+                    best = jnp.minimum(best, err1)
+                    stall = jnp.where(improved, 0, stall + 1)
+                    return (x_new, x_best, best, it + 1, stall)
 
-            x, _ = jax.lax.scan(body, x, None, length=max_iter)
-            return _finish(x, ps, qs, status, max_iter)
+                x, x_best, best, it, _ = jax.lax.while_loop(
+                    cond1, body1,
+                    (x, x, err_in, jnp.int32(0), jnp.int32(0)),
+                )
+
+                def cond2(carry):
+                    _, it, err, _ = carry
+                    return jnp.logical_and(it < max_iter, err >= tol)
+
+                def body2(carry):
+                    x, it, _, fb = carry
+                    x_new, _ = _newton_step(bp_inv, bq_inv, x, ps, qs,
+                                            status)
+                    theta_n, v_n = x_new[:n], x_new[n:]
+                    jv_n = _assemble(theta_n, v_n, status)
+                    err_post = jnp.max(jnp.abs(_residual_from(
+                        jv_n, theta_n, v_n, ps, qs) * free))
+                    return (x_new, it + 1, err_post, fb + 1)
+
+                x, it, err, fb = jax.lax.while_loop(
+                    cond2, body2, (x_best, it, best, jnp.int32(0))
+                )
+                return _finish(x, ps, qs, status, it, fallbacks=fb)
+
+        @functools.partial(jax.jit, donate_argnums=_donate)
+        def _solve_fixed_impl(bp_inv, bq_inv, x, ps, qs, status):
+            with jax.default_matmul_precision("highest"):
+                # Unconditional mixed steps + the structural full-
+                # precision endgame; ``fallbacks`` reports the stall
+                # signal, as in pf/krylov.py.
+                inf = jnp.asarray(jnp.inf, rdtype)
+
+                def body(carry, _):
+                    x, best, fb = carry
+                    x_new, err1 = _newton_step_mixed(
+                        bp_inv, bq_inv, x, ps, qs, status
+                    )
+                    stalled = jnp.logical_and(
+                        err1 >= _MIXED_ACCEPT_RATIO * best, best >= tol
+                    )
+                    best = jnp.minimum(best, err1)
+                    return (x_new, best,
+                            fb + stalled.astype(jnp.int32)), None
+
+                (x, _, fb), _ = jax.lax.scan(
+                    body, (x, inf, jnp.int32(0)), None,
+                    length=max(max_iter - 1, 0),
+                )
+                if max_iter > 0:  # the ladder's full-precision endgame
+                    x, _ = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
+                return _finish(x, ps, qs, status, max_iter, fallbacks=fb)
+    else:
+        @functools.partial(jax.jit, donate_argnums=_donate)
+        def _solve_impl(bp_inv, bq_inv, x, ps, qs, status):
+            with jax.default_matmul_precision("highest"):
+                def cond(carry):
+                    _, it, err = carry
+                    return jnp.logical_and(it < max_iter, err >= tol)
+
+                def body(carry):
+                    x, it, _ = carry
+                    x_new, err = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
+                    return (x_new, it + 1, err)
+
+                x, it, _ = jax.lax.while_loop(
+                    cond, body, (x, jnp.int32(0), jnp.asarray(jnp.inf, rdtype))
+                )
+                return _finish(x, ps, qs, status, it)
+
+        @functools.partial(jax.jit, donate_argnums=_donate)
+        def _solve_fixed_impl(bp_inv, bq_inv, x, ps, qs, status):
+            with jax.default_matmul_precision("highest"):
+                def body(x, _):
+                    x_new, _ = _newton_step(bp_inv, bq_inv, x, ps, qs, status)
+                    return x_new, None
+
+                x, _ = jax.lax.scan(body, x, None, length=max_iter)
+                return _finish(x, ps, qs, status, max_iter)
 
     def solve(p_inj=None, q_inj=None, status=None, v0=None, theta0=None):
         x, ps, qs, st = _prep(p_inj, q_inj, status, v0, theta0)
@@ -444,7 +611,7 @@ def make_sparse_newton_solver(
         x, ps, qs, st = _prep(p_inj, q_inj, status, v0, theta0)
         return _solve_fixed_impl(_bp_inv, _bq_inv, x, ps, qs, st)
 
-    tags = {"pf_backend": "sparse"}
+    tags = {"pf_backend": "sparse", "precision": precision}
     if mesh is not None:
         # The krylov mesh wrapper verbatim (replicated preconditioner
         # pair, lane-sharded everything else) with NewtonResult output.

@@ -118,6 +118,15 @@ class StudySpec:
     # the study's identity — backends agree to solver tolerance, not
     # bit-for-bit, so a checkpoint only resumes under its own backend.
     pf_backend: str = "auto"
+    # Inner-solve precision for bus-case Newton solves (the
+    # --pf-precision key): "f64" full-precision inner GMRES, "mixed"
+    # f32 inner under the working-dtype acceptance oracle with
+    # per-lane fallback, "auto" by backend (docs/solvers.md).  Like
+    # pf_backend it is part of the study's identity — mixed and f64
+    # agree to solver tolerance, not bit-for-bit, so a checkpoint only
+    # resumes under its own precision.  Feeder (ladder) studies have
+    # no Krylov inner; the key validates and is ignored there.
+    pf_precision: str = "auto"
     # Execution placement (NOT part of the study's identity — see
     # MESH_SPEC_KEYS): shard the scenario axis over this many devices
     # via shard_map (0 = unsharded single device, -1 = all local
@@ -213,6 +222,13 @@ class QstsEngine:
                 f"unknown pf_backend {spec.pf_backend!r} "
                 f"(have: {', '.join(BACKENDS)})"
             )
+        from freedm_tpu.pf.krylov import PF_PRECISIONS
+
+        if spec.pf_precision not in PF_PRECISIONS:
+            raise ValueError(
+                f"unknown pf_precision {spec.pf_precision!r} "
+                f"(have: {', '.join(PF_PRECISIONS)})"
+            )
         self.spec = spec
         self.kind, self._case = _resolve_case(spec.case)
         self.compiles = 0  # distinct chunk shapes compiled (bench bound)
@@ -283,9 +299,12 @@ class QstsEngine:
 
         from freedm_tpu.pf.sparse import resolve_backend
 
+        from freedm_tpu.pf.krylov import resolve_precision
+
         sys_ = self._case
         self.solver_name = "newton"
         self.pf_backend = resolve_backend(self.spec.pf_backend, sys_.n_bus)
+        self.pf_precision = resolve_precision(self.spec.pf_precision)
         self.rdtype = np.dtype(cplx.default_rdtype(None))
         n = sys_.n_bus
         self._n_profile = n
@@ -299,7 +318,8 @@ class QstsEngine:
             bt == PQ, 1.0, np.asarray(sys_.v_set, np.float64)
         ).astype(self.rdtype)
         solve, _ = make_newton_solver(
-            sys_, max_iter=self.spec.max_iter, backend=self.pf_backend
+            sys_, max_iter=self.spec.max_iter, backend=self.pf_backend,
+            precision=self.pf_precision,
         )
         self._solve = solve
 
@@ -369,7 +389,11 @@ class QstsEngine:
             return out
 
         if self._mesh is None:
-            return jax.jit(chunk)
+            # The state carry round-trips through host numpy at every
+            # chunk boundary (run_chunk), so its device buffers are
+            # exclusively this call's to consume: donate them into the
+            # identically-shaped output state (GP004 audits this).
+            return jax.jit(chunk, donate_argnums=(0,))
 
         # Sharded form: the SAME chunk body under shard_map, each device
         # scanning its local lane block.  Per-scenario accumulators are
@@ -417,6 +441,7 @@ class QstsEngine:
         feeder = self._case
         self.solver_name = "ladder"
         self.pf_backend = "sweep"  # the ladder has no Jacobian at all
+        self.pf_precision = "f64"  # ...and no Krylov inner to mix
         self.rdtype = np.dtype(cplx.default_rdtype(None))
         self._n_profile = feeder.n_branches
         s0 = cplx.as_c(np.asarray(feeder.s_load))
@@ -476,7 +501,11 @@ class QstsEngine:
             return out
 
         if self._mesh is None:
-            return jax.jit(chunk)
+            # The state carry round-trips through host numpy at every
+            # chunk boundary (run_chunk), so its device buffers are
+            # exclusively this call's to consume: donate them into the
+            # identically-shaped output state (GP004 audits this).
+            return jax.jit(chunk, donate_argnums=(0,))
 
         # Same sharding discipline as the bus chunk (see there): local
         # scan per device, exact scalar combines at chunk exit.
@@ -579,7 +608,8 @@ class QstsEngine:
                 f"pf.solve:{self.solver_name}", kind="solve",
                 tags={"solver": self.solver_name, "jit_compile": new_shape,
                       "steps": tc, "mesh_devices": self.mesh_devices,
-                      "pf_backend": self.pf_backend},
+                      "pf_backend": self.pf_backend,
+                      "pf_precision": self.pf_precision},
             ):
                 out = self._fns[tc](state, *arrays)
                 out = jax.block_until_ready(out)
@@ -640,6 +670,7 @@ class QstsEngine:
             "compiles": self.compiles,
             "mesh_devices": self.mesh_devices,
             "pf_backend": self.pf_backend,
+            "pf_precision": self.pf_precision,
             "wall_s": round(float(wall_s), 3),
         }
         if self.kind == "bus":

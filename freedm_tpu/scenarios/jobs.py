@@ -60,7 +60,7 @@ _JOB_KEY_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
 _FIELDS = {
     "case", "scenarios", "steps", "dt_minutes", "seed", "profile",
     "chunk_steps", "warm_start", "max_iter", "job_key", "mesh_devices",
-    "pf_backend",
+    "pf_backend", "pf_precision",
 }
 
 
@@ -117,6 +117,14 @@ def parse_job_request(payload: dict, default_chunk_steps: int = 24,
             f"unknown pf_backend {pf_backend!r} "
             f"(have: {', '.join(BACKENDS)})"
         )
+    from freedm_tpu.pf.krylov import PF_PRECISIONS
+
+    pf_precision = payload.get("pf_precision", "auto")
+    if pf_precision not in PF_PRECISIONS:
+        raise InvalidRequest(
+            f"unknown pf_precision {pf_precision!r} "
+            f"(have: {', '.join(PF_PRECISIONS)})"
+        )
     mesh_devices = _int("mesh_devices", int(default_mesh_devices), -1, 4096)
     if mesh_devices not in (0, 1):
         from freedm_tpu.parallel.mesh import resolve_device_count
@@ -142,7 +150,7 @@ def parse_job_request(payload: dict, default_chunk_steps: int = 24,
         case=case, scenarios=scenarios, steps=steps, dt_minutes=float(dt),
         seed=seed, profile=profile, chunk_steps=chunk_steps,
         warm_start=warm, max_iter=max_iter, mesh_devices=mesh_devices,
-        pf_backend=pf_backend,
+        pf_backend=pf_backend, pf_precision=pf_precision,
     )
     # Resolve the case NOW (typed error, and the lane-cell bound needs
     # its size); the engine built later resolves it again cheaply.

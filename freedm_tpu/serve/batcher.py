@@ -258,6 +258,13 @@ class MicroBatcher:
         #: Shapes compiled at startup by :meth:`Service.prewarm` — shown
         #: in /stats, excluded from ``serve_recompiles_total``.
         self.prewarmed: set = set()
+        # Measured padding accounting (ints mutated under the GIL, read
+        # by /stats): real lanes dispatched vs pad lanes the bucket
+        # table added on top.  /stats derives the observed padding
+        # fraction from these — the live counterpart of the table's
+        # analytic worst case (service.padding_waste_pct).
+        self.dispatched_lanes = 0
+        self.padded_lanes = 0
         self._shapes_lock = threading.Lock()
         # Watchdog surface (core.slo): the assembly loop beats this
         # every iteration; a stage stuck in assemble/submit stops
@@ -538,6 +545,8 @@ class MicroBatcher:
             engine = self.service.engine(workload, case)
             bucket = self.bucket_for(lanes)
             obs.SERVE_BATCH_LANES.labels(workload).observe(lanes)
+            self.dispatched_lanes += lanes
+            self.padded_lanes += max(bucket - lanes, 0)
             span = tracing.TRACER.start(
                 "serve.batch", kind="serve",
                 parent_ctx=group[0].span.context(),

@@ -321,7 +321,7 @@ class PowerFlowEngine(_Engine):
     workload = "pf"
 
     def __init__(self, case: str, max_iter: int = 12, mesh=None,
-                 backend: str = "auto"):
+                 backend: str = "auto", precision: str = "auto"):
         super().__init__(case)
         import jax
 
@@ -355,18 +355,25 @@ class PowerFlowEngine(_Engine):
         # updating), so the response's `iterations` and the pf metrics
         # actually show what a warm start saves.
         solve, _ = make_newton_solver(sys_, max_iter=max_iter,
-                                      backend=backend)
+                                      backend=backend, precision=precision)
+        # The dispatch buffers are DONATED: the assembled batch arrays
+        # (p, q, v0, th0) are freshly padded per dispatch and alias the
+        # result's (p, q, v, theta) buffers exactly, so every batch
+        # re-uses its own HBM instead of allocating four fresh
+        # [bucket, n] results (gridprobe GP004 audits the declaration).
         self._batched = jax.jit(
             jax.vmap(lambda p, q, v0, th0: solve(
                 p_inj=p, q_inj=q, v0=v0, theta0=th0
-            ))
+            )),
+            donate_argnums=(0, 1, 2, 3),
         )
         # Mesh form of the same while-loop solve: used for buckets the
         # device count divides; other buckets take the vmap program.
         self._mesh_lanes = _mesh_lanes(mesh)
         if self._mesh_lanes:
             self._batched_mesh, _ = make_newton_solver(
-                sys_, max_iter=max_iter, mesh=mesh, backend=backend
+                sys_, max_iter=max_iter, mesh=mesh, backend=backend,
+                precision=precision,
             )
 
     def solve(self, batch):
@@ -468,7 +475,7 @@ class N1Engine(_Engine):
     MAX_OUTAGES = 256
 
     def __init__(self, case: str, max_iter: int = 24, mesh=None,
-                 backend: str = "auto"):
+                 backend: str = "auto", precision: str = "auto"):
         super().__init__(case)
         from freedm_tpu.pf.n1 import make_n1_screen, secure_outages
 
@@ -479,7 +486,7 @@ class N1Engine(_Engine):
         # The mesh screen pads ragged lane counts internally, so it
         # serves every bucket; no fallback program needed.
         self._screen = make_n1_screen(sys_, max_iter=max_iter, mesh=mesh,
-                                      backend=backend)
+                                      backend=backend, precision=precision)
 
     def validate(self, req: N1Request):
         ks = list(req.outages)
@@ -552,9 +559,10 @@ class VVCEngine(_Engine):
     workload = "vvc"
 
     def __init__(self, case: str, pf_iters: int = 20, mesh=None,
-                 backend: str = "auto"):
-        # ``backend`` is accepted for engine-construction uniformity;
-        # the ladder sweep has no Jacobian, so it is a no-op here.
+                 backend: str = "auto", precision: str = "auto"):
+        # ``backend``/``precision`` are accepted for engine-construction
+        # uniformity; the ladder sweep has no Jacobian and no Krylov
+        # inner, so both are no-ops here.
         super().__init__(case)
         import jax
         import jax.numpy as jnp
@@ -724,15 +732,42 @@ def parse_request(workload: str, payload: dict):
 
 
 def default_buckets(max_batch: int) -> Tuple[int, ...]:
-    """Powers of two up to (and including) ``max_batch`` — the static
-    shape set jit programs are compiled for."""
-    out = []
+    """Powers of two plus their 1.5x intermediates up to (and
+    including) ``max_batch`` — the static shape set jit programs are
+    compiled for.
+
+    The intermediates (3, 6, 12, 24, 48, ...) halve the worst-case
+    padding waste of the pure power-of-two table (from ~50% of a
+    dispatch's lanes to ~33%); the extra compiles they cost are a
+    startup concern only — ``--serve-prewarm`` pushes every bucket
+    through XLA before traffic arrives (docs/serving.md).
+    :func:`padding_waste_pct` reports the table's worst case and
+    ``/stats`` carries both it and the measured padding fraction.
+    """
+    out = set()
     b = 1
     while b < max_batch:
-        out.append(b)
+        out.add(b)
+        mid = b + b // 2  # the 1.5x intermediate (integer for b >= 2)
+        if b >= 2 and mid < max_batch:
+            out.add(mid)
         b *= 2
-    out.append(int(max_batch))
-    return tuple(out)
+    out.add(int(max_batch))
+    return tuple(sorted(out))
+
+
+def padding_waste_pct(buckets: Tuple[int, ...]) -> float:
+    """Worst-case padded-lane share of a bucket table: the maximum,
+    over every real lane count up to the largest bucket, of
+    ``(bucket - lanes) / bucket`` for the bucket that lane count lands
+    in.  Pure powers of two sit just under 50% (lanes = 2^k + 1); the
+    default table with 1.5x intermediates stays under 34%."""
+    table = tuple(sorted(set(int(b) for b in buckets)))
+    worst = 0.0
+    for lanes in range(1, table[-1] + 1):
+        bucket = next(b for b in table if b >= lanes)
+        worst = max(worst, (bucket - lanes) / bucket)
+    return round(100.0 * worst, 2)
 
 
 class ServeConfig(NamedTuple):
@@ -767,6 +802,13 @@ class ServeConfig(NamedTuple):
     # small recognized cases on the measured-faster dense path while
     # client-named meshN scale tenants get the sparse one.
     pf_backend: str = "auto"
+    # Inner-solve precision for the Krylov-based pf/N-1 backends (CLI:
+    # --pf-precision): "f64" = full-precision inner GMRES, "mixed" =
+    # f32 inner under the working-dtype acceptance oracle with
+    # per-lane fallback (docs/solvers.md "Mixed precision"), "auto" =
+    # mixed on tpu/gpu, f64 on cpu.  Dense-backend engines validate
+    # and ignore it (no reduced-precision inner exists there).
+    pf_precision: str = "auto"
     # Pipelined dispatch (CLI: --serve-pipeline-depth): assembled
     # batches buffered per workload's device-executor lane, so batch
     # N+1 coalesces/pads while batch N solves and pf/n1/vvc no longer
@@ -812,6 +854,7 @@ class Service:
     MAX_ENGINES = 32
 
     def __init__(self, config: ServeConfig = ServeConfig(), start: bool = True):
+        from freedm_tpu.pf.krylov import PF_PRECISIONS
         from freedm_tpu.pf.sparse import BACKENDS
         from freedm_tpu.serve.batcher import MicroBatcher
 
@@ -819,6 +862,11 @@ class Service:
             raise ValueError(
                 f"unknown pf_backend {config.pf_backend!r} "
                 f"(have: {', '.join(BACKENDS)})"
+            )
+        if config.pf_precision not in PF_PRECISIONS:
+            raise ValueError(
+                f"unknown pf_precision {config.pf_precision!r} "
+                f"(have: {', '.join(PF_PRECISIONS)})"
             )
         if config.pipeline_depth < 0:
             raise ValueError(
@@ -913,7 +961,8 @@ class Service:
                 "vvc": {"pf_iters": cfg.vvc_pf_iters},
             }[workload]
             eng = _ENGINE_TYPES[workload](
-                case, mesh=self.mesh, backend=cfg.pf_backend, **kwargs
+                case, mesh=self.mesh, backend=cfg.pf_backend,
+                precision=cfg.pf_precision, **kwargs
             )
             if workload == "pf" and self.cache is not None:
                 from freedm_tpu.pf.sparse import resolve_backend
@@ -1307,10 +1356,27 @@ class Service:
                 f"{w}/{c}" for (w, c) in self._engines
             ),
             "buckets": list(self.config.bucket_table()),
+            # Padding honesty: the table's analytic worst-case padded-
+            # lane share plus the measured share of pad lanes actually
+            # dispatched (the 1.5x intermediate buckets exist to push
+            # both down — docs/serving.md).
+            "padding": {
+                "worst_case_pad_pct": padding_waste_pct(
+                    self.config.bucket_table()
+                ),
+                "dispatched_lanes": self.batcher.dispatched_lanes,
+                "padded_lanes": self.batcher.padded_lanes,
+                "observed_pad_pct": round(
+                    100.0 * self.batcher.padded_lanes
+                    / max(self.batcher.dispatched_lanes
+                          + self.batcher.padded_lanes, 1), 2
+                ),
+            },
             "max_batch": self.config.max_batch,
             "max_wait_ms": self.config.max_wait_ms,
             "mesh_devices": _mesh_lanes(self.mesh) or 1,
             "pf_backend": self.config.pf_backend,
+            "pf_precision": self.config.pf_precision,
             # Pipeline shape: buffered batches per executor lane (0 =
             # the serialized single-thread path) + live lane state.
             "pipeline_depth": self.config.pipeline_depth,

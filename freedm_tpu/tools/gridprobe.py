@@ -259,6 +259,7 @@ def build_inventory(programs: List[TracedProgram],
             "donation_candidates": [
                 list(c) for c in tp.donation_candidates()
             ],
+            "donated": tp.donated_args(),
         }
     return {
         "version": INVENTORY_VERSION,
@@ -321,11 +322,13 @@ def diff_inventory(current: dict, recorded: dict, flops_tol: float,
         ))
     for name in sorted(set(cur_p) & set(rec_p)):
         cur, rec = cur_p[name], rec_p[name]
-        for col in ("args", "results"):
-            if cur[col] != rec[col]:
+        # Structural columns compare exactly (donated included: a
+        # dropped donate_argnums is a silent HBM regression, not noise).
+        for col in ("args", "results", "donated"):
+            if cur.get(col) != rec.get(col):
                 findings.append(f(
                     f"program `{name}` {col} drifted: "
-                    f"{rec[col]} -> {cur[col]}"
+                    f"{rec.get(col)} -> {cur.get(col)}"
                 ))
         for col in ("eqns", "consts_bytes", "flops", "bytes_accessed"):
             drift = _rel_drift(cur.get(col), rec.get(col),

@@ -5,7 +5,7 @@
 | GP001 | dtype flow: f64 surfaces stay f64; bf16/f16 only inside declared boundaries |
 | GP002 | host transfer: no callback-shaped primitives inside traced programs |
 | GP003 | constant capture: no closure constant >= the size threshold      |
-| GP004 | donation readiness: declared donatable args have aliasable results |
+| GP004 | donation enforcement: declared donatable args are aliasable AND donated; donated args are declared |
 | GP005 | registry orphan: every registry entry builds and traces (engine-level) |
 | GP006 | inventory drift: traced program set matches tools/ir_inventory.json (engine-level) |
 
@@ -27,7 +27,7 @@ from freedm_tpu.tools.ir_rules.base import IrRule
 def all_ir_rules(const_mb: float = 0.25) -> List[IrRule]:
     """Fresh rule instances, in reporting order."""
     from freedm_tpu.tools.ir_rules.constant_capture import ConstantCapture
-    from freedm_tpu.tools.ir_rules.donation import DonationReadiness
+    from freedm_tpu.tools.ir_rules.donation import DonationEnforcement
     from freedm_tpu.tools.ir_rules.dtype_flow import DtypeFlow
     from freedm_tpu.tools.ir_rules.host_transfer import HostTransfer
 
@@ -35,5 +35,5 @@ def all_ir_rules(const_mb: float = 0.25) -> List[IrRule]:
         DtypeFlow(),
         HostTransfer(),
         ConstantCapture(const_mb=const_mb),
-        DonationReadiness(),
+        DonationEnforcement(),
     ]

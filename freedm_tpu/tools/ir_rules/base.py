@@ -189,6 +189,32 @@ class TracedProgram:
             total += int(nbytes)
         return total
 
+    def donated_args(self) -> List[int]:
+        """Argument indices the traced program actually DONATES.
+
+        A registered entrypoint that declares ``donate_argnums`` is
+        itself a jitted function; tracing it under the probe's outer
+        ``jax.jit`` leaves its body as a ``pjit`` eqn whose
+        ``donated_invars`` params carry the donation flags.  This maps
+        those flags back to the program's flattened argument indices
+        (the same index space as ``in_avals`` / ``donation_candidates``
+        / the spec's ``donatable`` declaration).  A program with no
+        pjit eqns — a plain function the probe wrapped itself — donates
+        nothing, which is exactly what an empty list reports.
+        """
+        invar_index = {
+            id(v): i for i, v in enumerate(self.closed_jaxpr.jaxpr.invars)
+        }
+        out: set = set()
+        for eqn in self.closed_jaxpr.jaxpr.eqns:
+            donated = eqn.params.get("donated_invars")
+            if not donated:
+                continue
+            for v, flag in zip(eqn.invars, donated):
+                if flag and id(v) in invar_index:
+                    out.add(invar_index[id(v)])
+        return sorted(out)
+
     def donation_candidates(self) -> List[Tuple[int, int, str]]:
         """Greedy (arg, result) pairs with identical dtype+shape — the
         buffers jit could alias with ``donate_argnums`` (the feed-in

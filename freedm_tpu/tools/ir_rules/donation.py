@@ -1,15 +1,26 @@
-"""GP004 — donation readiness: declared donatable buffers must have an
-aliasable result.
+"""GP004 — donation enforcement: declared donatable buffers must be
+aliasable AND actually donated by the compiled program.
 
-The MFU roadmap item (bf16/f32 inner GMRES + buffer donation across
-Newton iterations and serve dispatches) needs to know, per program,
-which argument buffers XLA could alias with a same-dtype-same-shape
-result — those are the HBM round trips donation would delete.  The
-engine computes the candidate pairs for every program and records them
-in the inventory (``donation_candidates``); this rule checks only the
-*declarations*: a spec that marks an argument index ``donatable`` when
-no result buffer can alias it has drifted from the program it
-describes — the same self-checking-registry posture as GL002's
+The MFU roadmap item shipped: the solver iteration programs and the
+serve dispatch buffers now declare ``donate_argnums`` on the buffers
+XLA can alias with a same-dtype-same-shape result (the HBM round trips
+donation deletes).  This rule keeps the registry's ``donatable``
+declarations and the programs in lock-step, in BOTH directions:
+
+- a declared index that is out of range, or that no result buffer can
+  alias, has drifted from the program it describes (the original
+  readiness check);
+- a declared index the traced program does NOT donate is a promise the
+  compiled code no longer keeps — the donation was dropped in a
+  refactor and the HBM win silently evaporated;
+- an argument the program donates WITHOUT declaring it is an invisible
+  aliasing hazard — donation destroys the caller's buffer, so it must
+  be visible in the registry where review sees it.
+
+The engine still records every aliasable pair in the inventory
+(``donation_candidates``) plus the actually-donated indices
+(``donated``), so the gap between "could donate" and "does donate"
+stays measurable — the same self-checking-registry posture as GL002's
 ``HOT_PATHS`` orphan findings.
 """
 
@@ -21,19 +32,30 @@ from freedm_tpu.tools.lint_rules.base import Finding
 from freedm_tpu.tools.ir_rules.base import IrRule, TracedProgram, aval_str
 
 
-class DonationReadiness(IrRule):
+class DonationEnforcement(IrRule):
     id = "GP004"
-    name = "donation-readiness"
-    hint = ("align the spec's donatable indices with the program: an "
-            "index is donation-ready only when some result has the "
-            "same dtype+shape (see the inventory's donation_candidates)")
+    name = "donation-enforcement"
+    hint = ("align the spec's donatable indices with the program: a "
+            "declared index must have a same-dtype+shape result buffer "
+            "AND be donated via donate_argnums on the jitted program; "
+            "a donated index must be declared (see the inventory's "
+            "donation_candidates / donated columns)")
 
     def check(self, program: TracedProgram) -> Iterable[Finding]:
         spec = program.spec
-        if not spec.donatable:
+        declared = set(spec.donatable)
+        donated = set(program.donated_args())
+        for idx in sorted(donated - declared):
+            yield self.finding(
+                spec,
+                f"argument {idx} ({aval_str(program.in_avals[idx])}) is "
+                f"donated by the program but not declared donatable in "
+                f"the registry",
+            )
+        if not declared:
             return
         n_args = len(program.in_avals)
-        for idx in spec.donatable:
+        for idx in sorted(declared):
             if idx >= n_args:
                 yield self.finding(
                     spec,
@@ -56,4 +78,12 @@ class DonationReadiness(IrRule):
                     spec,
                     f"argument {idx} ({aval_str(program.in_avals[idx])}) is "
                     f"declared donatable but no result buffer can alias it",
+                )
+                continue
+            if idx not in donated:
+                yield self.finding(
+                    spec,
+                    f"argument {idx} ({aval_str(program.in_avals[idx])}) is "
+                    f"declared donatable but the compiled program does not "
+                    f"donate it (donate_argnums dropped?)",
                 )

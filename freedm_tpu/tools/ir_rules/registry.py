@@ -41,6 +41,17 @@ from freedm_tpu.tools.ir_rules.base import F64Surface, ProgramSpec
 _BF16_PRECOND = ("preconditioner streams bf16 by design; Newton "
                  "iterates/residuals stay f64 (pf/krylov.py)")
 
+#: Boundary reason for the mixed-precision inner GMRES
+#: (--pf-precision mixed): the f32 Krylov iterates and the bf16
+#: preconditioner stream only PROPOSE a Newton update — the masked
+#: mismatch acceptance oracle and the convergence test stay in the
+#: working dtype, and a stalled lane falls back to the f64 inner
+#: (docs/solvers.md "Mixed precision").
+_MIXED_INNER = ("mixed-precision inner GMRES: f32 Krylov iterates + "
+                "bf16 preconditioner propose updates; the f64 masked-"
+                "mismatch acceptance oracle + per-lane fallback keep "
+                "the convergence contract (pf/krylov.py)")
+
 
 def _bus_case(name: str):
     from freedm_tpu.serve.service import _resolve_bus_case
@@ -80,6 +91,23 @@ def _krylov():
     from freedm_tpu.pf.krylov import make_krylov_solver
 
     solve, _ = make_krylov_solver(synthetic_mesh(40), inner_iters=8)
+    return _probe(solve)
+
+
+def _krylov_mixed():
+    from freedm_tpu.grid.cases import synthetic_mesh
+    from freedm_tpu.pf.krylov import make_krylov_solver
+
+    solve, _ = make_krylov_solver(synthetic_mesh(40), inner_iters=8,
+                                  precision="mixed")
+    return _probe(solve)
+
+
+def _newton_sparse_mixed():
+    from freedm_tpu.pf.sparse import make_sparse_newton_solver
+
+    solve, _ = make_sparse_newton_solver(_bus_case("case_ieee30"),
+                                         precision="mixed")
     return _probe(solve)
 
 
@@ -208,14 +236,30 @@ def _lb_round():
 PROGRAM_REGISTRY: List[ProgramSpec] = [
     ProgramSpec("pf/newton/dense", "freedm_tpu/pf/newton.py",
                 _newton_dense, f64=True),
+    # The iteration programs take (bp, bq, x, ps, qs, status); the
+    # scheduled injections ps/qs (flat argument indices 3, 4) are
+    # donated into the realized p/q results — GP004 enforces the
+    # declaration against the compiled donate_argnums.
     ProgramSpec("pf/newton/sparse", "freedm_tpu/pf/sparse.py",
                 _newton_sparse, f64=True,
                 allow_dtypes=frozenset({"bfloat16"}),
-                boundary_reason=_BF16_PRECOND),
+                boundary_reason=_BF16_PRECOND,
+                donatable=(3, 4)),
+    ProgramSpec("pf/newton/sparse/mixed", "freedm_tpu/pf/sparse.py",
+                _newton_sparse_mixed, f64=True,
+                allow_dtypes=frozenset({"bfloat16", "float32"}),
+                boundary_reason=_MIXED_INNER,
+                donatable=(3, 4)),
     ProgramSpec("pf/krylov", "freedm_tpu/pf/krylov.py",
                 _krylov, f64=True,
                 allow_dtypes=frozenset({"bfloat16"}),
-                boundary_reason=_BF16_PRECOND),
+                boundary_reason=_BF16_PRECOND,
+                donatable=(3, 4)),
+    ProgramSpec("pf/krylov/mixed", "freedm_tpu/pf/krylov.py",
+                _krylov_mixed, f64=True,
+                allow_dtypes=frozenset({"bfloat16", "float32"}),
+                boundary_reason=_MIXED_INNER,
+                donatable=(3, 4)),
     ProgramSpec("pf/fdlf", "freedm_tpu/pf/fdlf.py", _fdlf, f64=True),
     ProgramSpec("pf/ladder", "freedm_tpu/pf/ladder.py", _ladder, f64=True),
     ProgramSpec("pf/dc/solve", "freedm_tpu/pf/dc.py", _dc_solve, f64=True),
@@ -223,14 +267,24 @@ PROGRAM_REGISTRY: List[ProgramSpec] = [
     ProgramSpec("pf/n1/smw", "freedm_tpu/pf/n1.py", _n1_smw, f64=True),
     ProgramSpec("serve/cache/delta", "freedm_tpu/serve/cache.py",
                 _cache_delta, f64=True),
+    # Serve dispatch buffers: the padded (p, q, v0, th0) batch donates
+    # into the result's (p, q, v, theta) — four [bucket, n] HBM round
+    # trips deleted per dispatch.
     ProgramSpec("serve/pf/bucket4", "freedm_tpu/serve/service.py",
-                _serve_pf_bucket, f64=True),
+                _serve_pf_bucket, f64=True,
+                donatable=(0, 1, 2, 3)),
     ProgramSpec("serve/vvc/bucket2", "freedm_tpu/serve/service.py",
                 _serve_vvc_bucket, f64=True),
+    # QSTS chunk carries: the state NamedTuple (flat argument indices
+    # 0..9 bus / 0..7 feeder) round-trips through host numpy at chunk
+    # boundaries, so its device copy donates into the identically-
+    # shaped output state.
     ProgramSpec("qsts/bus_chunk", "freedm_tpu/scenarios/engine.py",
-                _qsts_bus_chunk, f64=True),
+                _qsts_bus_chunk, f64=True,
+                donatable=tuple(range(10))),
     ProgramSpec("qsts/feeder_chunk", "freedm_tpu/scenarios/engine.py",
-                _qsts_feeder_chunk, f64=True),
+                _qsts_feeder_chunk, f64=True,
+                donatable=tuple(range(8))),
     ProgramSpec("lb/auction_round", "freedm_tpu/modules/lb.py",
                 _lb_round, f64=False),
 ]
@@ -261,7 +315,7 @@ def _true_mismatch_surface():
         v=np.ones(n, np.float32), theta=np.zeros(n, np.float32),
         p=np.zeros(n, np.float32), q=np.zeros(n, np.float32),
         iterations=np.int32(0), converged=np.bool_(False),
-        mismatch=np.float32(1.0),
+        mismatch=np.float32(1.0), fallbacks=np.int32(0),
     )
     return true_mismatch, (sys_, res)
 

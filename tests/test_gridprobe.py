@@ -239,7 +239,8 @@ def test_gp003_arg_threading_is_clean(tmp_path):
 
 GP004_BAD = """
     def build():
-        return (lambda x: jnp.sum(x)), (jnp.ones(5),)
+        fn = jax.jit(lambda x: jnp.sum(x), donate_argnums=(0,))
+        return fn, (jnp.ones(5),)
     PROGRAM_REGISTRY = [
         ProgramSpec("fix/donate", "freedm_tpu/pf/newton.py", build,
                     donatable=(0,)),
@@ -248,10 +249,29 @@ GP004_BAD = """
 
 GP004_CLEAN = """
     def build():
-        return (lambda x: x * 2.0), (jnp.ones(5),)
+        fn = jax.jit(lambda x: x * 2.0, donate_argnums=(0,))
+        return fn, (jnp.ones(5),)
     PROGRAM_REGISTRY = [
         ProgramSpec("fix/donate_ok", "freedm_tpu/pf/newton.py", build,
                     donatable=(0,)),
+    ]
+"""
+
+GP004_NOT_DONATED = """
+    def build():
+        return (lambda x: x * 2.0), (jnp.ones(5),)
+    PROGRAM_REGISTRY = [
+        ProgramSpec("fix/undonated", "freedm_tpu/pf/newton.py", build,
+                    donatable=(0,)),
+    ]
+"""
+
+GP004_UNDECLARED = """
+    def build():
+        fn = jax.jit(lambda x: x * 2.0, donate_argnums=(0,))
+        return fn, (jnp.ones(5),)
+    PROGRAM_REGISTRY = [
+        ProgramSpec("fix/undeclared", "freedm_tpu/pf/newton.py", build),
     ]
 """
 
@@ -262,6 +282,23 @@ def test_gp004_declared_donation_without_alias(tmp_path):
     assert "no result buffer can alias" in findings[0].message
 
 
+def test_gp004_declared_but_not_donated(tmp_path):
+    # The flip side shipped with the donation work: a declared
+    # donatable pair the compiled program does NOT donate is a dropped
+    # HBM win, not a pass.
+    findings = _findings(_registry(tmp_path, GP004_NOT_DONATED))
+    assert _rules_of(findings) == ["GP004"]
+    assert "does not donate" in findings[0].message
+
+
+def test_gp004_donated_but_not_declared(tmp_path):
+    # Donation destroys the caller's buffer — an undeclared
+    # donate_argnums is an invisible aliasing hazard.
+    findings = _findings(_registry(tmp_path, GP004_UNDECLARED))
+    assert _rules_of(findings) == ["GP004"]
+    assert "not declared donatable" in findings[0].message
+
+
 def test_gp004_checks_declared_index_not_greedy_pairing(tmp_path):
     # Two same-shaped arguments, one result: the inventory's greedy
     # pairing gives the candidate to arg 0, but declaring arg 1
@@ -269,7 +306,8 @@ def test_gp004_checks_declared_index_not_greedy_pairing(tmp_path):
     # index directly against the results.
     reg = _registry(tmp_path, """
         def build():
-            return (lambda x, y: x + y), (jnp.ones(5), jnp.ones(5))
+            fn = jax.jit(lambda x, y: x + y, donate_argnums=(1,))
+            return fn, (jnp.ones(5), jnp.ones(5))
         PROGRAM_REGISTRY = [
             ProgramSpec("fix/second_arg", "freedm_tpu/pf/newton.py",
                         build, donatable=(1,)),
@@ -291,9 +329,12 @@ def test_gp004_aliasable_declaration_is_clean_and_recorded(tmp_path):
     res = run_probe(registry_file=_registry(tmp_path, GP004_CLEAN),
                     inventory_mode="skip")
     assert res.findings == []
-    cands = res.inventory["programs"]["fix/donate_ok"][
-        "donation_candidates"]
+    prog = res.inventory["programs"]["fix/donate_ok"]
+    cands = prog["donation_candidates"]
     assert cands and cands[0][:2] == [0, 0]
+    # The inventory records what the compiled program actually donates,
+    # so the could-vs-does gap stays measurable.
+    assert prog["donated"] == [0]
 
 
 # ---------------------------------------------------------------------------

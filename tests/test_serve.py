@@ -59,10 +59,32 @@ def _ticket(lanes=1, deadline=None, key=("pf", "case14")):
     return Ticket(key, None, {}, lanes, deadline)
 
 
-def test_default_buckets_are_powers_of_two_capped():
-    assert default_buckets(64) == (1, 2, 4, 8, 16, 32, 64)
-    assert default_buckets(6) == (1, 2, 4, 6)
+def test_default_buckets_powers_of_two_plus_intermediates():
+    # Powers of two PLUS the 1.5x intermediates (3, 6, 12, ...): the
+    # fatter table caps worst-case padding waste at ~33% instead of
+    # ~50% (prewarm hides the extra compiles).
+    assert default_buckets(64) == (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64)
+    assert default_buckets(6) == (1, 2, 3, 4, 6)
     assert default_buckets(1) == (1,)
+
+
+def test_bucket_padding_waste_is_reduced_and_reported(svc):
+    from freedm_tpu.serve.service import padding_waste_pct
+
+    pow2 = (1, 2, 4, 8, 16, 32, 64)
+    fat = default_buckets(64)
+    # The worst case drops from just-under-50% (2^k + 1 lanes) to
+    # under 34% — the satellite's pinned reduction.
+    assert padding_waste_pct(pow2) > 45.0
+    assert padding_waste_pct(fat) <= 34.0
+    # /stats carries both the analytic worst case and the measured
+    # padding of what was actually dispatched.
+    pad = svc.stats()["padding"]
+    assert pad["worst_case_pad_pct"] == padding_waste_pct(
+        svc.config.bucket_table()
+    )
+    assert pad["dispatched_lanes"] >= 0
+    assert 0.0 <= pad["observed_pad_pct"] <= 100.0
 
 
 def test_queue_sheds_on_overload_in_lanes():
