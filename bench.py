@@ -1470,6 +1470,144 @@ def bench_sparse(with_10k: bool = False) -> dict:
     return out
 
 
+def bench_topo(chunk: int = 4096, refactor_lanes: int = 32,
+               top_k: int = 8) -> dict:
+    """``--sections topo``: the switching-screen engine's gate set
+    (ISSUE 15 acceptance; ROADMAP "Topology optimization").
+
+    - ``topo_variants_per_sec`` — the headline row ``perf_gate`` pins
+      with ``--floor topo_variants_per_sec=10000``: every rank-≤2
+      variant of a 118-bus mesh through the full screen ladder
+      (vectorized radiality check + rank-r SMW lanes + on-device top-k
+      merge), chunked exactly like the sweep job runs it;
+    - ``topo_smw_vs_refactor_speedup`` — the same variants solved by
+      per-lane B′ re-formation + dense solve (the per-variant
+      refactorization the SMW lanes delete), per-variant time ratio;
+    - ``topo_ac_verify_topk_ms`` — the shortlist's sparse-backend AC
+      verify wall (the "verify" half of screen-then-verify);
+    - ``topo_excluded_pct`` — share of variants the screen excludes
+      (structural disconnection + the SMW backstop; the agreement
+      between the two checks is pinned by tests — the bench just
+      reports the rate).
+    """
+    import jax.numpy as jnp_
+
+    from freedm_tpu.pf import topo as tp
+
+    sys_ = synthetic_mesh(118, seed=1, load_mw=10.0, chord_frac=1.0)
+    m = sys_.n_branch
+    ts = tp.make_topo_screen(sys_, r_max=2)
+    rad = tp.make_radiality_check(sys_, r_max=2)
+    merge = tp.make_topk_merge(2, top_k)
+    variants = tp.enumerate_variants(np.arange(m), 2)
+    v_total = variants.shape[0]
+
+    def run_all():
+        best = merge.init()
+        excluded = 0
+        for v0 in range(0, v_total, chunk):
+            block = variants[v0:v0 + chunk]
+            real = block.shape[0]
+            if real < chunk:
+                block = np.concatenate(
+                    [block, np.repeat(block[-1:], chunk - real, axis=0)]
+                )
+            sl = jnp_.asarray(block)
+            valid = jnp_.arange(chunk) < real
+            # The shared ladder (pf/topo.screen_chunk): the bench runs
+            # the SAME masking/objective/accounting as the serve engine
+            # and the sweep job.
+            verdict = tp.screen_chunk(ts, rad, sl, valid, "mesh",
+                                      "loss", 1.0)
+            gid = jnp_.asarray(v0 + np.arange(chunk), jnp_.int32)
+            best = merge(*best, verdict.objective, sl, gid)
+            excluded += int(np.asarray(
+                verdict.disconnected + verdict.islanded
+            ))
+        jax.block_until_ready(best[0])
+        return best, excluded
+
+    (best, excluded) = run_all()  # compile + warm
+    t0 = time.perf_counter()
+    best, excluded = run_all()
+    dt = time.perf_counter() - t0
+    rate = v_total / dt
+
+    # Per-variant refactorization head-to-head: re-form B′ with the
+    # lane's status and dense-solve it — the O(n³)-per-variant path the
+    # SMW lanes replace.  Feasible lanes only (a singular refactorized
+    # B′ would be garbage, not slow).
+    from freedm_tpu.pf.fdlf import decoupled_parts
+    from freedm_tpu.utils import cplx as _cplx
+
+    rdtype = _cplx.default_rdtype(None)
+    parts = decoupled_parts(sys_, rdtype)
+    th_free = parts.th_free
+    p0 = jnp_.asarray(sys_.p_inj, rdtype)
+    obj_all = np.asarray(best[0], np.float64)
+    sl_all = np.asarray(best[1], np.int64)
+    feasible_rows = sl_all[np.isfinite(obj_all)]
+    # A MIXED-rank sample: enumeration is rank-ascending, so a naive
+    # [:N] slice would measure rank-1 lanes only and never exercise the
+    # [r, r] capacitance solve the head-to-head exists to gate.
+    pool = np.asarray(tp.enumerate_variants(np.arange(9), 2))
+    n1_rows = pool[pool[:, 1] < 0][: refactor_lanes // 4]
+    n2_rows = pool[pool[:, 1] >= 0][: refactor_lanes - n1_rows.shape[0]]
+    sample = np.concatenate([n1_rows, n2_rows])[:refactor_lanes]
+
+    @jax.jit
+    def refactor_screen(slots):
+        def lane(sl):
+            drop = jnp_.where(sl >= 0, sl, m)
+            status = jnp_.ones(m, rdtype).at[drop].set(0.0, mode="drop")
+            b = parts.b_prime(status)
+            rhs = jnp_.where(th_free > 0, p0, 0.0)
+            return jnp_.linalg.solve(b, rhs)
+
+        return jax.vmap(lane)(jnp_.asarray(slots))
+
+    ms_refactor = _time(
+        lambda: refactor_screen(sample), lambda r: r, reps=3
+    ) * 1000.0 / sample.shape[0]
+    smw_detail = ts.detail(np.asarray(sample, np.int32), flow_limit=1.0)
+    ms_smw = _time(
+        lambda: ts.screen(np.asarray(sample, np.int32), flow_limit=1.0),
+        lambda r: r.worst_flow, reps=10,
+    ) * 1000.0 / sample.shape[0]
+    # Equivalence stamp: the two paths solve the same systems.
+    ref_theta = np.asarray(refactor_screen(sample))
+    smw_theta = np.asarray(smw_detail.theta)
+    ok = ~np.asarray(smw_detail.islanded)
+    dtheta = float(np.max(np.abs(ref_theta[ok] - smw_theta[ok])))
+    # f64 under x64 (tests/CI); f32 noise floor on accelerator runs.
+    tol = 1e-8 if rdtype == jnp_.float64 else 1e-3
+    assert dtheta < tol, f"SMW drifted from refactorization: {dtheta}"
+
+    # Shortlist AC verify wall (sparse backend, warm-started lanes).
+    # Pad to the verifier's compiled [top_k, m] contract with base-
+    # topology rows when fewer shortlist rows are feasible.
+    verifier = tp.make_ac_verifier(sys_, k=top_k)
+    k_feasible = min(top_k, feasible_rows.shape[0])
+    short = np.full((top_k, feasible_rows.shape[1]), -1, np.int32)
+    short[:k_feasible] = feasible_rows[:k_feasible]
+    status = np.asarray(tp.status_from_slots(short, m))
+    r = verifier(status)
+    assert bool(np.all(np.asarray(r.converged)[:k_feasible])), \
+        "shortlist AC verify diverged"
+    ac_ms = _time(lambda: verifier(status), lambda x: x.v, reps=3) * 1000.0
+
+    return {
+        "topo_bench_variants": int(v_total),
+        "topo_variants_per_sec": round(rate, 1),
+        "topo_chunk_variants": int(chunk),
+        "topo_smw_per_variant_us": round(ms_smw * 1000.0, 3),
+        "topo_refactor_per_variant_us": round(ms_refactor * 1000.0, 3),
+        "topo_smw_vs_refactor_speedup": round(ms_refactor / ms_smw, 2),
+        "topo_ac_verify_topk_ms": round(ac_ms, 2),
+        "topo_excluded_pct": round(100.0 * excluded / v_total, 2),
+    }
+
+
 def bench_quick() -> dict:
     """The cheap subset the CI perf gate runs twice per build
     (``tools/perf_gate.py``): small cases, short compiles, enough reps
@@ -1517,7 +1655,10 @@ def main(argv=None) -> None:
     ap.add_argument(
         "--sections", default="solvers,serve,qsts",
         help="comma list of sections to run: solvers, serve, qsts, quick, "
-             "mesh, sparse, cache, mfu (default solvers,serve,qsts; mfu is "
+             "mesh, sparse, cache, mfu, topo (default solvers,serve,qsts; "
+             "topo is the switching-screen gate set — variants/s through "
+             "the radiality+SMW+top-k ladder, SMW-vs-refactorization "
+             "head-to-head, shortlist AC-verify wall; mfu is "
              "the solver-core MFU gate set — krylov lane throughput at "
              "mixed precision, mixed-vs-f64 head-to-head, donation "
              "on/off, and with --mfu-10k the 10k-bus wall; quick is "
@@ -1547,11 +1688,11 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
     sections = {s.strip() for s in args.sections.split(",") if s.strip()}
     unknown = sections - {"solvers", "serve", "qsts", "quick", "mesh",
-                          "sparse", "cache", "mfu"}
+                          "sparse", "cache", "mfu", "topo"}
     if unknown or not sections:
         raise SystemExit(
             f"--sections needs a non-empty subset of solvers,serve,qsts,"
-            f"quick,mesh,sparse,cache,mfu; got {args.sections!r}"
+            f"quick,mesh,sparse,cache,mfu,topo; got {args.sections!r}"
         )
 
     obj: dict = {}
@@ -1561,6 +1702,8 @@ def main(argv=None) -> None:
         obj["mfu"] = bench_mfu(lanes=args.mfu_lanes, with_10k=args.mfu_10k)
     if "cache" in sections:
         obj["cache"] = bench_cache()
+    if "topo" in sections:
+        obj["topo"] = bench_topo()
     if "qsts" in sections:
         obj["qsts"] = bench_qsts()
     if "mesh" in sections:
@@ -1628,6 +1771,15 @@ def main(argv=None) -> None:
             round(c["serve_cache_delta_speedup"] / 3.0, 2)
             if c["serve_cache_delta_speedup"] else None
         )
+    elif "metric" not in obj and "topo" in obj:
+        # topo-only invocation: the headline is the screen throughput
+        # (ISSUE 15 acceptance: >= 10k DC-screened variants/s on one
+        # host, floor-gated in CI).
+        t = obj["topo"]
+        obj["metric"] = "topo_variants_per_sec"
+        obj["value"] = t["topo_variants_per_sec"]
+        obj["unit"] = "variants/s"
+        obj["vs_baseline"] = round(t["topo_variants_per_sec"] / 10000.0, 2)
     elif "metric" not in obj and "mfu" in obj:
         # mfu-only invocation: the headline is the krylov lane speedup
         # over the r05 baseline (ISSUE 14 acceptance: >= 5x, or the

@@ -280,6 +280,23 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "or auto by backend (default auto; serves the "
                          "pf/N-1 engines and the QSTS scenario default, "
                          "docs/solvers.md)")
+    ap.add_argument("--topo-max-rank", type=int, default=None, metavar="R",
+                    help="simultaneous switch flips per topology-sweep "
+                         "variant (POST /v1/topo; default 2, hard cap 6)")
+    ap.add_argument("--topo-max-variants", type=int, default=None,
+                    metavar="V",
+                    help="variant ceiling per synchronous /v1/topo "
+                         "request (async sweeps chunk past it; "
+                         "default 20000)")
+    ap.add_argument("--topo-top-k", type=int, default=None, metavar="K",
+                    help="AC-verified shortlist size of topology screens "
+                         "(also the verifier's compiled lane count; "
+                         "default 8)")
+    ap.add_argument("--topo-chunk-variants", type=int, default=None,
+                    metavar="V",
+                    help="default chunk length (variants) of async "
+                         "topology sweep jobs — each chunk checkpoints "
+                         "for exact resume (default 4096)")
     ap.add_argument("--qsts-workers", type=int, default=None, metavar="N",
                     help="background workers for QSTS scenario jobs "
                          "(default 1; jobs ride the serve port)")
@@ -370,6 +387,10 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("serve_cache_mb", "serve_cache_mb"),
         ("serve_cache_ttl_s", "serve_cache_ttl_s"),
         ("serve_delta_max_rank", "serve_delta_max_rank"),
+        ("topo_max_rank", "topo_max_rank"),
+        ("topo_max_variants", "topo_max_variants"),
+        ("topo_top_k", "topo_top_k"),
+        ("topo_chunk_variants", "topo_chunk_variants"),
         ("qsts_workers", "qsts_workers"), ("qsts_max_jobs", "qsts_max_jobs"),
         ("qsts_chunk_steps", "qsts_chunk_steps"),
         ("qsts_checkpoint_dir", "qsts_checkpoint_dir"),
@@ -664,6 +685,9 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             delta_max_rank=cfg.serve_delta_max_rank,
             pf_backend=cfg.pf_backend,
             pf_precision=cfg.pf_precision,
+            topo_max_rank=cfg.topo_max_rank,
+            topo_max_variants=cfg.topo_max_variants,
+            topo_top_k=cfg.topo_top_k,
             # --mesh-devices also shards the engines' solver lanes
             # (docs/scaling.md); 0 keeps every engine single-device.
             mesh_devices=mesh_n,
@@ -674,6 +698,7 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             max_pending=cfg.qsts_max_jobs,
             checkpoint_dir=cfg.qsts_checkpoint_dir,
             default_chunk_steps=cfg.qsts_chunk_steps,
+            default_topo_chunk=cfg.topo_chunk_variants,
             # Submitted studies shard their scenario axis by default;
             # a request's own mesh_devices field overrides.
             default_mesh_devices=mesh_n,

@@ -311,6 +311,16 @@ class GlobalConfig:
     # tpu/gpu and f64 on cpu.  Same threading convention as
     # pf-backend: serve engines + QSTS scenario default.
     pf_precision: str = "auto"
+    # Topology sweeps (freedm_tpu.pf.topo), exposed on the serve port
+    # as POST /v1/topo (sync screen) and POST /v1/topo/sweep (async
+    # job): the simultaneous-flip cap per variant, the sync endpoint's
+    # per-request variant ceiling, the AC-verified shortlist size, and
+    # the async sweep's default chunk length in variants (each chunk
+    # checkpoints, so a killed sweep resumes; docs/topology.md).
+    topo_max_rank: int = 2
+    topo_max_variants: int = 20000
+    topo_top_k: int = 8
+    topo_chunk_variants: int = 4096
     # QSTS scenario jobs (freedm_tpu.scenarios), exposed on the serve
     # port as POST /v1/qsts + GET /v1/jobs/<id>: background worker
     # count (the solvers share one device — 1 is the right default),

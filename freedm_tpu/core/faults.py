@@ -85,6 +85,9 @@ KNOWN_POINTS: Dict[str, str] = {
     "qsts.worker.crash": "raise at a QSTS chunk boundary — the job "
                          "manager requeues the job from its checkpoint "
                          "(scenarios/jobs.py)",
+    "topo.worker.crash": "raise at a topology-sweep chunk boundary — "
+                         "same requeue-from-checkpoint contract, scoped "
+                         "to kind=topo jobs (scenarios/jobs.py)",
 }
 
 

@@ -1,0 +1,1068 @@
+"""Batched topology sweeps: switching-screen lanes over one B′ LU.
+
+The topology-optimization workload the DC machinery was built for
+(PAPERS.md: "Accelerated DC loadflow solver for topology optimization";
+ROADMAP "Topology optimization as a first-class workload"): enumerate
+or sample switch-state *variants* of a case — up to ``max_rank``
+simultaneous line flips — and screen thousands of them per request
+against ONE cached B′ factorization.  The ladder, cheapest first:
+
+1. **Vectorized radiality/connectivity check** — a batched min-label
+   connected-components pass over the closed-branch incidence (scatter-
+   min + pointer jumping inside a ``lax.while_loop``, no host loop):
+   variants that disconnect the network (or, in ``mode="radial"``,
+   fail the spanning-tree count) are excluded before any solve.
+2. **Rank-r Sherman–Morrison–Woodbury screen** — opening the branch set
+   S changes B′ by ``−Σ_{k∈S} w_k a_k a_kᵀ``, so every variant lane is
+   a capacitance-matrix solve off the SAME base factorization:
+
+       C = I_r − diag(w_S)·A_Sᵀ Z,   Z = B′⁻¹ A   (one multi-RHS solve
+       θ_v = θ0 + Z_S C⁻¹ diag(w_S) A_Sᵀ θ0        at build time)
+
+   This generalizes the single-outage Sherman–Morrison lane of
+   :mod:`freedm_tpu.pf.dc` (r = 1 makes C the scalar ``1 − w·aᵀz``) to
+   simultaneous flips; a (numerically) singular C is the same islanding
+   backstop as dc.py's singular-denominator flag, now at rank r.
+   Padded slots (``-1``) carry zero weight, so one static ``[V, r]``
+   shape serves every rank ≤ r — rank 0 is the base case lane.
+3. **Objective ranking** — DC loss proxy (Σ r·f²), worst loading
+   (max |f|), or violation count against a flow limit; islanding lanes
+   rank +inf.  A donating top-k merge carries the running shortlist
+   across chunks on device (GP004 audits the declaration).
+4. **AC verify** — the top-k shortlist is re-solved on the sparse
+   backend (status-traced warm-started lanes) before any answer is
+   returned; infeasible shortlist slots are replaced by the base
+   topology so an islanding variant can never reach an AC lane.
+
+Exposed three ways with this one implementation: the sync
+``POST /v1/topo`` engine (:mod:`freedm_tpu.serve.service`), the async
+job beside QSTS (:mod:`freedm_tpu.scenarios.jobs` — chunked +
+checkpointed, exact resume), and ``mesh``-sharded screen lanes under
+``--mesh-devices``.  ``bench.py --sections topo`` gates the headline
+``topo_variants_per_sec`` floor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+import time
+from dataclasses import dataclass
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from freedm_tpu.core import metrics as obs
+from freedm_tpu.core import profiling
+from freedm_tpu.core import tracing
+from freedm_tpu.grid.bus import BusSystem
+from freedm_tpu.pf.fdlf import decoupled_parts
+from freedm_tpu.utils import cplx
+
+#: |det C| below this marks the rank-r capacitance matrix singular —
+#: the variant islands the network (rank-r analogue of dc._ISLAND_EPS).
+_ISLAND_EPS = 1e-6
+
+TOPO_OBJECTIVES = ("loss", "max_flow", "violations")
+TOPO_MODES = ("mesh", "radial")
+TOPO_SEARCHES = ("exhaustive", "neighborhood")
+
+#: Hard cap on simultaneous flips per variant: the capacitance matrix
+#: is [r, r] per lane and enumeration is combinatorial in r.
+MAX_TOPO_RANK = 6
+
+#: Summary keys that legitimately differ between two runs of the same
+#: sweep (wall clock + bookkeeping) — the resume-exactness contract is
+#: "summaries equal modulo these", mirroring scenarios.engine's
+#: SUMMARY_TIMING_KEYS discipline.
+TOPO_TIMING_KEYS = ("wall_s", "variants_per_sec", "chunks_done",
+                    "resumed_from_chunk", "mesh_devices")
+
+#: TopoSweepSpec keys that describe execution placement, not the sweep.
+_MESH_SPEC_KEYS = ("mesh_devices",)
+
+CKPT_VERSION = 1
+
+
+class SweepCancelled(Exception):
+    """Raised between chunks when the caller's cancel event is set; the
+    last chunk checkpoint (if any) stays on disk for a later resume."""
+
+
+def strip_topo_timing(summary: dict) -> dict:
+    """The comparison view of a sweep summary: timing keys out."""
+    return {k: v for k, v in summary.items() if k not in TOPO_TIMING_KEYS}
+
+
+def _placement_free(d: dict) -> dict:
+    return {k: v for k, v in d.items() if k not in _MESH_SPEC_KEYS}
+
+
+# ---------------------------------------------------------------------------
+# Variant generation (host side, deterministic in the spec)
+# ---------------------------------------------------------------------------
+
+
+def count_exhaustive(n_switches: int, max_rank: int) -> int:
+    """Variants an exhaustive enumeration produces (ranks 1..max_rank)."""
+    return sum(math.comb(int(n_switches), r)
+               for r in range(1, int(max_rank) + 1))
+
+
+def enumerate_variants(switches, max_rank: int) -> np.ndarray:
+    """All open-sets of 1..``max_rank`` switches as a ``[V, max_rank]``
+    int32 slot matrix of BRANCH indices, ``-1``-padded — rank ascending,
+    lexicographic within a rank (deterministic, resume-stable)."""
+    sw = np.asarray(switches, np.int64)
+    r_max = int(max_rank)
+    rows = []
+    for r in range(1, r_max + 1):
+        for combo in itertools.combinations(range(sw.shape[0]), r):
+            row = np.full(r_max, -1, np.int32)
+            row[:r] = sw[list(combo)]
+            rows.append(row)
+    if not rows:
+        return np.empty((0, r_max), np.int32)
+    return np.stack(rows).astype(np.int32)
+
+
+def neighborhood_variants(switches, max_rank: int, samples: int,
+                          seed: int) -> np.ndarray:
+    """Seeded neighborhood sample for spaces too large to enumerate:
+    ``samples`` distinct open-sets of rank 1..``max_rank``, drawn by a
+    seeded generator — a pure function of (switches, max_rank, samples,
+    seed), so a killed sweep regenerates the identical variant list."""
+    sw = np.asarray(switches, np.int64)
+    width = int(max_rank)  # slot-matrix columns stay the REQUESTED rank
+    # A drawn rank can never exceed the candidate count (choice without
+    # replacement) — fewer switches than max_rank just caps the draw.
+    r_cap = min(width, int(sw.shape[0]))
+    if r_cap < 1:
+        return np.empty((0, max(width, 1)), np.int32)
+    rng = np.random.default_rng(int(seed))
+    seen = set()
+    rows = []
+    # Bounded draw loop: the distinct-subset space can be smaller than
+    # ``samples``, so cap attempts rather than spin forever.
+    space = count_exhaustive(sw.shape[0], r_cap)
+    want = min(int(samples), space)
+    attempts = 0
+    while len(rows) < want and attempts < 50 * max(want, 1):
+        attempts += 1
+        r = int(rng.integers(1, r_cap + 1))
+        combo = tuple(sorted(rng.choice(sw.shape[0], size=r,
+                                        replace=False).tolist()))
+        if combo in seen:
+            continue
+        seen.add(combo)
+        row = np.full(width, -1, np.int32)
+        row[:r] = sw[list(combo)]
+        rows.append(row)
+    if not rows:
+        return np.empty((0, width), np.int32)
+    return np.stack(rows).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Vectorized radiality / connectivity check
+# ---------------------------------------------------------------------------
+
+
+class RadialityResult(NamedTuple):
+    """Structural verdict per variant lane."""
+
+    connected: jax.Array  # [V] bool: closed-branch graph is one island
+    radial: jax.Array  # [V] bool: connected AND a spanning tree
+
+
+def make_radiality_check(sys: BusSystem, r_max: int, max_sweeps: int = 0):
+    """Compile the batched connectivity/radiality check.
+
+    Returns ``check(slots)`` with ``slots`` a ``[V, r_max]`` int array
+    of opened branch indices (``-1`` = unused slot): per lane, min-label
+    connected components over the CLOSED branches — scatter-min over
+    edge endpoints plus a pointer-jumping compression step inside a
+    bounded ``lax.while_loop`` — entirely on device (no host loop, no
+    per-variant union-find).  ``radial`` additionally requires the
+    spanning-tree branch count ``m − r == n − 1``.
+    """
+    n = sys.n_bus
+    m = sys.n_branch
+    f_idx = jnp.asarray(np.asarray(sys.from_bus))
+    t_idx = jnp.asarray(np.asarray(sys.to_bus))
+    cap = int(max_sweeps) if max_sweeps else n + 1
+
+    @jax.jit
+    def check(slots) -> RadialityResult:
+        slots = jnp.asarray(slots)
+
+        def lane(sl):
+            active = sl >= 0
+            k = jnp.where(active, sl, 0)
+            drop = jnp.where(active, k, m)
+            closed = jnp.ones(m, jnp.int32).at[drop].set(0, mode="drop")
+            sentinel = jnp.int32(n)
+            lab0 = jnp.arange(n, dtype=jnp.int32)
+
+            def cond(c):
+                _, changed, it = c
+                return jnp.logical_and(changed, it < cap)
+
+            def body(c):
+                lab, _, it = c
+                prop = jnp.where(
+                    closed > 0,
+                    jnp.minimum(lab[f_idx], lab[t_idx]),
+                    sentinel,
+                )
+                new = lab.at[f_idx].min(prop).at[t_idx].min(prop)
+                new = jnp.minimum(new, new[new])  # pointer jump
+                return new, jnp.any(new != lab), it + 1
+
+            lab, _, _ = jax.lax.while_loop(
+                cond, body, (lab0, jnp.bool_(True), jnp.int32(0))
+            )
+            connected = jnp.all(lab == 0)
+            n_open = jnp.sum(active.astype(jnp.int32))
+            radial = jnp.logical_and(connected, (m - n_open) == (n - 1))
+            return connected, radial
+
+        conn, rad = jax.vmap(lane)(slots)
+        return RadialityResult(connected=conn, radial=rad)
+
+    check.probe_target = lambda: (
+        check, (jnp.full((4, int(r_max)), -1, jnp.int32)
+                .at[:, 0].set(jnp.arange(4, dtype=jnp.int32)),)
+    )
+    return check
+
+
+# ---------------------------------------------------------------------------
+# Rank-r SMW screen lanes
+# ---------------------------------------------------------------------------
+
+
+class TopoScreenResult(NamedTuple):
+    """One screen pass's lane-batched output (all three objectives are
+    computed in one program; callers select with
+    :func:`select_objective`)."""
+
+    loss: jax.Array  # [V] DC loss proxy Σ r·f², pu
+    worst_flow: jax.Array  # [V] max |flow|, pu
+    violations: jax.Array  # [V] branches with |flow| > flow_limit
+    islanded: jax.Array  # [V] bool: singular capacitance matrix
+
+
+class TopoDetail(NamedTuple):
+    """Full per-variant state, for shortlist reporting and the oracle
+    tests (small V only — [V, n]/[V, m] outputs)."""
+
+    theta: jax.Array  # [V, n]
+    flows: jax.Array  # [V, m] (opened branches carry 0)
+    loss: jax.Array  # [V]
+    worst_flow: jax.Array  # [V]
+    violations: jax.Array  # [V]
+    islanded: jax.Array  # [V] bool
+
+
+class TopoScreen(NamedTuple):
+    """Compiled screen operators for one case (:func:`make_topo_screen`)."""
+
+    screen: "callable"  # (slots [V,r], flow_limit, p=None) -> TopoScreenResult
+    detail: "callable"  # same args -> TopoDetail
+    n_bus: int
+    n_branch: int
+    r_max: int
+
+
+def select_objective(res, objective: str):
+    """The ranking scalar of one screen result (+inf on islanded lanes;
+    lower is better for every objective)."""
+    if objective == "loss":
+        ob = res.loss
+    elif objective == "max_flow":
+        ob = res.worst_flow
+    elif objective == "violations":
+        ob = res.violations
+    else:
+        raise ValueError(
+            f"unknown objective {objective!r} "
+            f"(have: {', '.join(TOPO_OBJECTIVES)})"
+        )
+    return jnp.where(res.islanded, jnp.inf, ob)
+
+
+class ChunkVerdict(NamedTuple):
+    """One screened chunk's ranking vector + exclusion accounting —
+    THE shared per-chunk ladder of all three fronts (the sync engine,
+    the async sweep loop, and the bench), so masking/objective/
+    accounting semantics cannot drift between them.
+
+    The counts partition the chunk's valid lanes exactly:
+    ``feasible + disconnected + nonradial + islanded == valid count``
+    — ``islanded`` counts the lanes only the SMW singular-capacitance
+    backstop excluded (structurally connected/radial but numerically
+    singular; 0 whenever the structural check catches everything).
+    """
+
+    objective: jax.Array  # [V] ranking scalar; +inf = excluded
+    screen: TopoScreenResult
+    radiality: RadialityResult
+    feasible: jax.Array  # [] lanes with a finite objective
+    disconnected: jax.Array  # [] structural connectivity fires
+    nonradial: jax.Array  # [] connected but not a tree (radial mode)
+    islanded: jax.Array  # [] SMW backstop fires ALONE (see above)
+
+
+def screen_chunk(ts: "TopoScreen", rad_check, slots, valid, mode: str,
+                 objective: str, flow_limit) -> ChunkVerdict:
+    """Run one ``[V, r]`` slot block through the screen ladder:
+    structural radiality/connectivity check, rank-r SMW lanes, and the
+    mode/objective composition.  ``valid`` masks pad rows out of every
+    count and out of the ranking (their objective is +inf)."""
+    slots = jnp.asarray(slots)
+    valid = jnp.asarray(valid)
+    rr = rad_check(slots)
+    res = ts.screen(slots, flow_limit=flow_limit)
+    structural = jnp.logical_and(rr.connected, valid)
+    if mode == "radial":
+        structural = jnp.logical_and(structural, rr.radial)
+    obj = jnp.where(
+        jnp.logical_and(structural, ~res.islanded),
+        select_objective(res, objective),
+        jnp.inf,
+    )
+    nonradial = (
+        jnp.sum(jnp.logical_and(
+            jnp.logical_and(rr.connected, ~rr.radial), valid
+        )) if mode == "radial" else jnp.asarray(0)
+    )
+    return ChunkVerdict(
+        objective=obj,
+        screen=res,
+        radiality=rr,
+        feasible=jnp.sum(jnp.isfinite(obj)),
+        disconnected=jnp.sum(jnp.logical_and(~rr.connected, valid)),
+        nonradial=nonradial,
+        islanded=jnp.sum(jnp.logical_and(res.islanded, structural)),
+    )
+
+
+def make_topo_screen(
+    sys: BusSystem,
+    r_max: int,
+    dtype=None,
+    lu=None,
+    mesh=None,
+    batch_spec=None,
+) -> TopoScreen:
+    """Factorize B′ once (or adopt a cached ``lu_factor`` pair — the
+    serving cache's ``kind="lu"`` B′ half, same contract as
+    :func:`freedm_tpu.pf.dc.make_dc_solver`), pre-solve the masked
+    incidence columns of EVERY branch in one multi-RHS pass
+    (``Z = B′⁻¹A``, ``[n, m]``), and compile the rank-``r_max`` SMW
+    screen lanes.
+
+    ``screen(slots, flow_limit, p=None)``: ``slots`` is ``[V, r_max]``
+    int branch indices (``-1`` pads; rank 0 = the base case), returning
+    the three objective columns plus the islanding flag.  ``detail``
+    additionally returns per-variant angles/flows.  ``mesh`` shards the
+    variant-lane axis via ``shard_map`` (ragged counts padded with
+    replicas of the last lane and sliced off — byte-identical to the
+    vmap program, same discipline as the N-1 screen).
+    """
+    if not 1 <= int(r_max) <= MAX_TOPO_RANK:
+        raise ValueError(
+            f"r_max must be in [1, {MAX_TOPO_RANK}], got {r_max}"
+        )
+    r_max = int(r_max)
+    rdtype = cplx.default_rdtype(dtype)
+    n = sys.n_bus
+    m = sys.n_branch
+    parts = decoupled_parts(sys, rdtype)
+    th_free = parts.th_free
+    f_idx = jnp.asarray(np.asarray(sys.from_bus))
+    t_idx = jnp.asarray(np.asarray(sys.to_bus))
+    w = jnp.asarray(1.0 / sys.x, rdtype)
+    r_series = jnp.asarray(np.asarray(sys.r), rdtype)
+    p0 = jnp.asarray(sys.p_inj, rdtype)
+    mask_f = th_free[f_idx]
+    mask_t = th_free[t_idx]
+    eye_r = jnp.eye(r_max, dtype=rdtype)
+
+    if lu is None:
+        t0 = time.monotonic()
+        with jax.default_matmul_precision("highest"):
+            lu = jax.jit(jax.scipy.linalg.lu_factor)(parts.b_prime(None))
+            jax.block_until_ready(lu[0])
+        profiling.PROFILER.record_host("dc.factorize", time.monotonic() - t0)
+
+    # Z = B′⁻¹ A for every branch's masked update column, one multi-RHS
+    # solve at build time — per-variant work is then pure gathers.
+    t0 = time.monotonic()
+    rhs = np.zeros((n, m), np.float64)
+    rhs[np.asarray(sys.from_bus), np.arange(m)] += np.asarray(mask_f)
+    rhs[np.asarray(sys.to_bus), np.arange(m)] -= np.asarray(mask_t)
+    with jax.default_matmul_precision("highest"):
+        z_all = jax.scipy.linalg.lu_solve(lu, jnp.asarray(rhs, rdtype))
+        jax.block_until_ready(z_all)
+    profiling.PROFILER.record_host("topo.z_build", time.monotonic() - t0)
+
+    def _lane_state(lu_f, z, pj):
+        """Shared per-lane SMW correction: post-variant angles + the
+        singularity flag (the rank-r islanding backstop)."""
+        rhs_p = jnp.where(th_free > 0, pj, 0.0)
+        theta0 = jax.scipy.linalg.lu_solve(lu_f, rhs_p)
+
+        def lane(sl_row):
+            active = sl_row >= 0
+            act = active.astype(rdtype)
+            k = jnp.where(active, sl_row, 0)
+            zc = z[:, k] * act[None, :]  # [n, r]
+            wk = w[k] * act
+            fi, ti = f_idx[k], t_idx[k]
+            mf = mask_f[k] * act
+            mt = mask_t[k] * act
+            # aTz[i, j] = a_iᵀ z_j; C = I − diag(w)·AᵀZ.
+            a_t_z = zc[fi, :] * mf[:, None] - zc[ti, :] * mt[:, None]
+            cmat = eye_r - wk[:, None] * a_t_z
+            det = jnp.linalg.det(cmat)
+            islanded = jnp.abs(det) < _ISLAND_EPS
+            safe = jnp.where(islanded, eye_r, cmat)
+            a_t_th = theta0[fi] * mf - theta0[ti] * mt
+            y = jnp.linalg.solve(safe, wk * a_t_th)
+            theta_v = theta0 + zc @ y
+            flows = (theta_v[f_idx] - theta_v[t_idx]) * w
+            drop = jnp.where(active, k, m)
+            flows = flows.at[drop].set(0.0, mode="drop")
+            return theta_v, flows, islanded
+
+        return lane
+
+    def _objectives(flows, limit):
+        worst = jnp.max(jnp.abs(flows), axis=-1)
+        loss = jnp.sum(r_series * flows * flows, axis=-1)
+        viol = jnp.sum(
+            (jnp.abs(flows) > limit).astype(rdtype), axis=-1
+        )
+        return loss, worst, viol
+
+    @jax.jit
+    def _screen_impl(lu_f, z, slots, pj, limit) -> TopoScreenResult:
+        with jax.default_matmul_precision("highest"):
+            lane = _lane_state(lu_f, z, pj)
+            _, flows, islanded = jax.vmap(lane)(slots)
+            loss, worst, viol = _objectives(flows, limit)
+            return TopoScreenResult(
+                loss=loss, worst_flow=worst, violations=viol,
+                islanded=islanded,
+            )
+
+    @jax.jit
+    def _detail_impl(lu_f, z, slots, pj, limit) -> TopoDetail:
+        with jax.default_matmul_precision("highest"):
+            lane = _lane_state(lu_f, z, pj)
+            theta, flows, islanded = jax.vmap(lane)(slots)
+            loss, worst, viol = _objectives(flows, limit)
+            return TopoDetail(
+                theta=theta, flows=flows, loss=loss, worst_flow=worst,
+                violations=viol, islanded=islanded,
+            )
+
+    def _coerce(slots, limit, p):
+        sl = jnp.asarray(slots, jnp.int32)
+        if sl.ndim != 2 or sl.shape[1] != r_max:
+            raise ValueError(
+                f"slots must be [V, {r_max}] (this screen's r_max; pad "
+                f"unused columns with -1), got {tuple(sl.shape)}"
+            )
+        lim = jnp.asarray(limit, rdtype)
+        pj = p0 if p is None else jnp.asarray(p, rdtype)
+        return sl, lim, pj
+
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from freedm_tpu.parallel import mesh as pmesh
+
+        s1 = pmesh.lane_spec(mesh, 1, batch_spec=batch_spec)
+        s2 = pmesh.lane_spec(mesh, 2, batch_spec=batch_spec)
+        out_specs = TopoScreenResult(
+            loss=s1, worst_flow=s1, violations=s1, islanded=s1,
+        )
+        d = pmesh.lane_shards(mesh, batch_spec)
+        profiling.PROFILER.record_mesh("topo", d)
+
+        def _local(sl_block, pj, lim):
+            with jax.default_matmul_precision("highest"):
+                lane = _lane_state(lu, z_all, pj)
+                _, flows, islanded = jax.vmap(lane)(sl_block)
+                loss, worst, viol = _objectives(flows, lim)
+                return TopoScreenResult(
+                    loss=loss, worst_flow=worst, violations=viol,
+                    islanded=islanded,
+                )
+
+        # Built ONCE: the LU/Z factors replicate to every device and
+        # the injections/limit ride as replicated runtime arguments, so
+        # every call reuses one compiled sharded program.
+        _prog = pmesh.shard_batched(
+            _local, mesh, in_specs=(s2, P(), P()), out_specs=out_specs
+        )
+
+        def screen(slots, flow_limit=0.0, p=None) -> TopoScreenResult:
+            # Ragged lane counts pad with replicas of the last variant
+            # and slice back off — lanes are independent, so visible
+            # rows are unaffected (the N-1 screen's discipline, rank-2
+            # aware for the [V, r] slot matrix).
+            sl, lim, pj = _coerce(slots, flow_limit, p)
+            v = int(sl.shape[0])
+            pad = (-v) % d
+            if pad:
+                sl = jnp.concatenate([
+                    sl, jnp.broadcast_to(sl[-1:], (pad,) + sl.shape[1:])
+                ])
+            res = _prog(sl, pj, lim)
+            if pad:
+                res = jax.tree_util.tree_map(lambda x: x[:v], res)
+            return res
+    else:
+        def screen(slots, flow_limit=0.0, p=None) -> TopoScreenResult:
+            sl, lim, pj = _coerce(slots, flow_limit, p)
+            return _screen_impl(lu, z_all, sl, pj, lim)
+
+    def detail(slots, flow_limit=0.0, p=None) -> TopoDetail:
+        sl, lim, pj = _coerce(slots, flow_limit, p)
+        return _detail_impl(lu, z_all, sl, pj, lim)
+
+    # gridprobe seams: the jitted lane programs, LU/Z as arguments
+    # (captured factors would fold 8n² + 8nm bytes into the compiled
+    # payload — the same GP003 discipline as pf/dc.py).
+    _probe_slots = (jnp.full((4, r_max), -1, jnp.int32)
+                    .at[:, 0].set(jnp.arange(4, dtype=jnp.int32)))
+    screen.probe_target = lambda: (
+        _screen_impl, (lu, z_all, _probe_slots, p0,
+                       jnp.asarray(1.0, rdtype))
+    )
+    detail.probe_target = lambda: (
+        _detail_impl, (lu, z_all, _probe_slots, p0,
+                       jnp.asarray(1.0, rdtype))
+    )
+    return TopoScreen(screen=screen, detail=detail, n_bus=n, n_branch=m,
+                      r_max=r_max)
+
+
+# ---------------------------------------------------------------------------
+# Donating top-k merge (the screen-lane accumulator)
+# ---------------------------------------------------------------------------
+
+
+def make_topk_merge(r_max: int, k: int):
+    """Compile the running-shortlist merge: the carried best-``k``
+    (objective, slots, global id) triples are concatenated with a
+    chunk's lanes, stably sorted by objective, and truncated back to
+    ``k``.  The carried buffers are **donated** into the identically-
+    shaped outputs (GP004 audits the declaration) — the shortlist rides
+    device HBM across every chunk of a sweep instead of allocating
+    three fresh result buffers per merge.
+
+    Stability is the resume-exactness lever: equal objectives keep
+    concatenation order, carried entries precede the chunk's lanes, and
+    lanes arrive in global-id order — so the merged shortlist is
+    independent of how the variant list was chunked.
+    """
+    r_max = int(r_max)
+    k = int(k)
+
+    def _merge_impl(best_obj, best_slots, best_gid, obj, slots, gid):
+        all_obj = jnp.concatenate([best_obj, obj])
+        all_slots = jnp.concatenate([best_slots, slots])
+        all_gid = jnp.concatenate([best_gid, gid])
+        order = jnp.argsort(all_obj, stable=True)[:k]
+        return all_obj[order], all_slots[order], all_gid[order]
+
+    _merge_jit = jax.jit(_merge_impl, donate_argnums=(0, 1, 2))
+
+    def merge(best_obj, best_slots, best_gid, obj, slots, gid):
+        return _merge_jit(best_obj, best_slots, best_gid, obj, slots, gid)
+
+    def init():
+        rdtype = cplx.default_rdtype(None)
+        return (
+            jnp.full(k, jnp.inf, rdtype),
+            jnp.full((k, r_max), -1, jnp.int32),
+            jnp.full(k, -1, jnp.int32),
+        )
+
+    merge.init = init
+    merge.probe_target = lambda: (
+        _merge_jit, init() + (
+            jnp.ones(8, cplx.default_rdtype(None)),
+            jnp.full((8, r_max), -1, jnp.int32),
+            jnp.arange(8, dtype=jnp.int32),
+        )
+    )
+    return merge
+
+
+# ---------------------------------------------------------------------------
+# AC verification of the shortlist (sparse backend)
+# ---------------------------------------------------------------------------
+
+
+def make_ac_verifier(
+    sys: BusSystem,
+    k: int,
+    max_iter: int = 30,
+    dtype=None,
+    precision: str = "auto",
+):
+    """Compile the shortlist verifier: ``k`` status-traced sparse
+    Newton lanes (one Jacobian pattern, one preconditioner, shared by
+    every lane), warm-started from the base-case solution — the same
+    screen-then-verify ladder the DC-prefiltered N-1 screen uses, here
+    with per-lane branch-status vectors so simultaneous flips verify.
+
+    ``verify(status)`` takes ``[k, m]`` status rows (0 = open) and
+    returns a lane-batched :class:`~freedm_tpu.pf.newton.NewtonResult`.
+    Callers must feed it feasible (non-islanding) variants only — the
+    AC lanes assume connectivity; the screen's structural check plus
+    the SMW singularity flag are the gate.
+    """
+    from freedm_tpu.pf.sparse import make_sparse_newton_solver
+
+    m = sys.n_branch
+    rdtype = cplx.default_rdtype(dtype)
+    solve, _ = make_sparse_newton_solver(
+        sys, max_iter=max_iter, dtype=dtype, precision=precision,
+    )
+    base = solve()
+    base_v, base_th = base.v, base.theta
+    k = int(k)
+
+    @jax.jit
+    def _verify_impl(status):
+        def lane(st):
+            return solve(status=st, v0=base_v, theta0=base_th)
+
+        return jax.vmap(lane)(status)
+
+    def verify(status):
+        status = jnp.asarray(status, rdtype)
+        if status.ndim != 2 or status.shape[0] != k:
+            # The compiled lane count IS the contract — a mismatched
+            # caller would silently trigger a fresh XLA compile per
+            # shape instead of reusing this program.
+            raise ValueError(
+                f"status must be [{k}, {m}] (this verifier's compiled "
+                f"lane count), got {tuple(status.shape)}"
+            )
+        return _verify_impl(status)
+
+    verify.probe_target = lambda: (
+        _verify_impl, (jnp.ones((k, m), rdtype),)
+    )
+    verify.base = base
+    return verify
+
+
+#: Per-process cache of sweep verifiers keyed (case, k): a long-lived
+#: jobs server must not pay the sparse-Newton build + XLA compile again
+#: for every completed sweep of the same case/shortlist size (the sync
+#: engine caches its verifier the same way, once per engine).
+_AC_VERIFIER_CACHE: dict = {}
+_AC_VERIFIER_CACHE_MAX = 8
+
+
+def _cached_ac_verifier(case: str, sys_, k: int):
+    key = (case, int(k))
+    fn = _AC_VERIFIER_CACHE.get(key)
+    if fn is None:
+        fn = make_ac_verifier(sys_, k=k)
+        if len(_AC_VERIFIER_CACHE) >= _AC_VERIFIER_CACHE_MAX:
+            _AC_VERIFIER_CACHE.pop(next(iter(_AC_VERIFIER_CACHE)))
+        _AC_VERIFIER_CACHE[key] = fn
+    return fn
+
+
+def status_from_slots(slots, n_branch: int):
+    """``[V, m]`` status rows (0 = open) from ``[V, r]`` slot rows —
+    jit-safe (out-of-range pad slots dropped by the scatter)."""
+    slots = jnp.asarray(slots)
+
+    def lane(sl):
+        drop = jnp.where(sl >= 0, sl, n_branch)
+        return jnp.ones(n_branch).at[drop].set(0.0, mode="drop")
+
+    return jax.vmap(lane)(slots)
+
+
+# ---------------------------------------------------------------------------
+# The chunked, checkpointed sweep (jobs API + bench + soak reference)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TopoSweepSpec:
+    """One topology sweep: case + variant space + screening policy.
+
+    ``case`` uses the serving registry's bus-case vocabulary;
+    ``switches`` is the candidate branch list (``None`` = every
+    branch); ``search`` picks combinatorial enumeration up to
+    ``max_rank`` or the seeded ``samples``-sized neighborhood draw.
+    ``mesh_devices`` is execution placement only — a checkpoint resumes
+    across device counts (same contract as QSTS studies).
+    """
+
+    case: str
+    switches: Optional[Tuple[int, ...]] = None
+    max_rank: int = 2
+    mode: str = "mesh"  # mesh | radial
+    objective: str = "loss"  # loss | max_flow | violations
+    flow_limit: float = 1.0  # pu bar for the violations objective
+    top_k: int = 8
+    search: str = "exhaustive"  # exhaustive | neighborhood
+    samples: int = 0  # neighborhood draw size
+    seed: int = 0
+    chunk_variants: int = 4096
+    ac_verify: bool = True
+    mesh_devices: int = 0
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if d["switches"] is not None:
+            d["switches"] = list(d["switches"])
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TopoSweepSpec":
+        d = dict(d)
+        if d.get("switches") is not None:
+            d["switches"] = tuple(int(s) for s in d["switches"])
+        return cls(**d)
+
+
+def validate_sweep_spec(spec: TopoSweepSpec, n_branch: int) -> None:
+    """Range-check one spec against a case's branch table (typed
+    ValueError — the jobs layer maps it to ``invalid_request``)."""
+    if spec.mode not in TOPO_MODES:
+        raise ValueError(
+            f"unknown mode {spec.mode!r} (have: {', '.join(TOPO_MODES)})"
+        )
+    if spec.objective not in TOPO_OBJECTIVES:
+        raise ValueError(
+            f"unknown objective {spec.objective!r} "
+            f"(have: {', '.join(TOPO_OBJECTIVES)})"
+        )
+    if spec.search not in TOPO_SEARCHES:
+        raise ValueError(
+            f"unknown search {spec.search!r} "
+            f"(have: {', '.join(TOPO_SEARCHES)})"
+        )
+    if not 1 <= spec.max_rank <= MAX_TOPO_RANK:
+        raise ValueError(
+            f"max_rank must be in [1, {MAX_TOPO_RANK}], got {spec.max_rank}"
+        )
+    if spec.search == "neighborhood" and spec.samples < 1:
+        raise ValueError("neighborhood search needs samples >= 1")
+    if spec.objective == "violations" and not spec.flow_limit > 0:
+        raise ValueError("the violations objective needs flow_limit > 0")
+    if spec.top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {spec.top_k}")
+    if spec.chunk_variants < 1:
+        raise ValueError("chunk_variants must be >= 1")
+    if spec.switches is not None:
+        bad = [s for s in spec.switches
+               if not 0 <= int(s) < n_branch]
+        if bad:
+            raise ValueError(
+                f"switch indices must be in [0, {n_branch}), got {bad}"
+            )
+        if len(set(int(s) for s in spec.switches)) != len(spec.switches):
+            raise ValueError("switch list contains duplicates")
+
+
+def sweep_variants(spec: TopoSweepSpec, n_branch: int) -> np.ndarray:
+    """The spec's full (deterministic) variant matrix ``[V, max_rank]``."""
+    switches = (
+        np.arange(n_branch, dtype=np.int64)
+        if spec.switches is None
+        else np.asarray(spec.switches, np.int64)
+    )
+    if spec.search == "neighborhood":
+        return neighborhood_variants(
+            switches, spec.max_rank, spec.samples, spec.seed
+        )
+    return enumerate_variants(switches, spec.max_rank)
+
+
+def _resolve_sweep_case(name: str):
+    from freedm_tpu.serve.service import _resolve_bus_case
+
+    return _resolve_bus_case(name)
+
+
+def run_topo_sweep(
+    spec: TopoSweepSpec,
+    checkpoint_path: Optional[str] = None,
+    resume: bool = True,
+    cancel=None,
+    on_chunk=None,
+    stop_after_chunks: Optional[int] = None,
+    lu=None,
+) -> dict:
+    """Run one sweep chunk by chunk; returns the summary dict.
+
+    Mirrors :func:`freedm_tpu.scenarios.engine.run_study`'s contract:
+    ``checkpoint_path`` gets an atomic chunk-boundary checkpoint (the
+    shortlist + counters, host numpy — placement-free), ``resume=True``
+    continues a matching killed sweep from its last completed chunk
+    bit-for-bit (variant generation is a pure function of the spec),
+    ``cancel`` raises :class:`SweepCancelled` between chunks,
+    ``stop_after_chunks`` returns a partial summary (the bench/test
+    kill), and ``on_chunk(done, total, chunk_s, variants)`` is the jobs
+    layer's progress hook.  ``lu`` optionally adopts an existing B′
+    ``lu_factor`` pair (the serving cache's artifact).
+    """
+    sys_ = _resolve_sweep_case(spec.case)
+    m = sys_.n_branch
+    validate_sweep_spec(spec, m)
+    variants = sweep_variants(spec, m)
+    v_total = int(variants.shape[0])
+    if v_total == 0:
+        raise ValueError("the spec produces zero variants")
+    chunk = int(spec.chunk_variants)
+    n_chunks = math.ceil(v_total / chunk)
+
+    mesh = None
+    if spec.mesh_devices not in (0, 1):
+        from freedm_tpu.parallel.mesh import solver_mesh
+
+        mesh = solver_mesh(spec.mesh_devices)
+    ts = make_topo_screen(sys_, r_max=spec.max_rank, lu=lu, mesh=mesh)
+    rad_check = make_radiality_check(sys_, r_max=spec.max_rank)
+    merge = make_topk_merge(spec.max_rank, spec.top_k)
+
+    best_obj, best_slots, best_gid = merge.init()
+    counts = {"islanded": 0, "disconnected": 0, "nonradial": 0}
+    start_chunk = 0
+    if checkpoint_path and resume:
+        import os
+
+        if os.path.exists(checkpoint_path):
+            from freedm_tpu.runtime import checkpoint as ckpt
+
+            saved = ckpt.load(checkpoint_path)
+            if (
+                saved.get("version") == CKPT_VERSION
+                and isinstance(saved.get("spec"), dict)
+                and _placement_free(saved["spec"])
+                == _placement_free(spec.to_dict())
+            ):
+                best = saved["best"]
+                rdtype = cplx.default_rdtype(None)
+                best_obj = jnp.asarray(
+                    np.asarray(best["objective"], np.float64), rdtype
+                )
+                best_slots = jnp.asarray(
+                    np.asarray(best["slots"], np.int32)
+                )
+                best_gid = jnp.asarray(np.asarray(best["gid"], np.int32))
+                counts = {k: int(v) for k, v in saved["counts"].items()}
+                start_chunk = int(saved["chunk_index"])
+
+    t_start = time.monotonic()
+    span = tracing.TRACER.start(
+        "topo.sweep", kind="topo",
+        tags={"case": spec.case, "variants": v_total,
+              "max_rank": spec.max_rank, "objective": spec.objective},
+    )
+    try:
+        return _sweep_loop(
+            spec, sys_, variants, v_total, chunk, n_chunks, start_chunk,
+            ts, rad_check, merge, best_obj, best_slots, best_gid, counts,
+            checkpoint_path, cancel, on_chunk, stop_after_chunks, span,
+            t_start,
+        )
+    except SweepCancelled:
+        raise  # span already tagged/ended at the cancel site
+    except BaseException:
+        span.tag(outcome="error")
+        span.end()
+        raise
+
+
+def _sweep_loop(spec, sys_, variants, v_total, chunk, n_chunks,
+                start_chunk, ts, rad_check, merge, best_obj, best_slots,
+                best_gid, counts, checkpoint_path, cancel, on_chunk,
+                stop_after_chunks, span, t_start):
+    screened = 0
+    done_this_call = 0
+    with span.activate():
+        for kc in range(start_chunk, n_chunks):
+            if cancel is not None and cancel.is_set():
+                span.tag(outcome="cancelled")
+                span.end()
+                raise SweepCancelled(f"cancelled before chunk {kc}")
+            v0, v1 = kc * chunk, min(v_total, (kc + 1) * chunk)
+            real = v1 - v0
+            block = variants[v0:v1]
+            if real < chunk:
+                block = np.concatenate(
+                    [block, np.repeat(block[-1:], chunk - real, axis=0)]
+                )
+            c0 = time.monotonic()
+            with tracing.TRACER.start(
+                "topo.chunk", kind="topo",
+                tags={"chunk": kc, "variants": real},
+            ):
+                sl = jnp.asarray(block)
+                valid = jnp.arange(chunk) < real
+                verdict = screen_chunk(
+                    ts, rad_check, sl, valid, spec.mode,
+                    spec.objective, spec.flow_limit,
+                )
+                gid = jnp.asarray(v0 + np.arange(chunk), jnp.int32)
+                best_obj, best_slots, best_gid = merge(
+                    best_obj, best_slots, best_gid, verdict.objective,
+                    sl, gid
+                )
+                # Chunk-exit pull (the designed host boundary, like the
+                # QSTS chunk carry): counters + the checkpointed
+                # shortlist are host numpy from here.
+                counts["disconnected"] += int(np.asarray(
+                    verdict.disconnected
+                ))
+                counts["nonradial"] += int(np.asarray(verdict.nonradial))
+                counts["islanded"] += int(np.asarray(verdict.islanded))
+                best_host = {
+                    "objective": np.asarray(best_obj, np.float64).tolist(),
+                    "slots": np.asarray(best_slots, np.int32).tolist(),
+                    "gid": np.asarray(best_gid, np.int32).tolist(),
+                }
+            chunk_s = time.monotonic() - c0
+            screened += real
+            obs.TOPO_VARIANTS.inc(real)
+            obs.TOPO_SCREEN_SECONDS.observe(chunk_s)
+            if chunk_s > 0:
+                obs.TOPO_RATE.set(real / chunk_s)
+            if checkpoint_path:
+                from freedm_tpu.runtime import checkpoint as ckpt
+
+                ckpt.save(checkpoint_path, {
+                    "version": CKPT_VERSION,
+                    "spec": spec.to_dict(),
+                    "chunk_index": kc + 1,
+                    "best": best_host,
+                    "counts": dict(counts),
+                })
+            if on_chunk is not None:
+                on_chunk(kc + 1, n_chunks, chunk_s, real)
+            done_this_call += 1
+            if (
+                stop_after_chunks is not None
+                and done_this_call >= stop_after_chunks
+                and kc + 1 < n_chunks
+            ):
+                partial = _sweep_summary(
+                    spec, sys_, v_total, counts, best_obj, best_slots,
+                    best_gid, wall_s=time.monotonic() - t_start,
+                    screened=screened,
+                )
+                partial["completed"] = False
+                partial["chunks_done"] = kc + 1
+                partial["chunks_total"] = n_chunks
+                partial["resumed_from_chunk"] = start_chunk
+                span.tag(outcome="partial", chunks=kc + 1)
+                span.end()
+                return partial
+        summary = _sweep_summary(
+            spec, sys_, v_total, counts, best_obj, best_slots, best_gid,
+            wall_s=time.monotonic() - t_start, screened=screened,
+            ac=True,
+        )
+    summary["completed"] = True
+    summary["chunks_done"] = n_chunks
+    summary["chunks_total"] = n_chunks
+    summary["resumed_from_chunk"] = start_chunk
+    span.tag(outcome="completed", chunks=n_chunks)
+    span.end()
+    return summary
+
+
+def _sweep_summary(spec, sys_, v_total, counts, best_obj, best_slots,
+                   best_gid, wall_s: float, screened: int,
+                   ac: bool = False) -> dict:
+    """Assemble the sweep summary; with ``ac=True`` the feasible
+    shortlist is verified on the sparse AC backend and stamped with the
+    host float64 residual of each variant's own topology."""
+    obj = np.asarray(best_obj, np.float64)
+    slots = np.asarray(best_slots, np.int64)
+    gids = np.asarray(best_gid, np.int64)
+    feasible = np.isfinite(obj)
+    shortlist = []
+    for i in np.flatnonzero(feasible):
+        shortlist.append({
+            "open_branches": sorted(
+                int(s) for s in slots[i] if s >= 0
+            ),
+            "gid": int(gids[i]),
+            "objective": float(obj[i]),
+        })
+    out = {
+        "case": spec.case,
+        "mode": spec.mode,
+        "objective": spec.objective,
+        "max_rank": spec.max_rank,
+        "search": spec.search,
+        "variants_total": int(v_total),
+        "islanded": int(counts["islanded"]),
+        "disconnected": int(counts["disconnected"]),
+        "nonradial": int(counts["nonradial"]),
+        "mesh_devices": int(spec.mesh_devices) or 1,
+        "wall_s": round(float(wall_s), 3),
+    }
+    if wall_s > 0:
+        out["variants_per_sec"] = round(screened / wall_s, 1)
+    if ac and spec.ac_verify and shortlist:
+        from freedm_tpu.grid.bus import PQ, SLACK
+        from freedm_tpu.pf.krylov import host_injections
+
+        k = len(shortlist)
+        verifier = _cached_ac_verifier(spec.case, sys_, k)
+        status = np.asarray(
+            status_from_slots(
+                np.asarray(slots[feasible][:k], np.int32), sys_.n_branch
+            )
+        )
+        r = verifier(status)
+        v = np.asarray(r.v, np.float64)
+        theta = np.asarray(r.theta, np.float64)
+        conv = np.asarray(r.converged)
+        mism = np.asarray(r.mismatch, np.float64)
+        th_free = np.asarray(sys_.bus_type) != SLACK
+        v_free = np.asarray(sys_.bus_type) == PQ
+        p_req = np.asarray(sys_.p_inj, np.float64)
+        q_req = np.asarray(sys_.q_inj, np.float64)
+        for i, entry in enumerate(shortlist):
+            # Host float64 residual against THIS variant's topology —
+            # the same oracle discipline as the serve cache's verify.
+            p_c, q_c = host_injections(
+                sys_, theta[i], v[i], status=status[i]
+            )
+            fp = np.where(th_free, p_c - p_req, 0.0)
+            fq = np.where(v_free, q_c - q_req, 0.0)
+            entry.update({
+                "ac_converged": bool(conv[i]),
+                "ac_residual_pu": float(mism[i]),
+                "ac_true_mismatch_pu": float(
+                    max(np.max(np.abs(fp)), np.max(np.abs(fq)))
+                ),
+                "v_min_pu": float(np.min(v[i])),
+                "v_max_pu": float(np.max(v[i])),
+            })
+    out["shortlist"] = shortlist
+    return out

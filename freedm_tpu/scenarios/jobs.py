@@ -1,14 +1,18 @@
-"""Async jobs API for QSTS studies.
+"""Async jobs API for QSTS studies and topology sweeps.
 
-A QSTS study is minutes of device work, not the milliseconds the
-synchronous micro-batched queries (:mod:`freedm_tpu.serve`) answer in —
-so it gets the long-running-batch contract instead: ``POST /v1/qsts``
-validates and **returns immediately** with a ``job_id``;
-``GET /v1/jobs/<id>`` polls progress and, once completed, the summary;
-``POST /v1/jobs/<id>/cancel`` stops the study at its next chunk
-boundary (the chunk checkpoint stays on disk, so a cancelled or killed
-job resumes when an identical spec is resubmitted with the same
-``job_key``).
+A QSTS study (or a large switching sweep) is minutes of device work,
+not the milliseconds the synchronous micro-batched queries
+(:mod:`freedm_tpu.serve`) answer in — so both get the
+long-running-batch contract instead: ``POST /v1/qsts`` (or
+``POST /v1/topo/sweep``) validates and **returns immediately** with a
+``job_id``; ``GET /v1/jobs/<id>`` polls progress and, once completed,
+the summary; ``POST /v1/jobs/<id>/cancel`` stops the job at its next
+chunk boundary (the chunk checkpoint stays on disk, so a cancelled or
+killed job resumes when an identical spec is resubmitted with the same
+``job_key``).  One worker pool, one lifecycle/requeue machinery, two
+job kinds (``JobRecord.kind``): QSTS chunks over timesteps
+(:func:`freedm_tpu.scenarios.engine.run_study`), topo sweeps chunk
+over variants (:func:`freedm_tpu.pf.topo.run_topo_sweep`).
 
 Errors reuse the serving hierarchy (:mod:`freedm_tpu.serve.queue`):
 ``invalid_request`` for a malformed spec, ``overloaded`` when the
@@ -54,6 +58,14 @@ MAX_SCENARIOS = 1024
 MAX_STEPS = 100_000
 MAX_CHUNK_STEPS = 2048
 MAX_LANE_CELLS = 1_000_000  # scenarios * n_bus ceiling
+
+#: Topology sweep job bounds (``POST /v1/topo/sweep``): async sweeps
+#: may enumerate far past the sync endpoint's per-request cap, but the
+#: variant space must still be bounded up front.
+MAX_TOPO_JOB_VARIANTS = 500_000
+MAX_TOPO_JOB_TOPK = 32
+MIN_TOPO_CHUNK = 64
+MAX_TOPO_CHUNK = 16_384
 
 _JOB_KEY_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
 
@@ -166,13 +178,123 @@ def parse_job_request(payload: dict, default_chunk_steps: int = 24,
     return spec, job_key
 
 
+_TOPO_FIELDS = {
+    "case", "switches", "max_rank", "mode", "objective", "flow_limit",
+    "top_k", "search", "samples", "seed", "chunk_variants", "ac_verify",
+    "job_key", "mesh_devices",
+}
+
+
+def parse_topo_job_request(payload: dict, default_chunk: int = 4096,
+                           default_mesh_devices: int = 0):
+    """``(TopoSweepSpec, job_key)`` from a ``POST /v1/topo/sweep``
+    payload, every field range-checked with typed errors — the async
+    twin of the sync workload's ``TopoEngine.validate``."""
+    from freedm_tpu.pf.topo import (
+        MAX_TOPO_RANK,
+        TopoSweepSpec,
+        count_exhaustive,
+        validate_sweep_spec,
+    )
+
+    if not isinstance(payload, dict):
+        raise InvalidRequest("request body must be a JSON object")
+    unknown = set(payload) - _TOPO_FIELDS
+    if unknown:
+        raise InvalidRequest(
+            f"unknown field(s) {sorted(unknown)} for topo sweep"
+        )
+    if "case" not in payload:
+        raise InvalidRequest("missing required field 'case'")
+    case = payload["case"]
+    if not isinstance(case, str) or not case:
+        raise InvalidRequest("'case' must be a non-empty string")
+
+    def _int(name, default, lo, hi):
+        v = payload.get(name, default)
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise InvalidRequest(f"{name!r} must be an integer")
+        if not lo <= v <= hi:
+            raise InvalidRequest(f"{name!r} must be in [{lo}, {hi}], got {v}")
+        return v
+
+    max_rank = _int("max_rank", 2, 1, MAX_TOPO_RANK)
+    top_k = _int("top_k", 8, 1, MAX_TOPO_JOB_TOPK)
+    samples = _int("samples", 0, 0, MAX_TOPO_JOB_VARIANTS)
+    seed = _int("seed", 0, 0, 2**31 - 1)
+    chunk = _int("chunk_variants", int(default_chunk), MIN_TOPO_CHUNK,
+                 MAX_TOPO_CHUNK)
+    flow_limit = payload.get("flow_limit", 1.0)
+    if isinstance(flow_limit, bool) or not isinstance(
+        flow_limit, (int, float)
+    ) or not math.isfinite(flow_limit):
+        raise InvalidRequest("'flow_limit' must be a finite number")
+    ac_verify = payload.get("ac_verify", True)
+    if not isinstance(ac_verify, bool):
+        raise InvalidRequest("'ac_verify' must be a boolean")
+    switches = payload.get("switches")
+    if switches is not None:
+        if not isinstance(switches, (list, tuple)) or not switches or any(
+            isinstance(s, bool) or not isinstance(s, int) for s in switches
+        ):
+            raise InvalidRequest(
+                "'switches' must be a non-empty list of branch indices "
+                "(or omitted for the full branch set)"
+            )
+        switches = tuple(int(s) for s in switches)
+    mesh_devices = _int("mesh_devices", int(default_mesh_devices),
+                        -1, 4096)
+    job_key = payload.get("job_key")
+    if job_key is not None and (
+        not isinstance(job_key, str) or not _JOB_KEY_RE.match(job_key)
+    ):
+        raise InvalidRequest(
+            "'job_key' must match [A-Za-z0-9_.-]{1,64} (it names the "
+            "checkpoint file)"
+        )
+    spec = TopoSweepSpec(
+        case=case, switches=switches, max_rank=max_rank,
+        mode=payload.get("mode", "mesh"),
+        objective=payload.get("objective", "loss"),
+        flow_limit=float(flow_limit), top_k=top_k,
+        search=payload.get("search", "exhaustive"), samples=samples,
+        seed=seed, chunk_variants=chunk, ac_verify=ac_verify,
+        mesh_devices=mesh_devices,
+    )
+    # Resolve the case NOW (typed error + the variant-space bound).
+    from freedm_tpu.pf.topo import _resolve_sweep_case
+
+    try:
+        sys_ = _resolve_sweep_case(case)
+        validate_sweep_spec(spec, sys_.n_branch)
+    except ValueError as e:
+        raise InvalidRequest(str(e)) from None
+    n_switch = (sys_.n_branch if spec.switches is None
+                else len(spec.switches))
+    # Neighborhood draws are capped by the distinct-subset space, so
+    # the admission response's chunks_total/variants cannot over-report
+    # a tiny space (the sweep's own on_chunk still corrects totals if
+    # the bounded draw loop comes up short).
+    v_total = (min(spec.samples, count_exhaustive(n_switch, spec.max_rank))
+               if spec.search == "neighborhood"
+               else count_exhaustive(n_switch, spec.max_rank))
+    if v_total > MAX_TOPO_JOB_VARIANTS:
+        raise InvalidRequest(
+            f"the sweep enumerates {v_total} variants, over the "
+            f"{MAX_TOPO_JOB_VARIANTS} job ceiling; lower max_rank, "
+            f"shrink switches, or use search='neighborhood'"
+        )
+    return spec, job_key, v_total
+
+
 @dataclass
 class JobRecord:
-    """One submitted study and its lifecycle."""
+    """One submitted study/sweep and its lifecycle."""
 
     id: str
     spec: StudySpec
     job_key: Optional[str]
+    kind: str = "qsts"  # qsts | topo
     state: str = "queued"  # queued|running|completed|failed|cancelled
     submitted_ts: float = field(default_factory=time.time)
     started_ts: Optional[float] = None
@@ -188,6 +310,7 @@ class JobRecord:
     def to_dict(self) -> dict:
         out = {
             "job_id": self.id,
+            "kind": self.kind,
             "state": self.state,
             "spec": self.spec.to_dict(),
             "submitted_ts": round(self.submitted_ts, 3),
@@ -228,12 +351,14 @@ class JobManager:
     def __init__(self, workers: int = 1, max_pending: int = 16,
                  checkpoint_dir: Optional[str] = None,
                  default_chunk_steps: int = 24,
-                 default_mesh_devices: int = 0):
+                 default_mesh_devices: int = 0,
+                 default_topo_chunk: int = 4096):
         self.workers = max(int(workers), 1)
         self.max_pending = max(int(max_pending), 1)
         self.checkpoint_dir = checkpoint_dir
         self.default_chunk_steps = int(default_chunk_steps)
         self.default_mesh_devices = int(default_mesh_devices)
+        self.default_topo_chunk = int(default_topo_chunk)
         self._cond = threading.Condition()
         self._pending: deque = deque()
         self._jobs: "OrderedDict[str, JobRecord]" = OrderedDict()
@@ -276,6 +401,30 @@ class JobManager:
         )
         rec = JobRecord(id=os.urandom(8).hex(), spec=spec, job_key=job_key)
         rec.chunks_total = math.ceil(spec.steps / spec.chunk_steps)
+        out = self._admit(rec)
+        obs.QSTS_SUBMITTED.inc()
+        obs.EVENTS.emit("qsts.submitted", job_id=rec.id, case=spec.case,
+                        scenarios=spec.scenarios, steps=spec.steps)
+        return out
+
+    def submit_topo(self, payload: dict) -> dict:
+        """Admit one async topology sweep (``POST /v1/topo/sweep``) —
+        same lifecycle/polling/cancel contract as QSTS studies, run by
+        :func:`freedm_tpu.pf.topo.run_topo_sweep` (chunked, checkpointed
+        under the job key, exact resume)."""
+        spec, job_key, v_total = parse_topo_job_request(
+            payload, self.default_topo_chunk,
+            default_mesh_devices=self.default_mesh_devices,
+        )
+        rec = JobRecord(id=os.urandom(8).hex(), spec=spec,
+                        job_key=job_key, kind="topo")
+        rec.chunks_total = math.ceil(v_total / spec.chunk_variants)
+        out = self._admit(rec)
+        obs.EVENTS.emit("topo.submitted", job_id=rec.id, case=spec.case,
+                        variants=v_total, max_rank=spec.max_rank)
+        return out
+
+    def _admit(self, rec: JobRecord) -> dict:
         with self._cond:
             if self._closed:
                 raise ShuttingDown("jobs API is stopping")
@@ -299,9 +448,6 @@ class JobManager:
             # ("queued"), not a race with a worker that already started.
             out = rec.to_dict()
             self._cond.notify()
-        obs.QSTS_SUBMITTED.inc()
-        obs.EVENTS.emit("qsts.submitted", job_id=rec.id, case=spec.case,
-                        scenarios=spec.scenarios, steps=spec.steps)
         return out
 
     def get(self, job_id: str) -> dict:
@@ -319,10 +465,30 @@ class JobManager:
             rec.cancel.set()
             if rec.state == "queued":
                 # Never started: settle it here (the worker skips it).
+                # Direct metric calls (not via _outcome_counter): this
+                # is the _cond -> metrics-lock edge the GL006 static
+                # graph derives and the DebugLock test cross-checks.
                 rec.state = "cancelled"
                 rec.finished_ts = time.time()
-                obs.QSTS_JOBS.labels("cancelled").inc()
+                if rec.kind == "topo":
+                    obs.TOPO_SWEEPS.labels("cancelled").inc()
+                else:
+                    obs.QSTS_JOBS.labels("cancelled").inc()
         return rec.to_dict()
+
+    @staticmethod
+    def _outcome_counter(rec: JobRecord):
+        return obs.TOPO_SWEEPS if rec.kind == "topo" else obs.QSTS_JOBS
+
+    @staticmethod
+    def _emit_job_event(rec: JobRecord, outcome: str, **fields) -> None:
+        """Journal one job-lifecycle event under the kind's namespace
+        (``qsts.*`` / ``topo.*`` — both prefixes are documented in
+        docs/observability.md; GL005 matches f-strings by prefix)."""
+        if rec.kind == "topo":
+            obs.EVENTS.emit(f"topo.{outcome}", job_id=rec.id, **fields)
+        else:
+            obs.EVENTS.emit(f"qsts.{outcome}", job_id=rec.id, **fields)
 
     # -- watchdog surface (core.slo) -----------------------------------------
     def progress_age(self) -> float:
@@ -357,7 +523,8 @@ class JobManager:
         if rec.job_key is None or not self.checkpoint_dir:
             return None
         os.makedirs(self.checkpoint_dir, exist_ok=True)
-        return os.path.join(self.checkpoint_dir, f"qsts_{rec.job_key}.json")
+        return os.path.join(self.checkpoint_dir,
+                            f"{rec.kind}_{rec.job_key}.json")
 
     def _run(self) -> None:
         while True:
@@ -376,69 +543,95 @@ class JobManager:
             self._execute(rec)
 
     def _execute(self, rec: JobRecord) -> None:
+        from freedm_tpu.pf.topo import SweepCancelled, run_topo_sweep
+
         spec = rec.spec
-        obs.QSTS_RUNNING.inc()
+        is_topo = rec.kind == "topo"
+        running = obs.TOPO_RUNNING if is_topo else obs.QSTS_RUNNING
+        running.inc()
         ident = threading.get_ident()
         with self._cond:
             self._worker_beats[ident] = time.monotonic()
-        span = tracing.TRACER.start(
-            "qsts.job", kind="qsts",
-            tags={"job_id": rec.id, "case": spec.case,
-                  "scenarios": spec.scenarios, "steps": spec.steps},
-        )
+        if is_topo:
+            span = tracing.TRACER.start(
+                "topo.job", kind="topo",
+                tags={"job_id": rec.id, "case": spec.case,
+                      "max_rank": spec.max_rank,
+                      "objective": spec.objective},
+            )
+        else:
+            span = tracing.TRACER.start(
+                "qsts.job", kind="qsts",
+                tags={"job_id": rec.id, "case": spec.case,
+                      "scenarios": spec.scenarios, "steps": spec.steps},
+            )
 
         def on_chunk(done, total, chunk_s, lane_steps):
             rec.chunks_done = done
             rec.chunks_total = total
             self._worker_beats[ident] = time.monotonic()
-            obs.QSTS_CHUNK_SECONDS.observe(chunk_s)
-            if chunk_s > 0:
-                obs.QSTS_SCENARIO_RATE.set(lane_steps / chunk_s)
-            if FAULTS.enabled and FAULTS.should("qsts.worker.crash"):
+            if not is_topo:
+                # The topo sweep records its own topo_* chunk metrics
+                # inside run_topo_sweep.
+                obs.QSTS_CHUNK_SECONDS.observe(chunk_s)
+                if chunk_s > 0:
+                    obs.QSTS_SCENARIO_RATE.set(lane_steps / chunk_s)
+            # Kind-scoped injection points: a schedule chaos-testing
+            # QSTS studies must not also kill concurrent topo sweeps
+            # (and vice versa) — docs/robustness.md.
+            point = ("topo.worker.crash" if is_topo
+                     else "qsts.worker.crash")
+            if FAULTS.enabled and FAULTS.should(point):
                 # Injected worker death at a chunk boundary — the
                 # requeue path below must resume this job from the
-                # checkpoint the chunk just wrote (docs/robustness.md).
-                raise RuntimeError("fault injected: qsts.worker.crash")
+                # checkpoint the chunk just wrote.
+                raise RuntimeError(f"fault injected: {point}")
 
         ckpt_path = self._checkpoint_path(rec)
+        outcome_counter = self._outcome_counter(rec)
         try:
             with span.activate():
-                summary = run_study(
-                    spec, checkpoint_path=ckpt_path, resume=True,
-                    cancel=rec.cancel, on_chunk=on_chunk,
-                )
+                if is_topo:
+                    summary = run_topo_sweep(
+                        spec, checkpoint_path=ckpt_path, resume=True,
+                        cancel=rec.cancel, on_chunk=on_chunk,
+                    )
+                else:
+                    summary = run_study(
+                        spec, checkpoint_path=ckpt_path, resume=True,
+                        cancel=rec.cancel, on_chunk=on_chunk,
+                    )
             rec.summary = summary
             rec.error = None  # clear a prior requeue's crash record
             rec.resumed_from_chunk = summary.get("resumed_from_chunk", 0)
             if rec.resumed_from_chunk:
-                obs.QSTS_RESUMES.inc()
+                (obs.TOPO_RESUMES if is_topo else obs.QSTS_RESUMES).inc()
             rec.state = "completed"
             span.tag(outcome="completed", chunks=rec.chunks_done)
-            obs.QSTS_JOBS.labels("completed").inc()
-            obs.EVENTS.emit("qsts.completed", job_id=rec.id,
-                            chunks=rec.chunks_done,
-                            resumed_from=rec.resumed_from_chunk)
-        except StudyCancelled:
+            outcome_counter.labels("completed").inc()
+            self._emit_job_event(rec, "completed",
+                                 chunks=rec.chunks_done,
+                                 resumed_from=rec.resumed_from_chunk)
+        except (StudyCancelled, SweepCancelled):
             rec.state = "cancelled"
             span.tag(outcome="cancelled")
-            obs.QSTS_JOBS.labels("cancelled").inc()
-            obs.EVENTS.emit("qsts.cancelled", job_id=rec.id,
-                            chunks=rec.chunks_done)
+            outcome_counter.labels("cancelled").inc()
+            self._emit_job_event(rec, "cancelled", chunks=rec.chunks_done)
         except Exception as e:  # noqa: BLE001 — pollers must see failures
             if self._try_requeue(rec, ckpt_path, e, span):
                 return  # back on the pending queue; not terminal
             rec.state = "failed"
             rec.error = repr(e)
             span.tag(outcome="failed", error=repr(e))
-            obs.QSTS_JOBS.labels("failed").inc()
-            obs.EVENTS.emit("qsts.failed", job_id=rec.id, error=repr(e))
+            outcome_counter.labels("failed").inc()
+            self._emit_job_event(rec, "failed", error=repr(e))
         finally:
             if rec.state in ("completed", "failed", "cancelled"):
                 rec.finished_ts = time.time()
             span.end()
             with self._cond:
                 self._worker_beats.pop(ident, None)
-            obs.QSTS_RUNNING.dec()
+            running.dec()
 
     def _try_requeue(self, rec: JobRecord, ckpt_path: Optional[str],
                      err: BaseException, span) -> bool:
@@ -457,11 +650,11 @@ class JobManager:
             rec.error = repr(err)  # visible to pollers mid-requeue
             self._pending.append(rec)
             self._cond.notify()
-        obs.QSTS_REQUEUED.inc()
+        (obs.TOPO_REQUEUED if rec.kind == "topo"
+         else obs.QSTS_REQUEUED).inc()
         span.tag(outcome="requeued", error=repr(err),
                  requeue=rec.requeues)
-        obs.EVENTS.emit(
-            "qsts.requeued", job_id=rec.id, error=repr(err),
-            requeue=rec.requeues, chunks_done=rec.chunks_done,
-        )
+        self._emit_job_event(rec, "requeued", error=repr(err),
+                             requeue=rec.requeues,
+                             chunks_done=rec.chunks_done)
         return True

@@ -24,6 +24,8 @@ from freedm_tpu.serve.service import (  # noqa: F401
     PowerFlowResponse,
     ServeConfig,
     Service,
+    TopoRequest,
+    TopoResponse,
     VVCRequest,
     VVCResponse,
     default_buckets,

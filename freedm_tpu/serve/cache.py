@@ -170,13 +170,16 @@ class CaseEntry:
     __slots__ = ("key", "case", "sys", "backend", "tol", "rdtype",
                  "build_lock", "precond", "pattern", "delta_fn", "_dc",
                  "solutions", "artifact_bytes", "accounted", "alive",
-                 "last_used", "ttl_sweep", "_th_free", "_v_free")
+                 "last_used", "ttl_sweep", "_th_free", "_v_free",
+                 "precision")
 
-    def __init__(self, case: str, sys, backend: str, topo: str):
+    def __init__(self, case: str, sys, backend: str, topo: str,
+                 precision: str = "f64"):
         self.key = (case, topo, backend)
         self.case = case
         self.sys = sys
         self.backend = backend
+        self.precision = precision
         self.build_lock = threading.Lock()
         self.precond = None
         self.pattern = None
@@ -244,7 +247,7 @@ class CaseEntry:
             if self.delta_fn is None:
                 self.delta_fn = _build_delta_program(
                     self.sys, self.precond, self.tol, DELTA_MAX_SWEEPS,
-                    self.rdtype,
+                    self.rdtype, precision=self.precision,
                 )
         return self.delta_fn
 
@@ -278,12 +281,28 @@ class CaseEntry:
         return np.float64(max(np.max(np.abs(fp)), np.max(np.abs(fq))))
 
 
-def _build_delta_program(sys, precond, tol, max_sweeps, rdtype):
+def _build_delta_program(sys, precond, tol, max_sweeps, rdtype,
+                         precision: str = "f64"):
     """Compile the delta tier's correction: warm-started fast-decoupled
     sweeps whose inner solve is ``smw_delta_solve`` (rank-0: the cached
     LU pair IS the matrix — an injection delta moves only the RHS),
     iterated until the mismatch clears ``tol`` or ``max_sweeps``.  One
     jitted program per (case, topology); every delta answer reuses it.
+
+    ``precision="mixed"`` (the ``--pf-precision`` key, resolved by the
+    owning :class:`ServeCache`) runs the INNER triangular solves in
+    float32 — an f32 copy of the cached LU pair — as mixed-precision
+    iterative refinement: the iterates, the mismatch, and the exit test
+    stay in the working dtype, so the f32 solve only *proposes* each
+    sweep's correction direction while the f64 residual drives
+    convergence (B′/B″ are approximate sweep operators already — a few
+    ulps of f32 solve error just costs sweeps, not accuracy).  The
+    acceptance contract is unchanged: :meth:`CaseEntry.verify`'s host
+    float64 residual check is still the only gate between a delta
+    answer and the client, and a residual miss falls through to the
+    warm tier exactly as before — mixed can only ever make the tier
+    slower-but-correct, never wrong (the same oracle discipline as the
+    solvers' mixed inner GMRES, docs/solvers.md "Mixed precision").
     """
     import jax
     import jax.numpy as jnp
@@ -292,10 +311,31 @@ def _build_delta_program(sys, precond, tol, max_sweeps, rdtype):
     from freedm_tpu.pf.mfree import make_injection_fn
     from freedm_tpu.pf.n1 import smw_delta_solve
 
+    mixed = precision == "mixed"
     parts = decoupled_parts(sys, rdtype)
     th_free, v_free = parts.th_free, parts.v_free
     inject = make_injection_fn(sys, rdtype)
-    lu_p, lu_q = precond.bp, precond.bq
+    if mixed:
+        lu_p = (jnp.asarray(precond.bp[0], jnp.float32), precond.bp[1])
+        lu_q = (jnp.asarray(precond.bq[0], jnp.float32), precond.bq[1])
+
+        def _solve_p(dp):
+            return smw_delta_solve(
+                lu_p, None, None, dp.astype(jnp.float32)
+            ).astype(rdtype)
+
+        def _solve_q(dq):
+            return smw_delta_solve(
+                lu_q, None, None, dq.astype(jnp.float32)
+            ).astype(rdtype)
+    else:
+        lu_p, lu_q = precond.bp, precond.bq
+
+        def _solve_p(dp):
+            return smw_delta_solve(lu_p, None, None, dp)
+
+        def _solve_q(dq):
+            return smw_delta_solve(lu_q, None, None, dq)
 
     @jax.jit
     def correct(theta0, v0, p_sched, q_sched):
@@ -326,9 +366,9 @@ def _build_delta_program(sys, precond, tol, max_sweeps, rdtype):
 
             def body(c):
                 theta, v, dp, dq, it = c
-                theta = theta + smw_delta_solve(lu_p, None, None, dp) * th_free
+                theta = theta + _solve_p(dp) * th_free
                 _, dq2 = mismatch(theta, v)
-                v = v + smw_delta_solve(lu_q, None, None, dq2) * v_free
+                v = v + _solve_q(dq2) * v_free
                 dp3, dq3 = mismatch(theta, v)
                 return (theta, v, dp3, dq3, it + 1)
 
@@ -364,12 +404,20 @@ class ServeCache:
 
     def __init__(self, max_bytes: int, ttl_s: float = 600.0,
                  delta_max_rank: int = 16, delta_max_pu: float = DELTA_MAX_PU,
-                 verify_tol: Optional[float] = None):
+                 verify_tol: Optional[float] = None,
+                 precision: str = "f64"):
+        from freedm_tpu.pf.krylov import resolve_precision
+
         self.max_bytes = int(max_bytes)
         self.ttl_s = float(ttl_s)
         self.delta_max_rank = int(delta_max_rank)
         self.delta_max_pu = float(delta_max_pu)
         self.verify_tol = verify_tol
+        # Inner precision of the delta tier's correction program (the
+        # --pf-precision key): "mixed" = f32 SMW sweeps under the
+        # unchanged float64 verify oracle; resolved once here so every
+        # entry compiles the same program kind.
+        self.precision = resolve_precision(precision)
         self._lock = threading.Lock()
         self._entries: Dict[Tuple[str, str, str], CaseEntry] = {}
         self._lru: "OrderedDict[Tuple[Tuple[str, str, str], str], CaseEntry]" \
@@ -401,7 +449,8 @@ class ServeCache:
             est = 2 * (n * n + n) * 8
             if est > self.max_bytes:
                 return None
-            ent = CaseEntry(case, sys, backend, topo)
+            ent = CaseEntry(case, sys, backend, topo,
+                            precision=self.precision)
             self._entries[key] = ent
         with ent.build_lock:
             ent.build_artifacts()

@@ -9,12 +9,15 @@ it coalesces.
 
 Routes:
 
-- ``POST /v1/pf`` / ``POST /v1/n1`` / ``POST /v1/vvc`` — a JSON body
-  matching the workload's request record
-  (:mod:`freedm_tpu.serve.service`); 200 with the typed response dict
-  on success.
+- ``POST /v1/pf`` / ``POST /v1/n1`` / ``POST /v1/vvc`` /
+  ``POST /v1/topo`` — a JSON body matching the workload's request
+  record (:mod:`freedm_tpu.serve.service`); 200 with the typed
+  response dict on success.
 - ``POST /v1/qsts`` — submit a QSTS study to the async jobs layer
   (:mod:`freedm_tpu.scenarios.jobs`); 202 with ``{"job_id": ...}``.
+- ``POST /v1/topo/sweep`` — submit an async topology sweep to the same
+  jobs layer (chunked + checkpointed; docs/topology.md); 202 with
+  ``{"job_id": ...}``.
 - ``GET /v1/jobs/<id>`` — poll a job (progress, then the summary);
   ``POST /v1/jobs/<id>/cancel`` — stop it at the next chunk boundary.
 - ``GET /healthz`` — liveness + the workload/case table.
@@ -194,7 +197,8 @@ class ServeServer(BackgroundHttpServer):
                         self._reply(200, {
                             "service": "freedm_tpu serve",
                             "post": [f"/v1/{w}" for w in WORKLOADS]
-                            + ["/v1/qsts", "/v1/jobs/<id>/cancel"],
+                            + ["/v1/qsts", "/v1/topo/sweep",
+                               "/v1/jobs/<id>/cancel"],
                             "get": ["/healthz", "/stats", "/v1/jobs/<id>"],
                         })
                     else:
@@ -240,6 +244,11 @@ class ServeServer(BackgroundHttpServer):
                         raise InvalidRequest(f"malformed JSON: {e}") from None
                     if path == "/v1/qsts":
                         self._reply(202, self._jobs().submit(payload))
+                        return
+                    if path == "/v1/topo/sweep":
+                        # Async topology sweep beside QSTS: chunked +
+                        # checkpointed, polled via GET /v1/jobs/<id>.
+                        self._reply(202, self._jobs().submit_topo(payload))
                         return
                     workload = path[len("/v1/"):]
                     apply_deadline_budget(

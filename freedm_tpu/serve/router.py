@@ -74,7 +74,7 @@ from freedm_tpu.serve.queue import (
 )
 
 #: Workloads the router fronts (same vocabulary as serve.service).
-ROUTED_WORKLOADS = ("pf", "n1", "vvc")
+ROUTED_WORKLOADS = ("pf", "n1", "vvc", "topo")
 
 #: Breaker states, also the ``router_breaker_state`` gauge encoding.
 CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"

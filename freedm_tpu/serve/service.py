@@ -56,7 +56,7 @@ from freedm_tpu.serve.queue import (
     Ticket,
 )
 
-WORKLOADS = ("pf", "n1", "vvc")
+WORKLOADS = ("pf", "n1", "vvc", "topo")
 
 #: Voltage band for the VVC report, pu (ANSI C84.1 service band).
 V_BAND = (0.95, 1.05)
@@ -110,6 +110,35 @@ class VVCRequest:
 
     case: str
     q_ctrl_kvar: Sequence[Sequence[float]] = ()
+    timeout_s: float = 30.0
+
+
+@dataclass(frozen=True)
+class TopoRequest:
+    """Switching screen: enumerate (or neighborhood-sample) open-sets of
+    up to ``max_rank`` candidate switches, DC-screen every variant
+    through the rank-r SMW lanes over the case's cached B′ LU, rank by
+    ``objective`` (lower is better), and AC-verify the ``top_k``
+    shortlist on the sparse backend before answering
+    (:mod:`freedm_tpu.pf.topo`; docs/topology.md).
+
+    ``switches`` is the candidate branch list (``None`` = every
+    branch); ``mode="radial"`` additionally requires each surviving
+    variant's closed set to be a spanning tree.  Caps: ``max_rank`` ≤
+    ``--topo-max-rank``, variant count ≤ ``--topo-max-variants``,
+    ``top_k`` ≤ ``--topo-top-k``.
+    """
+
+    case: str
+    switches: Optional[Sequence[int]] = None
+    max_rank: int = 2
+    mode: str = "mesh"
+    objective: str = "loss"
+    flow_limit: float = 1.0
+    top_k: int = 4
+    search: str = "exhaustive"
+    samples: int = 0
+    seed: int = 0
     timeout_s: float = 30.0
 
 
@@ -189,6 +218,34 @@ class VVCResponse:
     v_min_pu: float
     v_max_pu: float
     band_violations: int  # live node-phases outside V_BAND
+    batch: BatchInfo
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["batch"] = self.batch.to_dict()
+        return d
+
+
+@dataclass
+class TopoResponse:
+    """One switching screen's verdict: exclusion accounting (structural
+    + SMW-backstop), the AC-verified shortlist, and the screen rate."""
+
+    workload: str
+    case: str
+    mode: str
+    objective: str
+    max_rank: int
+    n_variants: int
+    # The exclusion accounting partitions the variant space exactly:
+    # n_feasible + n_disconnected + n_nonradial + n_islanded
+    # == n_variants.
+    n_feasible: int
+    n_islanded: int  # SMW singular-capacitance backstop fired ALONE
+    n_disconnected: int  # structural connectivity check fires
+    n_nonradial: int  # connected but not a spanning tree (mode=radial)
+    shortlist: List[dict]  # open_branches/objective/ac stamps per entry
+    all_verified: bool  # every shortlist entry's AC lane converged
     batch: BatchInfo
 
     def to_dict(self) -> dict:
@@ -666,16 +723,323 @@ class VVCEngine(_Engine):
             ))
 
 
+class TopoEngine(_Engine):
+    """The switching-screen workload: one request = one full variant
+    sweep (enumerate → radiality check → SMW screen → on-device top-k →
+    AC verify), dispatched as a single lane through the micro-batcher.
+
+    The heavy artifacts ride the serving cache when one is configured:
+    ``attach_cache_lu`` (called by :meth:`Service.engine`) hands this
+    engine the case's already-factorized B′ LU pair, so attaching the
+    topology workload to a served case pays zero extra O(n³) work.
+    Variant counts are shape-bucketed (powers of two) so the compile
+    count stays bounded like every other engine's.
+    """
+
+    workload = "topo"
+
+    def __init__(self, case: str, mesh=None, backend: str = "auto",
+                 precision: str = "auto", max_rank: int = 2,
+                 max_variants: int = 20000, top_k: int = 8):
+        super().__init__(case)
+        from freedm_tpu.pf.topo import MAX_TOPO_RANK
+
+        sys_ = _resolve_bus_case(case)
+        self._sys = sys_
+        self.n_branch = sys_.n_branch
+        self.max_rank = min(int(max_rank), MAX_TOPO_RANK)
+        self.max_variants = int(max_variants)
+        self.top_k = max(int(top_k), 1)
+        self._mesh = mesh
+        self._precision = precision
+        self._lu = None  # serving-cache B′ LU pair (attach_cache_lu)
+        self._built = False
+        self._build_lock = threading.Lock()
+        # Variant-lane shape buckets: powers of two up to the variant
+        # cap — one compiled screen program per bucket, not per count.
+        b, buckets = 1, []
+        while b < self.max_variants:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.max_variants)
+        self._vbuckets = tuple(sorted(set(buckets)))
+
+    def attach_cache_lu(self, lu) -> None:
+        """Adopt a cached B′ ``lu_factor`` pair (must be called before
+        the first solve; the lazily-built screen factorizes its own
+        otherwise)."""
+        if not self._built:
+            self._lu = lu
+
+    def _ensure_built(self) -> None:
+        """Build the screen/radiality/verify programs once (submitter
+        thread, like other engines' __init__ compiles — under a lock so
+        a first-touch herd builds one set)."""
+        if self._built:
+            return
+        with self._build_lock:
+            if self._built:
+                return
+            from freedm_tpu.pf import topo as tp
+
+            self._screen = tp.make_topo_screen(
+                self._sys, r_max=self.max_rank, lu=self._lu,
+                mesh=self._mesh,
+            )
+            self._rad = tp.make_radiality_check(self._sys, self.max_rank)
+            self._verify = tp.make_ac_verifier(
+                self._sys, k=self.top_k, precision=self._precision,
+            )
+            self._built = True
+
+    def example_request(self):
+        return TopoRequest(case=self.case, switches=[0], max_rank=1,
+                           top_k=1)
+
+    def validate(self, req: TopoRequest):
+        from freedm_tpu.pf import topo as tp
+
+        # Build the compiled programs NOW, on the submitter's thread —
+        # before the ticket deadline starts — so a first-touch request
+        # pays the compile wall like every other engine's first touch
+        # (engine construction), not against its own timeout on the
+        # executor lane.
+        self._ensure_built()
+        # Field/vocabulary validation is ONE implementation shared with
+        # the async path (pf/topo.validate_sweep_spec, the same checker
+        # jobs.parse_topo_job_request uses) — the sync endpoint and the
+        # sweep job cannot drift on what a legal spec is.  The engine
+        # then layers its own serving caps (--topo-* config) on top.
+        int_fields = {"max_rank": req.max_rank, "top_k": req.top_k,
+                      "samples": req.samples, "seed": req.seed}
+        for name, v in int_fields.items():
+            if isinstance(v, bool) or not isinstance(v, (int, np.integer)):
+                raise InvalidRequest(f"{name!r} must be an integer")
+        if isinstance(req.flow_limit, bool) or not isinstance(
+            req.flow_limit, (int, float)
+        ) or not math.isfinite(req.flow_limit):
+            raise InvalidRequest("'flow_limit' must be a finite number")
+        if req.switches is not None and (
+            not isinstance(req.switches, (list, tuple))
+            or not req.switches
+            or any(isinstance(k, bool)
+                   or not isinstance(k, (int, np.integer))
+                   for k in req.switches)
+        ):
+            # Same strictness as the async parser: a JSON bool/string
+            # in the list is a typo, never a branch index.
+            raise InvalidRequest(
+                "'switches' must be a non-empty list of integer branch "
+                "indices (or omitted for the full branch set)"
+            )
+        try:
+            spec = tp.TopoSweepSpec(
+                case=self.case,
+                switches=(None if req.switches is None
+                          else tuple(int(k) for k in req.switches)),
+                max_rank=int(req.max_rank), mode=req.mode,
+                objective=req.objective,
+                flow_limit=float(req.flow_limit), top_k=int(req.top_k),
+                search=req.search, samples=int(req.samples),
+                seed=int(req.seed),
+            )
+            tp.validate_sweep_spec(spec, self.n_branch)
+        except (TypeError, ValueError) as e:
+            raise InvalidRequest(str(e)) from None
+        if req.max_rank > self.max_rank:
+            raise InvalidRequest(
+                f"max_rank must be <= {self.max_rank} "
+                f"(--topo-max-rank), got {req.max_rank}"
+            )
+        if req.top_k > self.top_k:
+            raise InvalidRequest(
+                f"top_k must be <= {self.top_k} (--topo-top-k), "
+                f"got {req.top_k}"
+            )
+        if spec.search == "neighborhood" and spec.samples > self.max_variants:
+            raise InvalidRequest(
+                f"neighborhood search needs samples in "
+                f"[1, {self.max_variants}], got {spec.samples}"
+            )
+        if spec.search == "exhaustive":
+            n_switch = (self.n_branch if spec.switches is None
+                        else len(spec.switches))
+            count = tp.count_exhaustive(n_switch, spec.max_rank)
+            if count > self.max_variants:
+                raise InvalidRequest(
+                    f"exhaustive enumeration is {count} variants, over "
+                    f"the {self.max_variants} cap (--topo-max-variants); "
+                    f"lower max_rank, shrink switches, or use "
+                    f"search='neighborhood'"
+                )
+        variants = tp.sweep_variants(spec, self.n_branch)
+        if variants.shape[0] == 0:
+            raise InvalidRequest("the request produces zero variants")
+        # Pad the rank axis to the engine's static r_max: one compiled
+        # program serves every requested rank.
+        if variants.shape[1] < self.max_rank:
+            variants = np.concatenate([
+                variants,
+                np.full((variants.shape[0],
+                         self.max_rank - variants.shape[1]), -1, np.int32),
+            ], axis=1)
+        return {
+            "variants": variants,
+            "mode": req.mode,
+            "objective": req.objective,
+            "flow_limit": float(req.flow_limit),
+            "top_k": int(req.top_k),
+        }
+
+    def assemble(self, group: List[Ticket], bucket: int):
+        # One request = one sweep; the group shares a dispatch slot but
+        # each sweep is its own compiled-program chain (no padding).
+        return [t.prepared for t in group]
+
+    def solve(self, batch):
+        # Dispatch-only chain per request (screen → top-k select → AC
+        # verify, all device-resident): the batcher performs the one
+        # deferred block_until_ready at its measurement boundary.
+        self._ensure_built()
+        return [self._solve_one(prep) for prep in batch]
+
+    def _solve_one(self, prep):
+        import jax
+        import jax.numpy as jnp
+
+        from freedm_tpu.pf import topo as tp
+
+        variants = prep["variants"]
+        v_real = int(variants.shape[0])
+        bucket = next(b for b in self._vbuckets if b >= v_real)
+        if v_real < bucket:
+            variants = np.concatenate([
+                variants,
+                np.repeat(variants[-1:], bucket - v_real, axis=0),
+            ])
+        slj = jnp.asarray(variants)
+        valid = jnp.asarray(np.arange(bucket) < v_real)
+        # The shared per-chunk ladder (pf/topo.screen_chunk): the sync
+        # endpoint, the async sweep, and the bench all compose masking/
+        # objective/exclusion accounting through this one helper.
+        verdict = tp.screen_chunk(
+            self._screen, self._rad, slj, valid, prep["mode"],
+            prep["objective"], prep["flow_limit"],
+        )
+        obj = verdict.objective
+        # top_k cannot exceed the lane count (a 2-variant request under
+        # an 8-deep shortlist cap is legal); the shortlist arrays pad
+        # back to the verifier's static K with infeasible rows.
+        k_eff = min(self.top_k, int(obj.shape[0]))
+        neg, idx = jax.lax.top_k(-obj, k_eff)
+        short_obj = -neg
+        short_feas = jnp.isfinite(short_obj)
+        # Infeasible shortlist slots collapse to the base topology —
+        # an islanding/disconnected variant can never reach an AC lane.
+        short_slots = jnp.where(short_feas[:, None], slj[idx], -1)
+        short_worst = verdict.screen.worst_flow[idx]
+        if k_eff < self.top_k:
+            pad = self.top_k - k_eff
+            short_obj = jnp.concatenate(
+                [short_obj, jnp.full(pad, jnp.inf, short_obj.dtype)]
+            )
+            short_feas = jnp.concatenate(
+                [short_feas, jnp.zeros(pad, bool)]
+            )
+            short_slots = jnp.concatenate([
+                short_slots,
+                jnp.full((pad, short_slots.shape[1]), -1,
+                         short_slots.dtype),
+            ])
+            short_worst = jnp.concatenate(
+                [short_worst, jnp.zeros(pad, short_worst.dtype)]
+            )
+        ac = self._verify(tp.status_from_slots(short_slots, self.n_branch))
+        return {
+            "n_variants": v_real,
+            "short_obj": short_obj,
+            "short_slots": short_slots,
+            "short_feas": short_feas,
+            "short_worst": short_worst,
+            "ac_converged": ac.converged,
+            "ac_mismatch": ac.mismatch,
+            "ac_v": ac.v,
+            # The exclusion accounting partitions the variant space
+            # exactly: feasible + disconnected + nonradial + islanded
+            # (the SMW backstop firing ALONE) == n_variants.
+            "n_feasible": verdict.feasible,
+            "n_islanded": verdict.islanded,
+            "n_disconnected": verdict.disconnected,
+            "n_nonradial": verdict.nonradial,
+        }
+
+    def scatter(self, group: List[Ticket], results,
+                info: BatchInfo) -> None:
+        for j, t in enumerate(group):
+            r = results[j]
+            # The one designed device->host pull per result field;
+            # everything below is host numpy.
+            obj = np.asarray(r["short_obj"])
+            slots = np.asarray(r["short_slots"])
+            feas = np.asarray(r["short_feas"])
+            worst = np.asarray(r["short_worst"])
+            conv = np.asarray(r["ac_converged"])
+            mism = np.asarray(r["ac_mismatch"])
+            v = np.asarray(r["ac_v"])
+            nv = np.asarray(r["n_variants"])
+            nf = np.asarray(r["n_feasible"])
+            ni = np.asarray(r["n_islanded"])
+            nd = np.asarray(r["n_disconnected"])
+            nr = np.asarray(r["n_nonradial"])
+            want = int(t.prepared["top_k"])
+            shortlist = []
+            for i in range(min(want, obj.shape[0])):
+                if not feas[i]:
+                    break  # trailing slots past the feasible count
+                shortlist.append({
+                    "open_branches": sorted(
+                        int(s) for s in slots[i] if s >= 0
+                    ),
+                    "objective": float(obj[i]),
+                    "worst_flow_pu": float(worst[i]),
+                    "ac_converged": bool(conv[i]),
+                    "ac_residual_pu": float(mism[i]),
+                    "v_min_pu": float(v[i].min()),
+                    "v_max_pu": float(v[i].max()),
+                })
+            n_variants = int(nv)
+            obs.TOPO_VARIANTS.inc(n_variants)
+            t.future.set_result(TopoResponse(
+                workload="topo",
+                case=self.case,
+                mode=t.prepared["mode"],
+                objective=t.prepared["objective"],
+                max_rank=int(t.request.max_rank),
+                n_variants=n_variants,
+                n_feasible=int(nf),
+                n_islanded=int(ni),
+                n_disconnected=int(nd),
+                n_nonradial=int(nr),
+                shortlist=shortlist,
+                all_verified=bool(
+                    all(e["ac_converged"] for e in shortlist)
+                ) if shortlist else False,
+                batch=info,
+            ))
+
+
 _ENGINE_TYPES = {
     "pf": PowerFlowEngine,
     "n1": N1Engine,
     "vvc": VVCEngine,
+    "topo": TopoEngine,
 }
 
 _REQUEST_TYPES = {
     "pf": PowerFlowRequest,
     "n1": N1Request,
     "vvc": VVCRequest,
+    "topo": TopoRequest,
 }
 
 
@@ -830,6 +1194,15 @@ class ServeConfig(NamedTuple):
     cache_mb: float = 64.0
     cache_ttl_s: float = 600.0
     delta_max_rank: int = 16
+    # Topology sweeps (serve workload "topo" + the async sweep jobs;
+    # CLI: --topo-max-rank / --topo-max-variants / --topo-top-k):
+    # simultaneous-flip cap per variant, per-request variant ceiling
+    # (the sync endpoint's admission bound — async sweeps chunk past
+    # it), and the AC-verified shortlist size cap (also the verifier's
+    # compiled lane count).
+    topo_max_rank: int = 2
+    topo_max_variants: int = 20000
+    topo_top_k: int = 8
 
     def bucket_table(self) -> Tuple[int, ...]:
         bs = self.buckets if self.buckets else default_buckets(self.max_batch)
@@ -893,6 +1266,7 @@ class Service:
                 max_bytes=int(config.cache_mb * 1024 * 1024),
                 ttl_s=config.cache_ttl_s,
                 delta_max_rank=config.delta_max_rank,
+                precision=config.pf_precision,
             )
         self._engines: Dict[Tuple[str, str], _Engine] = {}
         # Global lock guards the maps only; SLOW engine construction
@@ -959,11 +1333,35 @@ class Service:
                 "pf": {"max_iter": cfg.pf_max_iter},
                 "n1": {"max_iter": cfg.n1_max_iter},
                 "vvc": {"pf_iters": cfg.vvc_pf_iters},
+                "topo": {"max_rank": cfg.topo_max_rank,
+                         "max_variants": cfg.topo_max_variants,
+                         "top_k": cfg.topo_top_k},
             }[workload]
             eng = _ENGINE_TYPES[workload](
                 case, mesh=self.mesh, backend=cfg.pf_backend,
                 precision=cfg.pf_precision, **kwargs
             )
+            if workload == "topo" and self.cache is not None:
+                # The topology screen rides the serving cache's B′ LU:
+                # a case already served by pf answers switching sweeps
+                # with ZERO additional O(n³) factorization work (the
+                # make_topo_screen(lu=...) seam, same as the DC screen).
+                # Deliberate trade-off: entry() BUILDS the full artifact
+                # set (B″ + pattern included) even though the screen
+                # only uses bp — a topo-first tenant pre-pays the entry
+                # a later pf engine for the same case reuses; a case
+                # whose artifacts exceed the byte budget returns None
+                # and the engine self-factorizes bp below.
+                from freedm_tpu.pf.sparse import resolve_backend
+                from freedm_tpu.serve.cache import topology_digest
+
+                entry = self.cache.entry(
+                    case, eng._sys,
+                    resolve_backend(cfg.pf_backend, eng._sys.n_bus),
+                    topo=topology_digest(eng._sys),
+                )
+                if entry is not None:
+                    eng.attach_cache_lu(entry.precond.bp)
             if workload == "pf" and self.cache is not None:
                 from freedm_tpu.pf.sparse import resolve_backend
 

@@ -148,7 +148,7 @@ def _n1_smw():
     return _probe(screen)
 
 
-def _cache_delta():
+def _cache_delta(precision: str = "f64"):
     import jax.numpy as jnp
     import numpy as np
 
@@ -160,13 +160,47 @@ def _cache_delta():
     rdtype = cplx.default_rdtype(None)
     precond = build_fdlf_precond(sys_, dtype=rdtype, kind="lu")
     correct = _build_delta_program(sys_, precond, tol=1e-8, max_sweeps=8,
-                                   rdtype=rdtype)
+                                   rdtype=rdtype, precision=precision)
     n = sys_.n_bus
     theta0 = jnp.zeros(n, rdtype)
     v0 = jnp.ones(n, rdtype)
     p = jnp.asarray(np.asarray(sys_.p_inj), rdtype)
     q = jnp.asarray(np.asarray(sys_.q_inj), rdtype)
     return correct, (theta0, v0, p, q)
+
+
+def _cache_delta_mixed():
+    return _cache_delta(precision="mixed")
+
+
+def _topo_sys():
+    from freedm_tpu.grid.cases import synthetic_mesh
+
+    return synthetic_mesh(60, seed=2, load_mw=5.0, chord_frac=1.0)
+
+
+def _topo_radiality():
+    from freedm_tpu.pf.topo import make_radiality_check
+
+    return _probe(make_radiality_check(_topo_sys(), r_max=2))
+
+
+def _topo_screen():
+    from freedm_tpu.pf.topo import make_topo_screen
+
+    return _probe(make_topo_screen(_topo_sys(), r_max=2).screen)
+
+
+def _topo_topk():
+    from freedm_tpu.pf.topo import make_topk_merge
+
+    return _probe(make_topk_merge(r_max=2, k=4))
+
+
+def _topo_ac_verify():
+    from freedm_tpu.pf.topo import make_ac_verifier
+
+    return _probe(make_ac_verifier(_bus_case("case_ieee30"), k=2))
 
 
 def _serve_pf_bucket():
@@ -267,6 +301,32 @@ PROGRAM_REGISTRY: List[ProgramSpec] = [
     ProgramSpec("pf/n1/smw", "freedm_tpu/pf/n1.py", _n1_smw, f64=True),
     ProgramSpec("serve/cache/delta", "freedm_tpu/serve/cache.py",
                 _cache_delta, f64=True),
+    ProgramSpec("serve/cache/delta/mixed", "freedm_tpu/serve/cache.py",
+                _cache_delta_mixed, f64=True,
+                allow_dtypes=frozenset({"float32"}),
+                boundary_reason=(
+                    "mixed-precision delta refinement: f32 triangular "
+                    "solves over an f32 LU copy propose each sweep's "
+                    "correction; iterates/mismatch/exit test stay f64 "
+                    "and the host float64 residual verify remains the "
+                    "acceptance oracle with warm-tier fall-through "
+                    "(serve/cache.py)")),
+    # Topology sweeps (pf/topo.py, POST /v1/topo): the structural
+    # radiality/connectivity lanes (pure int program), the rank-r SMW
+    # screen lanes (LU/Z ride as runtime arguments, GP003), the
+    # donating top-k shortlist merge (the carried best-(obj, slots,
+    # gid) buffers alias their outputs — GP004 enforces the
+    # declaration), and the sparse-backend AC verify bucket.
+    ProgramSpec("pf/topo/radiality", "freedm_tpu/pf/topo.py",
+                _topo_radiality, f64=False),
+    ProgramSpec("pf/topo/screen", "freedm_tpu/pf/topo.py",
+                _topo_screen, f64=True),
+    ProgramSpec("pf/topo/topk", "freedm_tpu/pf/topo.py",
+                _topo_topk, f64=True, donatable=(0, 1, 2)),
+    ProgramSpec("pf/topo/ac_verify", "freedm_tpu/pf/topo.py",
+                _topo_ac_verify, f64=True,
+                allow_dtypes=frozenset({"bfloat16"}),
+                boundary_reason=_BF16_PRECOND),
     # Serve dispatch buffers: the padded (p, q, v0, th0) batch donates
     # into the result's (p, q, v, theta) — four [bucket, n] HBM round
     # trips deleted per dispatch.

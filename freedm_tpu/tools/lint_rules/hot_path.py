@@ -87,6 +87,8 @@ HOT_PATHS: Tuple[HotPath, ...] = (
     HotPath("freedm_tpu/serve/service.py", "PowerFlowEngine.solve"),
     HotPath("freedm_tpu/serve/service.py", "N1Engine.solve"),
     HotPath("freedm_tpu/serve/service.py", "VVCEngine.solve"),
+    HotPath("freedm_tpu/serve/service.py", "TopoEngine.solve"),
+    HotPath("freedm_tpu/serve/service.py", "TopoEngine._solve_one"),
     # Engine scatter(): the one designed device->host pull per result
     # field; everything after the np.asarray is host numpy.
     HotPath("freedm_tpu/serve/service.py", "PowerFlowEngine.scatter",
@@ -95,6 +97,8 @@ HOT_PATHS: Tuple[HotPath, ...] = (
             sources=("r", "results"), allow=frozenset({"asarray"})),
     HotPath("freedm_tpu/serve/service.py", "VVCEngine.scatter",
             sources=("out", "results"), allow=frozenset({"asarray"})),
+    HotPath("freedm_tpu/serve/service.py", "TopoEngine.scatter",
+            sources=("r", "results"), allow=frozenset({"asarray"})),
     # Incremental serving tier (serve/cache.py): lookup and insert are
     # pure host work (dict probes + numpy compares over host arrays) —
     # zero syncs allowed, ever: a device pull on the submit path would

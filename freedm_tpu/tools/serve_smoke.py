@@ -108,7 +108,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         ok("stats_served", bool(stats["engines"]),
            f"engines={stats['engines']}")
         ok("stats_pipeline", stats["pipeline_depth"] == 1
-           and set(stats["executor_lanes"]) == {"pf", "n1", "vvc"},
+           and set(stats["executor_lanes"]) == {"pf", "n1", "vvc", "topo"},
            f"depth={stats['pipeline_depth']} "
            f"lanes={sorted(stats['executor_lanes'])}")
         ok("stats_cache_block",

@@ -541,6 +541,9 @@ class QstsProbe:
         "job_key": "soakprobe",
     }
 
+    #: The jobs-API route the spec submits to.
+    SUBMIT_PATH = "/v1/qsts"
+
     def __init__(self, port: int):
         self.port = int(port)
         self.job_id: Optional[str] = None
@@ -565,7 +568,7 @@ class QstsProbe:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             try:
-                d = self._post("/v1/qsts", self.SPEC)
+                d = self._post(self.SUBMIT_PATH, self.SPEC)
                 self.job_id = d["job_id"]
                 self.resubmitted = self.submitted
                 self.submitted = True
@@ -628,6 +631,45 @@ class QstsProbe:
         from freedm_tpu.scenarios.engine import strip_timing
 
         return strip_timing(summary)
+
+
+class TopoProbe(QstsProbe):
+    """One topology sweep driven across the kill/restart schedule —
+    the switching-screen twin of :class:`QstsProbe`: submitted (stable
+    ``job_key``) to the slice the schedule kills, resubmitted after the
+    restart so the server resumes it from its chunk checkpoint, and the
+    finished summary compared EXACTLY (timing keys aside) against an
+    uninterrupted reference computed in this process.  Variant
+    generation is a pure function of the spec, so the resumed shortlist
+    must match bit-for-bit — the topo resume-determinism contract.
+    """
+
+    #: Enough chunks to straddle the kill on a busy CPU slice: every
+    #: rank-2 variant of a 120-bus mesh at the smallest chunk size.
+    #: AC verify off — the resume contract under test is the SCREEN's
+    #: (the shortlist + counters), and the sparse verifier's compile
+    #: cost would dominate the soak budget.
+    SPEC = {
+        "case": "mesh120", "max_rank": 2, "chunk_variants": 256,
+        "top_k": 8, "seed": 11, "ac_verify": False,
+        "job_key": "topoprobe",
+    }
+
+    SUBMIT_PATH = "/v1/topo/sweep"
+
+    def reference_summary(self) -> Dict:
+        """The uninterrupted sweep, computed in THIS process (same jax
+        platform/dtype as the slices)."""
+        from freedm_tpu.pf.topo import TopoSweepSpec, run_topo_sweep
+
+        spec = {k: v for k, v in self.SPEC.items() if k != "job_key"}
+        return run_topo_sweep(TopoSweepSpec(**spec))
+
+    @staticmethod
+    def strip_timing(summary: Dict) -> Dict:
+        from freedm_tpu.pf.topo import strip_topo_timing
+
+        return strip_topo_timing(summary)
 
 
 def wait_for(procs: List[Proc], cond, timeout_s: float) -> bool:
@@ -806,6 +848,7 @@ def run_soak(
     vvc: bool = True,
     serve_load: bool = True,
     qsts_probe: bool = False,
+    topo_probe: bool = False,
     chaos: bool = False,
 ) -> Dict:
     import tempfile
@@ -941,6 +984,19 @@ def run_soak(
                     probe.wait_chunks(1, timeout_s=form_timeout),
                     f"chunks_done={probe.chunks_before_kill}",
                 )
+        # Topology sweep probe: same kill/resume discipline on the
+        # switching-screen job class (chunked + checkpointed sweep).
+        tprobe: Optional[TopoProbe] = None
+        if topo_probe and member.spec.serve_port is not None:
+            tprobe = TopoProbe(member.spec.serve_port)
+            check.record("topo_probe_submitted", tprobe.submit(),
+                         f"target={member.spec.uuid}")
+            if tprobe.submitted:
+                check.record(
+                    "topo_probe_checkpointed_before_kill",
+                    tprobe.wait_chunks(1, timeout_s=form_timeout),
+                    f"chunks_done={tprobe.chunks_before_kill}",
+                )
         kill_ts = time.time()
         member.kill()
         survivors = [p for p in procs if p.alive()]
@@ -961,6 +1017,10 @@ def run_soak(
             # jobs layer finds the chunk checkpoint and resumes.
             check.record("qsts_probe_resubmitted",
                          probe.submit(timeout_s=form_timeout),
+                         "same job_key after restart")
+        if tprobe is not None and tprobe.submitted:
+            check.record("topo_probe_resubmitted",
+                         tprobe.submit(timeout_s=form_timeout),
                          "same job_key after restart")
 
         # Kill the LEADER: re-election among survivors + slave VVC
@@ -1031,6 +1091,23 @@ def run_soak(
                     f"speedup={(cache_summary or {}).get('serve_cache_probe_delta_speedup')}",
                 )
 
+        if tprobe is not None and tprobe.submitted:
+            tjob = tprobe.wait(timeout_s=max(2.0 * form_timeout, 300.0))
+            t_completed = tjob.get("state") == "completed"
+            check.record(
+                "topo_probe_completes", t_completed,
+                f"state={tjob.get('state')} err={tjob.get('error')}",
+            )
+            if t_completed:
+                tref = tprobe.reference_summary()
+                tgot = TopoProbe.strip_timing(tjob["summary"])
+                twant = TopoProbe.strip_timing(tref)
+                check.record(
+                    "topo_probe_matches_reference", tgot == twant,
+                    "killed-and-resumed sweep vs uninterrupted: "
+                    + ("exact" if tgot == twant
+                       else f"{tgot} != {twant}"),
+                )
         if probe is not None and probe.submitted:
             job = probe.wait(timeout_s=max(2.0 * form_timeout, 300.0))
             completed = job.get("state") == "completed"
@@ -1236,6 +1313,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="run without the VVC module (debug)")
     ap.add_argument("--no-serve-load", action="store_true",
                     help="skip the background what-if query load")
+    ap.add_argument("--no-topo-probe", action="store_true",
+                    help="skip the topology-sweep kill/resume probe")
     ap.add_argument("--no-qsts-probe", action="store_true",
                     help="skip the QSTS kill/resume determinism probe")
     ap.add_argument("--chaos", action="store_true",
@@ -1248,6 +1327,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         workdir=args.workdir, out=args.out, vvc=not args.no_vvc,
         serve_load=not args.no_serve_load,
         qsts_probe=not args.no_qsts_probe,
+        topo_probe=not args.no_topo_probe,
         chaos=args.chaos,
     )
     return 0 if artifact["pass"] else 1

@@ -1,0 +1,178 @@
+"""CI smoke for the topology-sweep stack: sync screen + async job.
+
+Starts a real :class:`~freedm_tpu.serve.ServeServer` with a
+:class:`~freedm_tpu.scenarios.jobs.JobManager` on an ephemeral port,
+then drives the switching-screen workload both ways it ships:
+
+- ``POST /v1/topo`` — a synchronous rank-2 screen over every branch of
+  ``case14``; asserts the 200, the exclusion accounting (islanded +
+  disconnected + feasible partitions the variant space), that every
+  shortlist entry is AC-verified converged with a residual below the
+  engine tolerance, and that no shortlist entry opens a bridge branch
+  (the islanding-never-verified contract).
+- ``POST /v1/topo/sweep`` — the same sweep as an async job with a
+  ``job_key``; polls ``GET /v1/jobs/<id>`` to completion and asserts
+  the job summary's shortlist MATCHES the sync answer's ranking (one
+  implementation, two front ends).
+
+Typed-error paths are exercised too (bad objective → 400
+``invalid_request``, unknown job id → 404 ``not_found``).  One
+command, exit code 0 iff healthy:
+
+    python -m freedm_tpu.tools.topo_smoke
+
+Used by ``.github/workflows/ci.yml``; also a handy local sanity check
+after touching pf/topo.py or the serve/jobs wiring.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Tuple
+
+POLL_TIMEOUT_S = 300.0
+
+
+def _post(port: int, path: str, payload: dict) -> Tuple[int, dict]:
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+def _get(port: int, path: str) -> Tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from freedm_tpu.grid.matpower import load_builtin
+    from freedm_tpu.pf.n1 import secure_outages
+    from freedm_tpu.scenarios.jobs import JobManager
+    from freedm_tpu.serve import ServeConfig, ServeServer, Service
+
+    svc = Service(ServeConfig(max_batch=4, buckets=(1, 4)))
+    jm = JobManager(
+        workers=1, checkpoint_dir=tempfile.mkdtemp(prefix="topo_smoke_")
+    ).start()
+    srv = ServeServer(svc, port=0, jobs=jm).start()
+    port = srv.port
+    failures: List[str] = []
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        line = f"{'ok' if ok else 'FAIL'}  {name}" + (
+            f"  ({detail})" if detail else ""
+        )
+        print(line)
+        if not ok:
+            failures.append(name)
+
+    try:
+        sys_ = load_builtin("case14")
+        bridges = set(range(sys_.n_branch)) - set(secure_outages(sys_))
+
+        # -- sync screen ------------------------------------------------
+        st, d = _post(port, "/v1/topo", {
+            "case": "case14", "max_rank": 2, "top_k": 4,
+            "timeout_s": 300,
+        })
+        check("sync 200", st == 200, f"status={st}")
+        if st == 200:
+            parts = (d["n_feasible"] + d["n_disconnected"]
+                     + d["n_nonradial"] + d["n_islanded"])
+            check("exclusion accounting partitions the space",
+                  parts == d["n_variants"],
+                  f"{d['n_feasible']}+{d['n_disconnected']}"
+                  f"+{d['n_nonradial']}+{d['n_islanded']} "
+                  f"vs {d['n_variants']}")
+            # n_islanded counts SMW-backstop-ONLY exclusions; on case14
+            # the structural check catches every islanding variant, so
+            # the backstop has nothing left to catch alone.
+            check("structural check leaves no backstop-only islands",
+                  d["n_islanded"] == 0 and d["n_disconnected"] > 0,
+                  f"islanded={d['n_islanded']} "
+                  f"disconnected={d['n_disconnected']}")
+            check("shortlist non-empty", bool(d["shortlist"]))
+            # 5e-4 covers the f32 engine tolerance (3e-5) with margin;
+            # under x64 the residuals are ~1e-14.
+            check("shortlist AC-verified",
+                  d["all_verified"] and all(
+                      e["ac_converged"] and e["ac_residual_pu"] < 5e-4
+                      for e in d["shortlist"]
+                  ))
+            check("no bridge reaches the shortlist", all(
+                not (set(e["open_branches"]) & bridges)
+                for e in d["shortlist"]
+            ), f"bridges={sorted(bridges)}")
+
+        # -- typed errors ----------------------------------------------
+        st2, d2 = _post(port, "/v1/topo", {"case": "case14",
+                                           "objective": "nope"})
+        check("bad objective -> 400 invalid_request",
+              st2 == 400 and d2["error"]["type"] == "invalid_request")
+        st3, d3 = _get(port, "/v1/jobs/deadbeef")
+        check("unknown job -> 404 not_found",
+              st3 == 404 and d3["error"]["type"] == "not_found")
+
+        # -- async sweep job -------------------------------------------
+        st4, d4 = _post(port, "/v1/topo/sweep", {
+            "case": "case14", "max_rank": 2, "top_k": 4,
+            "chunk_variants": 64, "job_key": "smoke",
+        })
+        check("sweep job 202", st4 == 202 and d4["kind"] == "topo",
+              f"status={st4}")
+        job = {}
+        if st4 == 202:
+            deadline = time.monotonic() + POLL_TIMEOUT_S
+            while time.monotonic() < deadline:
+                _, job = _get(port, f"/v1/jobs/{d4['job_id']}")
+                if job.get("state") in ("completed", "failed",
+                                        "cancelled"):
+                    break
+                time.sleep(0.5)
+            check("sweep job completed", job.get("state") == "completed",
+                  f"state={job.get('state')} err={job.get('error')}")
+        if job.get("state") == "completed" and st == 200:
+            js = job["summary"]["shortlist"]
+            check("job shortlist matches sync ranking", [
+                e["open_branches"] for e in js
+            ] == [
+                e["open_branches"] for e in d["shortlist"]
+            ], f"job={[e['open_branches'] for e in js]}")
+            check("job shortlist AC-verified", all(
+                e["ac_converged"] and e["ac_true_mismatch_pu"] < 5e-4
+                for e in js
+            ))
+    finally:
+        srv.stop()
+        jm.stop()
+        svc.stop()
+
+    if failures:
+        print(f"topo_smoke: {len(failures)} failure(s): "
+              f"{', '.join(failures)}", file=sys.stderr)
+        return 1
+    print("topo_smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -494,7 +494,21 @@ def test_trace_propagation_survives_lossy_udp_channel():
         deadline = time.time() + 10.0
         while len(got) < 10 and time.time() < deadline:
             time.sleep(0.02)
+        # A send span ENDS on its ACK, which trails the delivery by a
+        # beat (and the ACK itself can ride a retransmit under loss) —
+        # wait for all ten ping send spans to land in the ring before
+        # snapshotting, or a recv's parent is legitimately still open
+        # and the parent-linkage assert flakes.
         recs = tracing.TRACER.tail()
+        while time.time() < deadline:
+            done = sum(
+                1 for r in recs
+                if r["kind"] == "send" and r["tags"].get("type") == "ping"
+            )
+            if done >= 10:
+                break
+            time.sleep(0.02)
+            recs = tracing.TRACER.tail()
     finally:
         ea.stop(); eb.stop()
         tracing.TRACER.reset()

@@ -665,7 +665,7 @@ def test_pipeline_matches_serialized_byte_identical():
     svc_pipe = Service(ServeConfig(pipeline_depth=2, **cfg))
     svc_ser = Service(ServeConfig(pipeline_depth=0, **cfg))
     try:
-        assert set(svc_pipe.batcher.lanes) == {"pf", "n1", "vvc"}
+        assert set(svc_pipe.batcher.lanes) == {"pf", "n1", "vvc", "topo"}
         assert svc_ser.batcher.lanes == {}
         jobs = _mixed_jobs(svc_pipe)
         got_pipe = [_strip_batch(r) for r in _run_concurrent(svc_pipe, jobs)]
@@ -674,7 +674,7 @@ def test_pipeline_matches_serialized_byte_identical():
         # And the pipelined service's stats surface names its lanes.
         st = svc_pipe.stats()
         assert st["pipeline_depth"] == 2
-        assert set(st["executor_lanes"]) == {"pf", "n1", "vvc"}
+        assert set(st["executor_lanes"]) == {"pf", "n1", "vvc", "topo"}
     finally:
         svc_pipe.stop()
         svc_ser.stop()
