@@ -1620,6 +1620,69 @@ def bench_quick() -> dict:
     }
 
 
+def bench_roofline(inventory_path: str, tol: float = 0.5,
+                   repeats: int = 3) -> dict:
+    """Measured-vs-model roofline pass over the whole program registry
+    (``core/roofline.py``): drive every PROGRAM_REGISTRY entry on the
+    live backend, join against gridprobe's static flops/bytes, and
+    write/diff ``roofline_inventory.json`` — the GP006-style drift gate
+    for the model columns (flops, bytes, intensity, bound class).  A
+    missing inventory is written (first run / new backend); an existing
+    one is diffed and any drift exits 1 with readable findings, exactly
+    the gridprobe CI contract.  The returned columns are all
+    ``roofline_``-prefixed — direction-neutral in the perf gate, so the
+    BENCH trajectory records achieved MFU/intensity without gating on a
+    noisy host."""
+    import pathlib
+    import sys
+
+    from freedm_tpu.core import roofline as rl
+
+    rl.ROOFLINE.configure(enabled=True)
+    res = rl.ROOFLINE.measure_registry(repeats=repeats)
+    report = rl.ROOFLINE.report()
+    inv = rl.build_roofline_inventory(report)
+    path = pathlib.Path(inventory_path)
+    if not path.is_absolute():
+        path = pathlib.Path(__file__).resolve().parent / path
+    if path.exists():
+        recorded = json.loads(path.read_text(encoding="utf-8"))
+        findings = rl.diff_roofline_inventory(inv, recorded, tol)
+        if findings:
+            for f in findings:
+                print(f"ROOFLINE DRIFT: {f}", file=sys.stderr)
+            print(
+                f"roofline inventory drifted ({len(findings)} finding(s))"
+                f" — regenerate {path} deliberately if the change is"
+                f" intended", file=sys.stderr,
+            )
+            raise SystemExit(1)
+        written = False
+    else:
+        path.write_text(
+            json.dumps(inv, indent=1, sort_keys=True) + "\n",
+            encoding="utf-8",
+        )
+        written = True
+    out = {
+        "roofline_programs_total": len(inv["programs"]),
+        "roofline_measured_total": len(res["measured"]),
+        "roofline_errors_total": len(res["errors"]),
+        "roofline_backend": inv["backend"],
+        "roofline_inventory_written": written,
+    }
+    for name, row in sorted(inv["programs"].items()):
+        slug = name.replace("/", "_")
+        m = row["measured"]
+        if m["mfu_pct"] is not None:
+            out[f"roofline_{slug}_mfu_pct"] = m["mfu_pct"]
+        if row["intensity_flops_per_byte"] is not None:
+            out[f"roofline_{slug}_intensity"] = (
+                row["intensity_flops_per_byte"]
+            )
+    return out
+
+
 def _gridprobe_snapshot() -> dict:
     """Program-inventory stamps for the snapshot: how many distinct
     jitted programs gridprobe audits and their summed XLA cost-analysis
@@ -1655,7 +1718,10 @@ def main(argv=None) -> None:
     ap.add_argument(
         "--sections", default="solvers,serve,qsts",
         help="comma list of sections to run: solvers, serve, qsts, quick, "
-             "mesh, sparse, cache, mfu, topo (default solvers,serve,qsts; "
+             "mesh, sparse, cache, mfu, topo, roofline (default "
+             "solvers,serve,qsts; roofline drives every registered "
+             "program through the roofline observatory and writes/diffs "
+             "the drift-gated roofline_inventory.json; "
              "topo is the switching-screen gate set — variants/s through "
              "the radiality+SMW+top-k ladder, SMW-vs-refactorization "
              "head-to-head, shortlist AC-verify wall; mfu is "
@@ -1685,14 +1751,29 @@ def main(argv=None) -> None:
                     help="include the mfu section's 10k-bus mesh wall row "
                          "(the <60 ms acceptance ceiling; minutes on a "
                          "small CPU host, like --sparse-10k)")
+    ap.add_argument("--roofline-inventory",
+                    default="freedm_tpu/tools/roofline_inventory.json",
+                    metavar="PATH",
+                    help="roofline inventory JSON the roofline section "
+                         "writes (when missing) or diffs against "
+                         "(repo-root relative)")
+    ap.add_argument("--roofline-repeats", type=int, default=3, metavar="N",
+                    help="timed dispatches per program in the roofline "
+                         "section (default 3; the compile call is always "
+                         "excluded)")
+    ap.add_argument("--roofline-tol", type=float, default=0.5, metavar="R",
+                    help="relative drift tolerance for the roofline "
+                         "inventory's gated model columns (default 0.5, "
+                         "matching the gridprobe GP006 gate)")
     args = ap.parse_args(argv)
     sections = {s.strip() for s in args.sections.split(",") if s.strip()}
     unknown = sections - {"solvers", "serve", "qsts", "quick", "mesh",
-                          "sparse", "cache", "mfu", "topo"}
+                          "sparse", "cache", "mfu", "topo", "roofline"}
     if unknown or not sections:
         raise SystemExit(
             f"--sections needs a non-empty subset of solvers,serve,qsts,"
-            f"quick,mesh,sparse,cache,mfu,topo; got {args.sections!r}"
+            f"quick,mesh,sparse,cache,mfu,topo,roofline; "
+            f"got {args.sections!r}"
         )
 
     obj: dict = {}
@@ -1710,6 +1791,11 @@ def main(argv=None) -> None:
         obj["mesh"] = bench_mesh()
     if "sparse" in sections:
         obj["sparse"] = bench_sparse(with_10k=args.sparse_10k)
+    if "roofline" in sections:
+        obj["roofline"] = bench_roofline(
+            args.roofline_inventory, tol=args.roofline_tol,
+            repeats=args.roofline_repeats,
+        )
     # quick is a strict subset of the solvers section's extra metrics:
     # when solvers also runs, its full-measurement rows supersede quick
     # (same keys, longer reps), so quick only runs standalone.
@@ -1791,6 +1877,15 @@ def main(argv=None) -> None:
         obj["vs_baseline"] = round(
             m["nr_2000bus_krylov_lane_speedup"] / 5.0, 2
         )
+    elif "metric" not in obj and "roofline" in obj:
+        # roofline-only invocation (the CI smoke): the headline is the
+        # direction-neutral program coverage count — the drift gate
+        # itself already exited 1 on any model-column regression.
+        r = obj["roofline"]
+        obj["metric"] = "roofline_programs_total"
+        obj["value"] = r["roofline_programs_total"]
+        obj["unit"] = "programs"
+        obj["vs_baseline"] = None
     elif "metric" not in obj and "mesh" in obj:
         # mesh-only invocation: the headline is QSTS throughput speedup
         # at all devices (ISSUE 6 acceptance: >= 1.6x at D devices with

@@ -162,6 +162,20 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "shape-bucket) jit compile accounting, device-"
                          "memory peaks, host hot-path timers (profile_* "
                          "metrics + the /profile route)")
+    ap.add_argument("--roofline", action="store_true", default=None,
+                    help="enable the roofline observatory: per-program "
+                         "dispatch counts and block_until_ready-bounded "
+                         "device wall joined against gridprobe's static "
+                         "flops/bytes inventory (roofline_* metrics + the "
+                         "/roofline route; docs/observability.md)")
+    ap.add_argument("--roofline-inventory", default=None, metavar="PATH",
+                    help="roofline achieved-intensity inventory JSON the "
+                         "CI diff runs against (repo-root relative; default "
+                         "freedm_tpu/tools/roofline_inventory.json)")
+    ap.add_argument("--profile-capture-dir", default=None, metavar="DIR",
+                    help="base directory for on-demand jax.profiler trace "
+                         "captures (POST /profile/capture?ms=N; default "
+                         "a tempdir per capture)")
     ap.add_argument("--probe-inventory", default=None, metavar="PATH",
                     help="gridprobe program-inventory JSON the CI diff "
                          "runs against (repo-root relative; default "
@@ -199,6 +213,11 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                     metavar="S", help="stall watchdog: busy with no progress "
                                       "for S seconds journals watchdog.stall "
                                       "(default 20)")
+    ap.add_argument("--slo-pf-fallback-rate", type=float, default=None,
+                    metavar="R", help="mixed-precision fallback objective: "
+                                      "pf_precision_fallbacks_total per "
+                                      "Newton solve (default 0.05; 0 = "
+                                      "disabled)")
     ap.add_argument("--fault-spec", default=None, metavar="SPEC",
                     help="deterministic fault-injection schedule: "
                          "'[seed=N;]point:rate[:arg=V][:after=N][:max=N]' "
@@ -362,6 +381,9 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("trace_log", "trace_log"), ("profile_metrics", "profile_metrics"),
         ("pf_backend", "pf_backend"),
         ("pf_precision", "pf_precision"),
+        ("roofline", "roofline"),
+        ("roofline_inventory", "roofline_inventory"),
+        ("profile_capture_dir", "profile_capture_dir"),
         ("probe_inventory", "probe_inventory"),
         ("probe_const_mb", "probe_const_mb"),
         ("probe_flops_tol", "probe_flops_tol"),
@@ -373,6 +395,7 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("slo_overrun_rate", "slo_overrun_rate"),
         ("slo_qsts_floor", "slo_qsts_floor"),
         ("slo_watchdog_s", "slo_watchdog_s"),
+        ("slo_pf_fallback_rate", "slo_pf_fallback_rate"),
         ("fault_spec", "fault_spec"),
         ("router_port", "router_port"),
         ("router_replica", "router_replica"),
@@ -443,6 +466,18 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         from freedm_tpu.core import profiling
 
         profiling.PROFILER.configure(enabled=True)
+
+    if cfg.roofline or cfg.profile_capture_dir:
+        # Same discipline as the profiler: on before any solver exists,
+        # so first-round dispatches are already attributed (the compile
+        # hit lands dispatch-only by design).  A bare capture dir keeps
+        # the observatory off but points POST /profile/capture at it.
+        from freedm_tpu.core import roofline
+
+        roofline.ROOFLINE.configure(
+            enabled=bool(cfg.roofline),
+            capture_dir=cfg.profile_capture_dir or None,
+        )
 
     if cfg.fault_spec:
         # Fault schedule installed before any subsystem exists, so the
@@ -754,6 +789,7 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             serve_p99_ms=cfg.slo_serve_p99_ms,
             broker_overrun_rate=cfg.slo_overrun_rate,
             qsts_floor_steps_per_sec=cfg.slo_qsts_floor,
+            pf_fallback_rate=cfg.slo_pf_fallback_rate,
             watchdog_s=cfg.slo_watchdog_s,
         ))
         if serve_service is not None:

@@ -377,6 +377,22 @@ class GlobalConfig:
     slo_overrun_rate: float = 0.05
     slo_qsts_floor: float = 0.0
     slo_watchdog_s: float = 20.0
+    # Mixed-precision fallback-rate objective: precision fallbacks per
+    # Newton/Krylov solver iteration over the burn windows (a
+    # mass-fallback regression silently halves throughput; 0 = off).
+    slo_pf_fallback_rate: float = 0.05
+    # Roofline observatory (freedm_tpu.core.roofline): per-program
+    # measured-vs-model MFU attribution against gridprobe's static
+    # flops/bytes inventory, exported as roofline_* metrics and the
+    # metrics server's /roofline route.  Disabled by default at
+    # one-attribute-check cost, like profiling.
+    roofline: bool = False
+    # The checked-in roofline inventory `bench.py --sections roofline`
+    # diffs (repo-root relative), and the directory POST
+    # /profile/capture writes jax.profiler traces into ("" = a fresh
+    # temp dir per capture).
+    roofline_inventory: str = "freedm_tpu/tools/roofline_inventory.json"
+    profile_capture_dir: str = ""
 
     @property
     def uuid(self) -> str:

@@ -545,6 +545,13 @@ class MetricsServer(BackgroundHttpServer):
     ``GET /slo`` — the installed SLO monitor's objective verdicts as
     JSON (``freedm_tpu.core.slo``; ``{"enabled": false}`` until one is
     installed);
+    ``GET /roofline`` — the roofline observatory's per-program
+    measured-vs-model table + top-N fusion/donation targets as JSON
+    (``freedm_tpu.core.roofline``; static model columns are served even
+    while the observatory is disabled);
+    ``POST /profile/capture?ms=N`` — capture a :mod:`jax.profiler`
+    trace for N milliseconds into a TensorBoard-loadable directory
+    (409 while a capture is already running);
     anything else — a one-line index.  Runs ``http.server`` on a daemon
     thread; ``port=0`` binds an ephemeral port (read it back from
     ``.port``).
@@ -615,14 +622,61 @@ class MetricsServer(BackgroundHttpServer):
                         default=str,
                     )
                     self._reply(200, body + "\n", "application/json")
+                elif url.path == "/roofline":
+                    from freedm_tpu.core import roofline as _roofline
+
+                    q = parse_qs(url.query)
+                    top_n = int(q.get("top", ["5"])[0])
+                    self._reply(
+                        200,
+                        json.dumps(_roofline.ROOFLINE.report(top_n=top_n),
+                                   default=str) + "\n",
+                        "application/json",
+                    )
                 elif url.path == "/":
                     self._reply(
                         200,
                         "freedm_tpu metrics: /metrics /events /trace "
-                        "/profile /slo\n",
+                        "/profile /slo /roofline\n",
                         "text/plain; charset=utf-8")
                 else:
                     self._reply(404, "not found\n", "text/plain; charset=utf-8")
+
+            def do_POST(self):
+                url = urlparse(self.path)
+                if url.path == "/profile/capture":
+                    from freedm_tpu.core import roofline as _roofline
+
+                    q = parse_qs(url.query)
+                    try:
+                        ms = int(q.get("ms", ["100"])[0])
+                        if ms <= 0:
+                            raise ValueError(ms)
+                    except ValueError:
+                        self._reply(400,
+                                    json.dumps({"error": "ms must be a "
+                                                "positive integer"}) + "\n",
+                                    "application/json")
+                        return
+                    try:
+                        out = _roofline.ROOFLINE.capture_trace(ms)
+                    except RuntimeError as e:
+                        # One capture at a time: the observatory holds
+                        # the capture lock for the whole window.
+                        self._reply(409,
+                                    json.dumps({"error": str(e)}) + "\n",
+                                    "application/json")
+                        return
+                    except Exception as e:  # jax/profiler unavailable
+                        self._reply(503,
+                                    json.dumps({"error": repr(e)}) + "\n",
+                                    "application/json")
+                        return
+                    self._reply(200, json.dumps(out) + "\n",
+                                "application/json")
+                else:
+                    self._reply(404, "not found\n",
+                                "text/plain; charset=utf-8")
 
         super().__init__(Handler, port=port, host=host)
 
@@ -824,6 +878,11 @@ ROUTER_PROXY_LATENCY = REGISTRY.histogram(
     "router_proxy_seconds",
     "Wall time of one proxied attempt (connect + replica answer)",
     buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0, 30.0))
+ROUTER_FEDERATION_UP = REGISTRY.gauge(
+    "router_federation_up",
+    "1 if the replica answered the last GET /metrics federation "
+    "scrape on the router, else 0",
+    labels=("replica",))
 
 # -- fault injection (freedm_tpu.core.faults) -------------------------------
 FAULTS_INJECTED = REGISTRY.counter(

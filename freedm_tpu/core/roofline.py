@@ -1,0 +1,576 @@
+"""Roofline observatory: measured-vs-model MFU attribution per program.
+
+gridprobe (PR 13) computes a *static* cost model for every registered
+jitted program — XLA cost-analysis FLOPs and bytes per entry of
+``PROGRAM_REGISTRY``, checked in as ``ir_inventory.json`` — and the
+profiling registry (PR 2) measures compile wall, but nothing joins
+model cost to *measured per-dispatch device time*.  This module is that
+join: every dispatch of a registered program, recorded at the designed
+block_until_ready boundaries (``MicroBatcher._execute``, the QSTS and
+topo chunk exits, ``traced_solver``) or driven explicitly by
+:meth:`RooflineObservatory.measure_registry`, becomes an
+achieved-performance record — achieved FLOP/s, bytes/s, arithmetic
+intensity, model-MFU %, and a memory-vs-compute-bound classification
+against a per-backend peak table.  The TPU scaling literature (PAPERS:
+"Large Scale Distributed Linear Algebra With TPUs"; SABLE's batched
+power-flow throughput accounting) treats exactly this
+measured-vs-roofline attribution as table stakes: without it nobody can
+say which program is leaving the MXU idle or whether a PR moved
+achieved intensity.
+
+Exposed three ways:
+
+- ``GET /roofline`` on the metrics server — the per-program table plus
+  a top-N "next fusion/donation targets" list ranked by recoverable
+  device seconds (gap to the program's roof);
+- ``roofline_*`` metrics on the process registry (per-program dispatch
+  counters, device-wall counters, achieved-FLOP/s and model-MFU
+  gauges);
+- ``POST /profile/capture?ms=N`` — an on-demand :mod:`jax.profiler`
+  trace capture into a TensorBoard-loadable directory
+  (``--profile-capture-dir``), for the XLA-level view the host-side
+  join cannot see.
+
+``bench.py --sections roofline`` drives every registered program on the
+live backend and writes/diffs ``roofline_inventory.json`` — the
+GP006-style drift gate for the model columns (flops, bytes, intensity,
+bound class), so achieved-intensity regressions are caught the way
+program-shape drift already is.
+
+**Disabled by default** at one-attribute-check cost, exactly like the
+tracer and the profiling registry: every instrumented site guards on
+``ROOFLINE.enabled`` before doing any work (``--roofline`` turns it
+on).
+
+Model-column semantics: the static FLOPs/bytes are per *registered
+trace shape* (e.g. ``serve/pf/bucket4`` is the 4-lane case14 bucket);
+runtime dispatches at other shapes pass a ``scale`` factor (lane or
+step ratio vs the registered shape) so the credited model work tracks
+the dispatched batch.  Dispatch-only sites (``traced_solver`` steady
+state, whose spans deliberately measure the async dispatch side) count
+dispatches without crediting device wall — achieved columns stay
+honest: they divide model work by blocked device seconds only.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from freedm_tpu.core import metrics as obs
+
+# -- roofline_* metric catalogue (zero-valued until something happens) ------
+ROOFLINE_DISPATCHES = obs.REGISTRY.counter(
+    "roofline_dispatches_total",
+    "Dispatches attributed to each registered program (blocked "
+    "measurement-boundary dispatches AND dispatch-only solver calls)",
+    labels=("program",))
+ROOFLINE_DEVICE_SECONDS = obs.REGISTRY.counter(
+    "roofline_device_seconds_total",
+    "block_until_ready-bounded device wall attributed to each program "
+    "(blocked dispatches only — dispatch-only records add nothing)",
+    labels=("program",))
+ROOFLINE_ACHIEVED_FLOPS = obs.REGISTRY.gauge(
+    "roofline_achieved_flops_per_sec",
+    "Achieved model FLOP/s of each program over its cumulative blocked "
+    "window (scaled static FLOPs / blocked device seconds)",
+    labels=("program",))
+ROOFLINE_MFU = obs.REGISTRY.gauge(
+    "roofline_model_mfu_pct",
+    "Model MFU percent of each program: achieved FLOP/s over the "
+    "resolved backend peak FLOP/s",
+    labels=("program",))
+
+#: Per-backend peak table: label -> (peak FLOP/s, peak bytes/s).  The
+#: ``cpu`` row is the checked-in default the CI runner class gates
+#: against (deliberately conservative: a couple of AVX2 cores + dual
+#: channel DRAM); TPU rows are published per-chip peaks (dense
+#: bf16/f32 MXU FLOP/s, HBM bandwidth) matched against
+#: ``jax.devices()[0].device_kind``, so the same code lands accelerator
+#: numbers on a TPU/GPU runner without a config change.  ``configure``
+#: overrides both values for a calibrated host.
+PEAK_TABLE: Dict[str, tuple] = {
+    "cpu": (5.0e10, 2.0e10),
+    "tpu v2": (46.0e12, 7.0e11),
+    "tpu v3": (123.0e12, 9.0e11),
+    "tpu v4": (275.0e12, 1.228e12),
+    "tpu v5 lite": (197.0e12, 8.19e11),
+    "tpu v5": (459.0e12, 2.765e12),
+    "tpu v6 lite": (918.0e12, 1.64e12),
+    "tpu": (275.0e12, 1.228e12),
+    "gpu": (1.0e13, 1.0e12),
+}
+
+#: Cap on one /profile/capture window: a forgotten curl must not leave
+#: the profiler running for minutes.
+CAPTURE_MAX_MS = 60_000
+
+_DEFAULT_INVENTORY = "freedm_tpu/tools/ir_inventory.json"
+
+
+def _repo_root() -> Path:
+    """Parent of the installed package — same resolution as gridprobe's
+    ``repo_root`` (NOT imported from there: importing gridprobe pins
+    ``JAX_PLATFORMS=cpu``, which a TPU process must never inherit)."""
+    import freedm_tpu
+
+    return Path(freedm_tpu.__file__).resolve().parent.parent
+
+
+def _sig6(v: float) -> float:
+    """6-significant-digit rounding (gridprobe's checked-in-file
+    stability discipline)."""
+    return float(f"{float(v):.6g}")
+
+
+def resolve_peak(peak_flops: Optional[float] = None,
+                 peak_bytes: Optional[float] = None) -> dict:
+    """The backend peak the roofline is drawn against.
+
+    Explicit overrides win; otherwise the first local jax device's
+    ``device_kind`` is matched (longest key first) against
+    :data:`PEAK_TABLE`, falling back to the platform row and finally
+    the checked-in CPU defaults.  Never force-imports jax — a
+    transport-only process reports the CPU row.
+    """
+    import sys
+
+    backend, kind = "cpu", ""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            dev = jax.devices()[0]
+            backend = str(dev.platform)
+            kind = str(getattr(dev, "device_kind", "") or "")
+        except Exception:
+            pass
+    table_key = "cpu"
+    low = kind.lower()
+    for key in sorted(PEAK_TABLE, key=len, reverse=True):
+        if key != "cpu" and key in low:
+            table_key = key
+            break
+    else:
+        if backend in PEAK_TABLE:
+            table_key = backend
+    flops, bw = PEAK_TABLE[table_key]
+    if peak_flops is not None:
+        flops = float(peak_flops)
+    if peak_bytes is not None:
+        bw = float(peak_bytes)
+    return {
+        "backend": backend,
+        "device_kind": kind,
+        "table_key": table_key,
+        "flops_per_s": flops,
+        "bytes_per_s": bw,
+        "balance_flops_per_byte": _sig6(flops / bw) if bw > 0 else None,
+    }
+
+
+def solver_program(solver: str, pf_backend: str = "",
+                   precision: str = "") -> Optional[str]:
+    """Registry program name for a ``traced_solver`` site, from the
+    same construction tags the solver spans carry (``pf_backend``,
+    ``precision`` — docs/observability.md); None when the solver maps
+    to no registered program (attribution must never guess)."""
+    if solver == "newton":
+        if pf_backend == "sparse":
+            return ("pf/newton/sparse/mixed" if precision == "mixed"
+                    else "pf/newton/sparse")
+        return "pf/newton/dense"
+    if solver == "krylov":
+        return "pf/krylov/mixed" if precision == "mixed" else "pf/krylov"
+    if solver == "fdlf":
+        return "pf/fdlf"
+    if solver == "ladder":
+        return "pf/ladder"
+    return None
+
+
+class RooflineObservatory:
+    """Process-wide roofline account (:data:`ROOFLINE`).
+
+    Thread-safe; ``enabled`` is the single hot-path guard, exactly the
+    :class:`~freedm_tpu.core.profiling.ProfilingRegistry` contract —
+    instrumented sites check it before calling in, and every record
+    method re-checks defensively.
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.RLock()
+        # program -> [dispatches, blocked_dispatches, blocked_device_s,
+        #             model_flops_done, model_bytes_done]
+        self._programs: Dict[str, list] = {}
+        self._static: Optional[Dict[str, tuple]] = None  # lazy join table
+        self._inventory_path: Optional[str] = None
+        self._peak_flops: Optional[float] = None
+        self._peak_bytes: Optional[float] = None
+        self._capture_dir: Optional[str] = None
+        self._capture_lock = threading.Lock()
+
+    # -- configuration -------------------------------------------------------
+    def configure(self, enabled: Optional[bool] = None,
+                  inventory_path: Optional[str] = None,
+                  peak_flops: Optional[float] = None,
+                  peak_bytes: Optional[float] = None,
+                  capture_dir: Optional[str] = None,
+                  ) -> "RooflineObservatory":
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if inventory_path is not None:
+                self._inventory_path = str(inventory_path)
+                self._static = None  # re-join on next record
+            if peak_flops is not None:
+                self._peak_flops = float(peak_flops)
+            if peak_bytes is not None:
+                self._peak_bytes = float(peak_bytes)
+            if capture_dir is not None:
+                self._capture_dir = str(capture_dir)
+        return self
+
+    def reset(self) -> None:
+        """Back to the disabled boot state (tests); the ``roofline_*``
+        metric series keep their registrations, zeroed by the registry's
+        own reset in test setups."""
+        with self._lock:
+            self.enabled = False
+            self._programs.clear()
+            self._static = None
+            self._inventory_path = None
+            self._peak_flops = None
+            self._peak_bytes = None
+            self._capture_dir = None
+
+    # -- static join ---------------------------------------------------------
+    def _static_costs(self) -> Dict[str, tuple]:
+        """program -> (flops, bytes_accessed) from the checked-in
+        gridprobe inventory; {} when the file is missing/unreadable
+        (dispatch counting still works, achieved columns stay None)."""
+        with self._lock:
+            if self._static is not None:
+                return self._static
+            rel = self._inventory_path or _DEFAULT_INVENTORY
+            path = Path(rel)
+            if not path.is_absolute():
+                path = _repo_root() / path
+            table: Dict[str, tuple] = {}
+            try:
+                d = json.loads(path.read_text(encoding="utf-8"))
+                for name, row in d.get("programs", {}).items():
+                    fl = float(row.get("flops", -1.0))
+                    by = float(row.get("bytes_accessed", -1.0))
+                    table[name] = (fl, by)
+            except (OSError, ValueError):
+                pass
+            self._static = table
+            return table
+
+    # -- the record seam -----------------------------------------------------
+    def record_dispatch(self, program: str,
+                        device_s: Optional[float] = None,
+                        scale: float = 1.0) -> None:
+        """One dispatch of ``program``.
+
+        ``device_s`` is the block_until_ready-bounded device wall of
+        the dispatch (None = dispatch-only: count it, credit nothing —
+        the async-dispatch sites).  ``scale`` multiplies the program's
+        static model FLOPs/bytes for this dispatch (lane/step ratio vs
+        the registered trace shape).
+        """
+        if not self.enabled:
+            return
+        name = str(program)
+        costs = self._static_costs().get(name)
+        with self._lock:
+            ent = self._programs.get(name)
+            if ent is None:
+                ent = self._programs[name] = [0, 0, 0.0, 0.0, 0.0]
+            ent[0] += 1
+            if device_s is not None:
+                s = max(float(device_s), 0.0)
+                ent[1] += 1
+                ent[2] += s
+                if costs is not None and costs[0] > 0:
+                    ent[3] += costs[0] * float(scale)
+                if costs is not None and costs[1] > 0:
+                    ent[4] += costs[1] * float(scale)
+            blocked_s, flops_done = ent[2], ent[3]
+        ROOFLINE_DISPATCHES.labels(name).inc()
+        if device_s is None:
+            return
+        ROOFLINE_DEVICE_SECONDS.labels(name).inc(s)
+        if blocked_s > 0 and flops_done > 0:
+            achieved = flops_done / blocked_s
+            ROOFLINE_ACHIEVED_FLOPS.labels(name).set(achieved)
+            peak = resolve_peak(self._peak_flops, self._peak_bytes)
+            ROOFLINE_MFU.labels(name).set(
+                round(100.0 * achieved / peak["flops_per_s"], 4)
+            )
+
+    # -- exposition (the /roofline route, bench, soak, tests) ----------------
+    def report(self, top_n: int = 5) -> dict:
+        """The ``/roofline`` payload: the peak in force, one row per
+        program (every statically known program appears, dispatched or
+        not), and the top-N fusion/donation targets ranked by
+        recoverable device seconds against each program's own roof."""
+        peak = resolve_peak(self._peak_flops, self._peak_bytes)
+        static = self._static_costs()
+        balance = peak["balance_flops_per_byte"]
+        with self._lock:
+            names = sorted(set(static) | set(self._programs))
+            rows: Dict[str, dict] = {}
+            for name in names:
+                fl, by = static.get(name, (-1.0, -1.0))
+                ent = self._programs.get(name, [0, 0, 0.0, 0.0, 0.0])
+                disp, blocked, dev_s, fl_done, by_done = ent
+                intensity = (_sig6(fl / by)
+                             if fl > 0 and by > 0 else None)
+                if intensity is None or balance is None:
+                    bound = "unknown"
+                else:
+                    bound = ("memory" if intensity < balance
+                             else "compute")
+                row = {
+                    "dispatches": disp,
+                    "blocked_dispatches": blocked,
+                    "device_s": round(dev_s, 6),
+                    "model_flops": _sig6(fl) if fl > 0 else None,
+                    "model_bytes": _sig6(by) if by > 0 else None,
+                    "intensity_flops_per_byte": intensity,
+                    "bound": bound,
+                    "achieved_flops_per_s": None,
+                    "achieved_bytes_per_s": None,
+                    "mfu_pct": None,
+                    "roof_flops_per_s": None,
+                    "roof_pct": None,
+                    "headroom_s": None,
+                }
+                if intensity is not None:
+                    # The program's own roof: compute-limited peak or
+                    # its bandwidth-limited ceiling, whichever binds.
+                    row["roof_flops_per_s"] = _sig6(min(
+                        peak["flops_per_s"],
+                        intensity * peak["bytes_per_s"],
+                    ))
+                if dev_s > 0 and fl_done > 0:
+                    achieved = fl_done / dev_s
+                    row["achieved_flops_per_s"] = _sig6(achieved)
+                    row["mfu_pct"] = round(
+                        100.0 * achieved / peak["flops_per_s"], 4
+                    )
+                    if by_done > 0:
+                        row["achieved_bytes_per_s"] = _sig6(
+                            by_done / dev_s
+                        )
+                    if row["roof_flops_per_s"]:
+                        frac = min(achieved / row["roof_flops_per_s"],
+                                   1.0)
+                        row["roof_pct"] = round(100.0 * frac, 4)
+                        row["headroom_s"] = round(
+                            dev_s * (1.0 - frac), 6
+                        )
+                rows[name] = row
+        targets = sorted(
+            (
+                {"program": n, "headroom_s": r["headroom_s"],
+                 "roof_pct": r["roof_pct"], "bound": r["bound"],
+                 "device_s": r["device_s"]}
+                for n, r in rows.items()
+                if r["headroom_s"] is not None
+            ),
+            key=lambda t: -t["headroom_s"],
+        )[:max(int(top_n), 0)]
+        return {
+            "enabled": self.enabled,
+            "peak": peak,
+            "programs": rows,
+            "targets": targets,
+        }
+
+    def snapshot(self) -> dict:
+        """Alias of :meth:`report` (the soak artifact's block)."""
+        return self.report()
+
+    # -- explicit measurement (bench --sections roofline) --------------------
+    def measure_registry(self, repeats: int = 3,
+                         programs: Optional[List[str]] = None) -> dict:
+        """Drive every PROGRAM_REGISTRY entry on the live backend:
+        build, jit, one warm call (the compile, excluded), then
+        ``repeats`` timed dispatches each bounded by
+        ``block_until_ready`` — recorded through the normal
+        :meth:`record_dispatch` seam at scale 1.0 (the registered trace
+        shape IS the dispatched shape here).  Enables the observatory
+        if it is off (an explicit measurement request is the opt-in).
+        Returns ``{"measured": [...], "errors": {name: repr}}``.
+        """
+        import jax
+
+        from freedm_tpu.tools.ir_rules.registry import PROGRAM_REGISTRY
+
+        if not self.enabled:
+            self.configure(enabled=True)
+        wanted = set(programs) if programs else None
+        measured: List[str] = []
+        errors: Dict[str, str] = {}
+        for spec in PROGRAM_REGISTRY:
+            if wanted is not None and spec.name not in wanted:
+                continue
+            try:
+                fn, args = spec.build()
+                jfn = jax.jit(fn)
+                jax.block_until_ready(jfn(*args))  # compile, excluded
+                for _ in range(max(int(repeats), 1)):
+                    t0 = time.perf_counter()
+                    out = jfn(*args)
+                    jax.block_until_ready(out)
+                    self.record_dispatch(
+                        spec.name, time.perf_counter() - t0
+                    )
+                measured.append(spec.name)
+            except Exception as e:  # a broken build is GP005's job
+                errors[spec.name] = repr(e)
+        return {"measured": measured, "errors": errors}
+
+    # -- on-demand jax.profiler capture --------------------------------------
+    def capture_trace(self, ms: int,
+                      out_dir: Optional[str] = None) -> dict:
+        """Run :func:`jax.profiler.start_trace`/``stop_trace`` for
+        ``ms`` milliseconds (capped at :data:`CAPTURE_MAX_MS`) into a
+        timestamped subdirectory of ``out_dir`` (default: the
+        configured ``--profile-capture-dir``, else a fresh temp dir).
+        One capture at a time — a second request while one runs raises
+        ``RuntimeError`` (the HTTP route maps it to 409)."""
+        import tempfile
+
+        import jax
+
+        ms = max(1, min(int(ms), CAPTURE_MAX_MS))
+        base = out_dir or self._capture_dir
+        if not base:
+            base = tempfile.mkdtemp(prefix="freedm_profile_")
+        if not self._capture_lock.acquire(blocking=False):
+            raise RuntimeError("a profiler capture is already running")
+        try:
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            target = Path(base) / f"capture_{stamp}_{ms}ms"
+            target.mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(str(target))
+            try:
+                time.sleep(ms / 1000.0)
+            finally:
+                jax.profiler.stop_trace()
+            return {"trace_dir": str(target), "ms": ms}
+        finally:
+            self._capture_lock.release()
+
+
+# -- roofline inventory (the CI drift gate) ---------------------------------
+
+ROOFLINE_INVENTORY_VERSION = 1
+
+#: Absolute slack per gated scalar column, applied before the relative
+#: tolerance — the same zero-baseline discipline as gridprobe's GP006.
+ROOFLINE_ABS_SLACK = {
+    "flops": 4096.0,
+    "bytes_accessed": 4096.0,
+    "intensity_flops_per_byte": 0.005,
+}
+
+
+def build_roofline_inventory(report: dict) -> dict:
+    """The checked-in shape of one roofline run.
+
+    Gated (deterministic) columns per program: the static model flops /
+    bytes, the derived arithmetic intensity, and the bound class
+    against the resolved backend's machine balance.  The ``measured``
+    sub-object (MFU %, achieved FLOP/s, device wall, dispatches) is
+    **info-only** — recorded for the BENCH trajectory, excluded from
+    the drift diff, so reruns on a noisy host stay diff-clean while a
+    model-column change still fails the gate.
+    """
+    progs = {}
+    for name, row in sorted(report["programs"].items()):
+        progs[name] = {
+            "flops": row["model_flops"],
+            "bytes_accessed": row["model_bytes"],
+            "intensity_flops_per_byte": row["intensity_flops_per_byte"],
+            "bound": row["bound"],
+            "measured": {
+                "mfu_pct": row["mfu_pct"],
+                "achieved_flops_per_s": row["achieved_flops_per_s"],
+                "device_s": row["device_s"],
+                "dispatches": row["dispatches"],
+            },
+        }
+    peak = report["peak"]
+    return {
+        "version": ROOFLINE_INVENTORY_VERSION,
+        "backend": peak["table_key"],
+        "peak_flops_per_s": peak["flops_per_s"],
+        "peak_bytes_per_s": peak["bytes_per_s"],
+        "programs": progs,
+    }
+
+
+def diff_roofline_inventory(current: dict, recorded: dict,
+                            tol: float) -> List[str]:
+    """Readable findings for every way the model columns drifted from
+    the checked-in roofline inventory; [] when clean.  ``measured`` is
+    never compared."""
+
+    def _drift(cur, rec, slack) -> Optional[float]:
+        if cur is None or rec is None:
+            return None if cur == rec else float("inf")
+        cur, rec = float(cur), float(rec)
+        if cur < 0 or rec < 0 or abs(cur - rec) <= slack:
+            return None
+        return (float("inf") if rec == 0
+                else abs(cur - rec) / abs(rec))
+
+    findings: List[str] = []
+    if current.get("backend") != recorded.get("backend"):
+        findings.append(
+            f"backend drifted: {recorded.get('backend')} -> "
+            f"{current.get('backend')} (the bound classes are only "
+            f"comparable on the recorded backend's peak table)"
+        )
+        return findings
+    cur_p = current.get("programs", {})
+    rec_p = recorded.get("programs", {})
+    for name in sorted(set(rec_p) - set(cur_p)):
+        findings.append(
+            f"program `{name}` is in the roofline inventory but no "
+            f"longer measured (registry entry removed/renamed?)"
+        )
+    for name in sorted(set(cur_p) - set(rec_p)):
+        findings.append(
+            f"program `{name}` is measured but not in the roofline "
+            f"inventory (new program?)"
+        )
+    for name in sorted(set(cur_p) & set(rec_p)):
+        cur, rec = cur_p[name], rec_p[name]
+        if cur.get("bound") != rec.get("bound"):
+            findings.append(
+                f"program `{name}` bound class drifted: "
+                f"{rec.get('bound')} -> {cur.get('bound')}"
+            )
+        for col, slack in ROOFLINE_ABS_SLACK.items():
+            drift = _drift(cur.get(col), rec.get(col), slack)
+            if drift is not None and drift > tol:
+                findings.append(
+                    f"program `{name}` {col} drifted "
+                    f"{rec.get(col)} -> {cur.get(col)} "
+                    f"({drift:+.0%} vs the {tol:.0%} tolerance)"
+                )
+    return findings
+
+
+#: The process-wide roofline observatory every layer instruments
+#: against.
+ROOFLINE = RooflineObservatory()

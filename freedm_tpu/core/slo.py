@@ -21,6 +21,11 @@ against :data:`~freedm_tpu.core.metrics.REGISTRY`:
                           target.
   ``qsts_throughput``     ``qsts_scenario_steps_per_sec`` floor, evaluated
                           only while a job is running (0 disables).
+  ``pf_fallback_rate``    ``pf_precision_fallbacks_total`` per Newton
+                          iteration (the ``pf_newton_iterations`` sum) —
+                          a mixed-precision regression that mass-falls-back
+                          whole batches halves throughput without erroring,
+                          so it must page like any other breach (0 disables).
   =====================  =====================================================
 
 - **Fast+slow burn windows** — each ratio objective is evaluated over a
@@ -103,6 +108,7 @@ class SloConfig:
     serve_p99_ms: float = 250.0
     broker_overrun_rate: float = 0.05
     qsts_floor_steps_per_sec: float = 0.0
+    pf_fallback_rate: float = 0.05
     watchdog_s: float = 20.0
 
 
@@ -112,6 +118,15 @@ def _counter_sum(name: str) -> float:
     if m is None:
         return 0.0
     return float(sum(child.value for _, child in m.children()))
+
+
+def _histogram_sum(name: str) -> float:
+    """Sum of observed values across all children of a histogram
+    (0 if absent) — e.g. total Newton iterations ever recorded."""
+    m = obs.REGISTRY.get(name)
+    if m is None:
+        return 0.0
+    return float(sum(child.sum for _, child in m.children()))
 
 
 def _outcome_sum(outcomes) -> float:
@@ -147,7 +162,7 @@ class _Sample:
     """One scrape of the raw cumulative values the objectives need."""
 
     __slots__ = ("ts", "ok", "bad", "lat_counts", "overruns", "rounds",
-                 "qsts_rate", "qsts_running")
+                 "qsts_rate", "qsts_running", "pf_fallbacks", "pf_iters")
 
     def __init__(self, ts: float):
         self.ts = ts
@@ -158,6 +173,8 @@ class _Sample:
         self.rounds = _counter_sum("broker_rounds_total")
         self.qsts_rate = _gauge("qsts_scenario_steps_per_sec")
         self.qsts_running = _gauge("qsts_jobs_running")
+        self.pf_fallbacks = _counter_sum("pf_precision_fallbacks_total")
+        self.pf_iters = _histogram_sum("pf_newton_iterations")
 
 
 class SloMonitor:
@@ -238,6 +255,7 @@ class SloMonitor:
             ("serve_p99", self._judge_p99),
             ("broker_overruns", self._judge_overruns),
             ("qsts_throughput", self._judge_qsts),
+            ("pf_fallback_rate", self._judge_pf_fallbacks),
         ):
             v = judge(samples, t)
             if v is not None:
@@ -408,6 +426,34 @@ class SloMonitor:
             round(burn_fast, 3), round(burn_slow, 3),
         )
 
+    def _judge_pf_fallbacks(self, samples, now) -> Optional[dict]:
+        cfg = self.config
+        target = cfg.pf_fallback_rate
+        if target <= 0:
+            return None
+
+        def rate(span):
+            win = self._window(samples, now, span)
+            if win is None:
+                return None
+            a, b = win
+            iters = b.pf_iters - a.pf_iters
+            if iters <= 0:
+                return None  # no solves in the window: no signal
+            return (b.pf_fallbacks - a.pf_fallbacks) / iters
+
+        fast = rate(cfg.fast_window_s)
+        slow = rate(cfg.slow_window_s)
+        if fast is None and not self._state.get("pf_fallback_rate"):
+            return None
+        burn_fast = 0.0 if fast is None else fast / target
+        burn_slow = burn_fast if slow is None else slow / target
+        return self._burn_verdict(
+            "pf_fallback_rate",
+            None if fast is None else round(fast, 4),
+            target, round(burn_fast, 3), round(burn_slow, 3),
+        )
+
     # -- transitions ---------------------------------------------------------
     def _transition(self, name: str, verdict: dict) -> None:
         breached = bool(verdict["breached"])
@@ -463,6 +509,7 @@ class SloMonitor:
                     "broker_overrun_rate": self.config.broker_overrun_rate,
                     "qsts_floor_steps_per_sec":
                         self.config.qsts_floor_steps_per_sec,
+                    "pf_fallback_rate": self.config.pf_fallback_rate,
                     "watchdog_s": self.config.watchdog_s,
                 },
                 "objectives": dict(self._last),

@@ -430,8 +430,12 @@ def traced_solver(solver: str, fn, tags=None):
     change the overlap the caller built); the first-call span is the
     honest compile wall time, because jax compiles synchronously.
     Calls made from inside a jax transformation (``vmap(solve)``)
-    record nothing.  Disabled tracing AND disabled profiling cost one
-    attribute check each.
+    record nothing.  When the roofline observatory (``core.roofline``)
+    is enabled, steady-state calls count on its dispatch account —
+    dispatch-only, no wall credit, because nothing here blocks (the
+    block_until_ready-bounded boundaries in serve/QSTS/topo carry the
+    honest device wall).  Disabled tracing AND disabled profiling AND
+    disabled roofline cost one attribute check each.
     """
     import functools
     import time as _time
@@ -439,9 +443,16 @@ def traced_solver(solver: str, fn, tags=None):
     # Late import keeps this module numpy-free for processes that never
     # build a solver (profiling pulls in the metrics registry).
     from freedm_tpu.core import profiling as _profiling
+    from freedm_tpu.core import roofline as _roofline
 
     seen = [False]
     extra_tags = dict(tags) if tags else {}
+    # Resolved once at wrap time: the registered program this solver's
+    # dispatches attribute to (None = never guess).
+    rl_program = _roofline.solver_program(
+        solver, extra_tags.get("pf_backend", ""),
+        extra_tags.get("precision", ""),
+    )
 
     @functools.wraps(fn)
     def wrapper(*a, **kw):
@@ -451,6 +462,10 @@ def traced_solver(solver: str, fn, tags=None):
         first = not seen[0]
         seen[0] = True
         profiled = first and _profiling.PROFILER.enabled
+        if rl_program is not None and _roofline.ROOFLINE.enabled \
+                and not first and not _in_jax_trace():
+            # Steady-state dispatch: counted, no wall credit (async).
+            _roofline.ROOFLINE.record_dispatch(rl_program)
         if not TRACER.enabled:
             if profiled and not _in_jax_trace():
                 t0 = _time.perf_counter()

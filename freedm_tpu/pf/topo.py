@@ -57,6 +57,7 @@ import numpy as np
 
 from freedm_tpu.core import metrics as obs
 from freedm_tpu.core import profiling
+from freedm_tpu.core import roofline
 from freedm_tpu.core import tracing
 from freedm_tpu.grid.bus import BusSystem
 from freedm_tpu.pf.fdlf import decoupled_parts
@@ -949,6 +950,22 @@ def _sweep_loop(spec, sys_, variants, v_total, chunk, n_chunks,
             obs.TOPO_SCREEN_SECONDS.observe(chunk_s)
             if chunk_s > 0:
                 obs.TOPO_RATE.set(real / chunk_s)
+            if profiling.PROFILER.enabled:  # one attribute check when off
+                # The chunk boundary is where the sweep's working set
+                # peaks (screen buffers + merged shortlist live at
+                # once) — sample it like serve dispatch and QSTS chunks.
+                profiling.PROFILER.sample_memory("topo")
+            if roofline.ROOFLINE.enabled:  # one attribute check when off
+                # chunk_s closes at the np.asarray pulls above — the
+                # designed host boundary, so it is honest device wall.
+                # The registry traced the screen at 4 variant lanes;
+                # the first chunk of a (resumed) sweep carries the
+                # trace+compile hit, so it is counted but not credited.
+                roofline.ROOFLINE.record_dispatch(
+                    "pf/topo/screen",
+                    device_s=None if kc == start_chunk else chunk_s,
+                    scale=chunk / 4.0,
+                )
             if checkpoint_path:
                 from freedm_tpu.runtime import checkpoint as ckpt
 

@@ -50,6 +50,7 @@ from typing import Callable, Dict, NamedTuple, Optional
 import numpy as np
 
 from freedm_tpu.core import profiling
+from freedm_tpu.core import roofline
 from freedm_tpu.core import tracing
 from freedm_tpu.scenarios.profiles import PROFILE_KINDS, ProfileSet, ProfileSpec
 
@@ -623,6 +624,18 @@ class QstsEngine:
                     time.monotonic() - t_solve,
                 )
             profiling.PROFILER.sample_memory("qsts")
+        if roofline.ROOFLINE.enabled:  # one attribute check when off
+            # The registry traced the chunk programs at S2xT4 (8
+            # scenario-steps), so the model cost scales with the
+            # dispatched scenario-step count; the compile-tainted first
+            # dispatch of a shape is counted but not credited wall.
+            roofline.ROOFLINE.record_dispatch(
+                "qsts/bus_chunk" if self.kind == "bus"
+                else "qsts/feeder_chunk",
+                device_s=None if new_shape
+                else time.monotonic() - t_solve,
+                scale=spec.scenarios * tc / 8.0,
+            )
         if self._gather is not None:
             # Gather shards back to host numpy (profiled as mesh.gather)
             # — the boundary that keeps chunk checkpoints placement-free.

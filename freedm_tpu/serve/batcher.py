@@ -77,6 +77,7 @@ from typing import Dict, List, Optional
 
 from freedm_tpu.core import metrics as obs
 from freedm_tpu.core import profiling
+from freedm_tpu.core import roofline
 from freedm_tpu.core import tracing
 from freedm_tpu.core.faults import FAULTS
 from freedm_tpu.serve.queue import ServeError, ShuttingDown, Ticket
@@ -655,6 +656,25 @@ class MicroBatcher:
                     max(time.monotonic() - t_host0 - solve_s, 0.0),
                 )
                 profiling.PROFILER.sample_memory("serve")
+            if roofline.ROOFLINE.enabled:  # one attribute check when off
+                # solve_s is block_until_ready-bounded above — the
+                # honest device wall the roofline join needs.  The
+                # registry traced these programs at fixed lane counts
+                # (pf bucket 4, vvc bucket 2), so the model cost scales
+                # linearly with the dispatched bucket; a compile-tainted
+                # first dispatch is counted but not credited wall.
+                _rl_prog, _rl_base = {
+                    "pf": ("serve/pf/bucket4", 4.0),
+                    "vvc": ("serve/vvc/bucket2", 2.0),
+                    "n1": ("pf/n1/smw", None),
+                }.get(workload, (None, None))
+                if _rl_prog is not None:
+                    roofline.ROOFLINE.record_dispatch(
+                        _rl_prog,
+                        device_s=None if work.new_shape else solve_s,
+                        scale=1.0 if _rl_base is None
+                        else work.bucket / _rl_base,
+                    )
             for t in group:
                 self.service._complete_ok(t, info)
         except Exception as e:  # noqa: BLE001 — waiters must never hang

@@ -21,6 +21,10 @@ Routes:
 - ``GET /v1/jobs/<id>`` — poll a job (progress, then the summary);
   ``POST /v1/jobs/<id>/cancel`` — stop it at the next chunk boundary.
 - ``GET /healthz`` — liveness + the workload/case table.
+- ``GET /metrics`` — this replica's Prometheus registry rendering, the
+  per-replica half of the router's fleet federation scrape
+  (``RouterServer`` ``GET /metrics`` sums these under a ``replica``
+  label; docs/observability.md).
 - ``GET /stats`` — queue depth, the batcher's shape-bucket table, the
   per-shape recompile attribution (``recompiles_by_bucket``:
   ``"workload/case:bucket" -> first dispatches``, so a recompile storm
@@ -162,6 +166,17 @@ class ServeServer(BackgroundHttpServer):
                             {"error": {"type": err.code, "detail": str(err)}},
                             retry_after_s=getattr(err, "retry_after_s", None))
 
+            def _reply_text(self, code: int, text: str,
+                            content_type: str) -> None:
+                data = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                if self.close_connection:
+                    self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(data)
+
             def _jobs(self):
                 if jm is None:
                     raise NotFound(
@@ -190,6 +205,18 @@ class ServeServer(BackgroundHttpServer):
                         if jm is not None:
                             stats["qsts"] = jm.stats()
                         self._reply(200, stats)
+                    elif path == "/metrics":
+                        # The per-replica federation scrape target: the
+                        # process registry in the text exposition
+                        # format, exactly what MetricsServer serves —
+                        # but on the serve port, so the router can sum
+                        # the fleet without a second port per replica.
+                        from freedm_tpu.core.metrics import REGISTRY
+
+                        self._reply_text(
+                            200, REGISTRY.render_prometheus(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
                     elif path.startswith("/v1/jobs/"):
                         job_id = path[len("/v1/jobs/"):]
                         self._reply(200, self._jobs().get(job_id))
@@ -199,7 +226,8 @@ class ServeServer(BackgroundHttpServer):
                             "post": [f"/v1/{w}" for w in WORKLOADS]
                             + ["/v1/qsts", "/v1/topo/sweep",
                                "/v1/jobs/<id>/cancel"],
-                            "get": ["/healthz", "/stats", "/v1/jobs/<id>"],
+                            "get": ["/healthz", "/stats", "/metrics",
+                                    "/v1/jobs/<id>"],
                         })
                     else:
                         self._reply(404, {"error": {"type": "not_found",

@@ -42,7 +42,11 @@ Everything is surfaced on the existing registry/tracer: ``router_*``
 metrics, per-replica breaker-state gauges, and one ``serve.route``
 span per routed request (tags: case, owner, served-by replica,
 attempts, outcome).  The router itself exposes ``/healthz`` (its own
-liveness + the replica table) and ``/stats``.
+liveness + the replica table), ``/stats``, and ``GET /metrics`` — the
+fleet federation scrape: every replica's registry with a ``replica``
+label injected plus the router's own series, so one scrape target
+covers the whole fleet (``router_federation_up`` marks replicas that
+missed the scrape).
 
 Scope: the router fronts the synchronous what-if workloads
 (``POST /v1/pf|n1|vvc``).  QSTS jobs are replica-local state (a job id
@@ -134,6 +138,47 @@ class HashRing:
     def owner(self, key: str) -> Optional[str]:
         pref = self.preference(key)
         return pref[0] if pref else None
+
+
+def _relabel_exposition(text: str, replica: str,
+                        seen_meta: set) -> List[str]:
+    """Inject ``replica="<id>"`` into every sample line of a Prometheus
+    text exposition, keeping the first ``# HELP``/``# TYPE`` per metric
+    fleet-wide (``seen_meta`` carries the dedupe state across calls).
+    A sample that already carries a ``replica`` label — the router's
+    own breaker/federation gauges — is passed through untouched: a
+    duplicate label name is illegal exposition."""
+    label = 'replica="{}"'.format(
+        replica.replace("\\", "\\\\").replace('"', '\\"')
+    )
+    out: List[str] = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                key = (parts[1], parts[2])
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+            out.append(line)
+            continue
+        brace = line.find("{")
+        space = line.find(" ")
+        if brace != -1 and (space == -1 or brace < space):
+            close = line.rfind("}")
+            inner = line[brace + 1:close]
+            if 'replica="' in inner:
+                out.append(line)
+                continue
+            inner = "{},{}".format(inner, label) if inner else label
+            out.append(line[:brace + 1] + inner + "}" + line[close + 1:])
+        elif space != -1:
+            out.append("{}{{{}}}{}".format(line[:space], label, line[space:]))
+        else:
+            out.append(line)
+    return out
 
 
 class ReplicaState:
@@ -393,6 +438,53 @@ class Router:
             # replica dying mid-response — a probe failure, never a
             # prober-thread death.
             return False, False
+
+    # -- fleet federation (GET /metrics) -------------------------------------
+    def federate_metrics(self) -> str:
+        """One Prometheus scrape target for the whole fleet: every
+        replica's ``GET /metrics`` rendering with a ``replica=<id>``
+        label injected on each sample line (``# HELP``/``# TYPE``
+        deduplicated fleet-wide), followed by the router's own registry
+        labeled ``replica="router"``.  Fleet totals are a query-side
+        ``sum without(replica)(...)`` — the label keeps per-replica
+        attribution, which a pre-summed exposition would destroy.  A
+        replica that fails the scrape contributes nothing but its
+        ``router_federation_up{replica=...} 0`` marker."""
+        with self._lock:
+            targets = list(self.replicas.values())
+        seen_meta: set = set()
+        out: List[str] = []
+        for st in targets:
+            text = self._scrape_metrics(st)
+            obs.ROUTER_FEDERATION_UP.labels(st.id).set(
+                0.0 if text is None else 1.0
+            )
+            if text is None:
+                continue
+            out.extend(_relabel_exposition(text, st.id, seen_meta))
+        # Router-local series last, so its just-updated federation_up
+        # gauges describe THIS scrape.
+        out.extend(_relabel_exposition(
+            obs.REGISTRY.render_prometheus(), "router", seen_meta
+        ))
+        return "\n".join(out) + "\n"
+
+    def _scrape_metrics(self, st: ReplicaState) -> Optional[str]:
+        try:
+            conn = http.client.HTTPConnection(
+                st.host, st.port, timeout=self.config.probe_timeout_s
+            )
+            try:
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    return None
+                return body.decode("utf-8", "replace")
+            finally:
+                conn.close()
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
 
     # -- routing core --------------------------------------------------------
     def route(self, path: str, body: bytes) -> _ProxyReply:
@@ -738,6 +830,21 @@ class RouterServer:
                         self._reply(
                             200, (json.dumps(rt.stats()) + "\n").encode()
                         )
+                    elif path == "/metrics":
+                        # Fleet federation: replica registries summed
+                        # under a replica label + the router's own
+                        # series (text exposition, not JSON).
+                        data = rt.federate_metrics().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                        self.send_header("Content-Length", str(len(data)))
+                        if self.close_connection:
+                            self.send_header("Connection", "close")
+                        self.end_headers()
+                        self.wfile.write(data)
                     else:
                         self._reply(404, _error_reply(
                             NotFound(f"no route {path!r}")

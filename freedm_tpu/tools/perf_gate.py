@@ -83,8 +83,15 @@ def direction(name: str) -> int:
     """+1 higher-is-better, -1 lower-is-better, 0 informational.
     Higher-better fragments win ties (``..._per_sec`` contains no
     lower-better fragment, but ``...ms_per_iteration`` style names
-    must resolve deterministically)."""
+    must resolve deterministically).  ``roofline_*`` columns are always
+    informational: achieved MFU/intensity on a shared CI host is
+    trajectory data for the accelerator-run diff, not a gate — their
+    own drift gate is the roofline inventory diff (bench
+    ``--sections roofline``), which compares only the deterministic
+    model columns."""
     low = name.lower()
+    if "roofline_" in low:
+        return 0
     if any(f in low for f in HIGHER_BETTER):
         return 1
     if any(f in low for f in LOWER_BETTER):

@@ -168,6 +168,30 @@ def scrape_json_route(port: int, route: str, timeout_s: float = 3.0) -> Dict:
         return {}
 
 
+def sum_roofline(snaps: Dict[str, Dict]) -> Dict:
+    """Fleet-summed roofline block: per-program dispatch counts and
+    blocked device wall added across every slice's ``/roofline``
+    snapshot (the fleet's attribution, not one process's)."""
+    fleet: Dict[str, Dict] = {}
+    enabled = False
+    for snap in snaps.values():
+        if not snap:
+            continue
+        enabled = enabled or bool(snap.get("enabled"))
+        for name, row in snap.get("programs", {}).items():
+            agg = fleet.setdefault(name, {
+                "dispatches": 0, "blocked_dispatches": 0, "device_s": 0.0,
+            })
+            agg["dispatches"] += int(row.get("dispatches") or 0)
+            agg["blocked_dispatches"] += int(
+                row.get("blocked_dispatches") or 0
+            )
+            agg["device_s"] = round(
+                agg["device_s"] + float(row.get("device_s") or 0.0), 6
+            )
+    return {"enabled": enabled, "programs": fleet}
+
+
 _CACHE_DIR: Optional[str] = None
 
 
@@ -886,6 +910,7 @@ def run_soak(
     pre_kill_pairs: List[Dict] = []
     slo_status: Dict = {}
     profile_snap: Dict = {}
+    roofline_snaps: Dict[str, Dict] = {}
     plant = subprocess.Popen(
         [sys.executable, "-m", "freedm_tpu.sim.plantserver", str(wd / "rig.xml")],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=_env(), text=True,
@@ -1199,6 +1224,15 @@ def run_soak(
             for p in procs
             if p.alive() and p.spec.metrics_port is not None
         )
+        # Per-slice roofline snapshots, fleet-summed into the artifact
+        # below: the dispatch/device-wall attribution of the whole soak
+        # run, per program (empty rows while --roofline is off).
+        roofline_snaps.update(
+            (p.spec.uuid,
+             scrape_json_route(p.spec.metrics_port, "/roofline"))
+            for p in procs
+            if p.alive() and p.spec.metrics_port is not None
+        )
     finally:
         if loader is not None:
             serve_summary = loader.stop()
@@ -1287,6 +1321,19 @@ def run_soak(
             "status": slo_status,
         },
         "profile": profile_snap,
+        "roofline": {
+            "fleet": sum_roofline(roofline_snaps),
+            "slices": {
+                uuid: {
+                    "enabled": bool(snap.get("enabled")),
+                    "dispatches_total": sum(
+                        int(r.get("dispatches") or 0)
+                        for r in snap.get("programs", {}).values()
+                    ),
+                }
+                for uuid, snap in roofline_snaps.items() if snap
+            },
+        },
     }
     if chaos_artifact is not None:
         artifact["chaos"] = chaos_artifact

@@ -1,0 +1,297 @@
+"""Roofline observatory tests (``freedm_tpu.core.roofline``).
+
+Covers: the static join against a hand-written gridprobe inventory
+(achieved FLOP/s, MFU, intensity, bound class, per-program roof and
+headroom), dispatch-only attribution (async sites credit nothing), the
+disabled-by-default no-op path (the acceptance bar: one attribute
+check, no recorded state), ``traced_solver`` steady-state attribution
+including the under-a-jax-trace (vmap) guard, the ``/roofline`` route
+schema, the checked-in roofline inventory's consistency, and the CI
+drift gate (``diff_roofline_inventory`` plus ``bench.py``'s exit-1
+path on a mutated inventory).
+"""
+
+import importlib.util
+import json
+import pathlib
+import urllib.request
+
+import pytest
+
+from freedm_tpu.core import metrics as M
+from freedm_tpu.core import roofline, tracing
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CHECKED_IN = REPO / "freedm_tpu" / "tools" / "roofline_inventory.json"
+
+
+def _toy_inventory(tmp_path, name="toy/prog", flops=2e9, by=1e9):
+    """A minimal gridprobe-shaped inventory with one known program:
+    intensity flops/by (= 2.0 by default, memory-bound on the CPU
+    row's balance of 2.5)."""
+    p = tmp_path / "ir_inventory.json"
+    p.write_text(json.dumps({
+        "programs": {name: {"flops": flops, "bytes_accessed": by}},
+    }))
+    return p
+
+
+@pytest.fixture
+def rl(tmp_path):
+    """An enabled observatory pinned to the CPU peak row and a toy
+    inventory; hard-reset afterwards so the rest of the suite runs on
+    the disabled no-op path."""
+    roofline.ROOFLINE.configure(
+        enabled=True,
+        inventory_path=str(_toy_inventory(tmp_path)),
+        peak_flops=5e10,
+        peak_bytes=2e10,
+    )
+    yield roofline.ROOFLINE
+    roofline.ROOFLINE.reset()
+
+
+# ---------------------------------------------------------------------------
+# static join + attribution arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_static_join_attributes_measured_wall_to_model_costs(rl):
+    # Two blocked dispatches of 0.5 s each at scale 1.0: 4e9 model
+    # FLOPs over 1.0 s of device wall.
+    rl.record_dispatch("toy/prog", device_s=0.5)
+    rl.record_dispatch("toy/prog", device_s=0.5)
+    row = rl.report()["programs"]["toy/prog"]
+    assert row["dispatches"] == 2
+    assert row["blocked_dispatches"] == 2
+    assert row["device_s"] == pytest.approx(1.0)
+    assert row["intensity_flops_per_byte"] == pytest.approx(2.0)
+    assert row["bound"] == "memory"  # 2.0 < balance 5e10/2e10 = 2.5
+    assert row["achieved_flops_per_s"] == pytest.approx(4e9)
+    assert row["achieved_bytes_per_s"] == pytest.approx(2e9)
+    assert row["mfu_pct"] == pytest.approx(100 * 4e9 / 5e10)  # 8 %
+    # The program's own roof is its bandwidth ceiling:
+    # intensity * peak_bytes = 2.0 * 2e10 = 4e10 < peak_flops.
+    assert row["roof_flops_per_s"] == pytest.approx(4e10)
+    assert row["roof_pct"] == pytest.approx(10.0)
+    assert row["headroom_s"] == pytest.approx(0.9)
+    # The headroom ranking surfaces it as the top target.
+    targets = rl.report(top_n=3)["targets"]
+    assert targets and targets[0]["program"] == "toy/prog"
+
+
+def test_scale_multiplies_model_costs(rl):
+    # A half-shape dispatch credits half the registered trace's cost.
+    rl.record_dispatch("toy/prog", device_s=0.5, scale=0.5)
+    row = rl.report()["programs"]["toy/prog"]
+    assert row["achieved_flops_per_s"] == pytest.approx(2e9)
+
+
+def test_dispatch_only_counts_but_credits_nothing(rl):
+    # device_s=None is the async-dispatch site contract: counted,
+    # never credited — no fabricated throughput.
+    rl.record_dispatch("toy/prog")
+    row = rl.report()["programs"]["toy/prog"]
+    assert row["dispatches"] == 1
+    assert row["blocked_dispatches"] == 0
+    assert row["achieved_flops_per_s"] is None
+    assert row["mfu_pct"] is None
+    # Model columns are served even without any wall credit.
+    assert row["bound"] == "memory"
+
+
+def test_unknown_program_still_counts_dispatches(rl):
+    rl.record_dispatch("not/registered", device_s=0.1)
+    row = rl.report()["programs"]["not/registered"]
+    assert row["dispatches"] == 1
+    assert row["model_flops"] is None
+    assert row["bound"] == "unknown"
+    assert row["achieved_flops_per_s"] is None
+
+
+# ---------------------------------------------------------------------------
+# disabled-by-default tripwire
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_mode_records_nothing():
+    # The acceptance bar: when off, instrumented sites pay one
+    # attribute check and record_dispatch is a no-op.
+    assert roofline.ROOFLINE.enabled is False
+    before = roofline.ROOFLINE._programs.copy()
+    roofline.ROOFLINE.record_dispatch("toy/prog", device_s=1.0)
+    assert roofline.ROOFLINE._programs == before
+    assert roofline.ROOFLINE.snapshot()["enabled"] is False
+
+
+# ---------------------------------------------------------------------------
+# traced_solver attribution (+ the vmap/trace guard)
+# ---------------------------------------------------------------------------
+
+
+def test_traced_solver_steady_state_dispatches_are_attributed(tmp_path):
+    roofline.ROOFLINE.configure(
+        enabled=True,
+        inventory_path=str(_toy_inventory(tmp_path, "pf/newton/dense")),
+        peak_flops=5e10, peak_bytes=2e10,
+    )
+    try:
+        wrapped = tracing.traced_solver(
+            "newton", lambda x: x * 2.0, tags={"pf_backend": "dense"})
+        wrapped(1.0)  # first call = compile, never attributed
+        wrapped(1.0)
+        wrapped(1.0)
+        row = roofline.ROOFLINE.report()["programs"]["pf/newton/dense"]
+        assert row["dispatches"] == 2
+        # Steady-state solver dispatches are async: dispatch-only.
+        assert row["blocked_dispatches"] == 0
+    finally:
+        roofline.ROOFLINE.reset()
+
+
+def test_traced_solver_under_vmap_records_nothing(tmp_path):
+    # A solver re-entered inside a jax transformation trace (vmap here)
+    # is one device program, not N dispatches — the trace guard must
+    # keep every traced call out of the account.
+    import jax
+    import jax.numpy as jnp
+
+    roofline.ROOFLINE.configure(
+        enabled=True,
+        inventory_path=str(_toy_inventory(tmp_path, "pf/newton/dense")),
+        peak_flops=5e10, peak_bytes=2e10,
+    )
+    try:
+        wrapped = tracing.traced_solver(
+            "newton", lambda x: x * 2.0, tags={"pf_backend": "dense"})
+        vf = jax.vmap(wrapped)
+        vf(jnp.arange(4.0))
+        vf(jnp.arange(4.0))  # steady state, still inside the trace
+        assert "pf/newton/dense" not in roofline.ROOFLINE._programs
+    finally:
+        roofline.ROOFLINE.reset()
+
+
+def test_solver_program_vocabulary():
+    assert roofline.solver_program("newton", "dense") == "pf/newton/dense"
+    assert roofline.solver_program("newton", "sparse") == "pf/newton/sparse"
+    assert roofline.solver_program(
+        "newton", "sparse", "mixed") == "pf/newton/sparse/mixed"
+    assert roofline.solver_program("krylov", "matrix_free") == "pf/krylov"
+    assert roofline.solver_program("nosuch") is None
+
+
+# ---------------------------------------------------------------------------
+# /roofline route
+# ---------------------------------------------------------------------------
+
+
+def test_roofline_route_serves_full_report(tmp_path):
+    roofline.ROOFLINE.configure(
+        enabled=True, peak_flops=5e10, peak_bytes=2e10)
+    roofline.ROOFLINE.record_dispatch("pf/newton/dense", device_s=0.25)
+    server = M.MetricsServer(port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/roofline", timeout=5
+        ) as r:
+            served = json.loads(r.read())
+        # A malformed capture request is rejected up front (400), not
+        # handed to the profiler.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/profile/capture?ms=0",
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc.value.code == 400
+    finally:
+        server.stop()
+        roofline.ROOFLINE.reset()
+    assert served["enabled"] is True
+    assert set(served) == {"enabled", "peak", "programs", "targets"}
+    assert served["peak"]["flops_per_s"] == pytest.approx(5e10)
+    # Every statically registered program appears, dispatched or not.
+    assert len(served["programs"]) >= 21
+    row = served["programs"]["pf/newton/dense"]
+    assert row["dispatches"] == 1
+    assert row["mfu_pct"] is not None
+    for col in ("intensity_flops_per_byte", "bound", "headroom_s"):
+        assert col in row
+
+
+# ---------------------------------------------------------------------------
+# the checked-in inventory + drift gate
+# ---------------------------------------------------------------------------
+
+
+def test_checked_in_roofline_inventory_matches_static_model():
+    # The gated columns are pure functions of the checked-in gridprobe
+    # inventory and the CPU peak row — a fresh report (no measurement
+    # needed) must be diff-clean against the committed file.
+    recorded = json.loads(CHECKED_IN.read_text())
+    roofline.ROOFLINE.configure(enabled=True)
+    try:
+        inv = roofline.build_roofline_inventory(
+            roofline.ROOFLINE.report())
+    finally:
+        roofline.ROOFLINE.reset()
+    assert roofline.diff_roofline_inventory(inv, recorded, tol=0.5) == []
+    assert len(recorded["programs"]) >= 21
+
+
+def test_diff_rejects_model_drift(rl):
+    rl.record_dispatch("toy/prog", device_s=0.5)
+    inv = roofline.build_roofline_inventory(rl.report())
+    assert roofline.diff_roofline_inventory(inv, inv, tol=0.5) == []
+    # Measured columns never gate: a noisy rerun stays clean.
+    noisy = json.loads(json.dumps(inv))
+    noisy["programs"]["toy/prog"]["measured"]["mfu_pct"] = 99.0
+    assert roofline.diff_roofline_inventory(noisy, inv, tol=0.5) == []
+    # Model drift fails: flops beyond tolerance and a bound flip.
+    drifted = json.loads(json.dumps(inv))
+    drifted["programs"]["toy/prog"]["flops"] *= 4
+    drifted["programs"]["toy/prog"]["bound"] = "compute"
+    findings = roofline.diff_roofline_inventory(drifted, inv, tol=0.5)
+    assert len(findings) == 2
+    assert any("bound class" in f for f in findings)
+    assert any("flops drifted" in f for f in findings)
+    # Program set changes are findings in both directions.
+    gone = json.loads(json.dumps(inv))
+    del gone["programs"]["toy/prog"]
+    assert any("no longer measured" in f
+               for f in roofline.diff_roofline_inventory(gone, inv, 0.5))
+    assert any("new program" in f
+               for f in roofline.diff_roofline_inventory(inv, gone, 0.5))
+    # A backend mismatch short-circuits: nothing else is comparable.
+    other = json.loads(json.dumps(inv))
+    other["backend"] = "tpu_v5e"
+    findings = roofline.diff_roofline_inventory(inv, other, 0.5)
+    assert len(findings) == 1 and "backend drifted" in findings[0]
+
+
+def test_bench_roofline_exits_1_on_drifted_inventory(tmp_path, monkeypatch):
+    # The CI contract end to end: bench --sections roofline against a
+    # mutated inventory must exit 1.  The registry measurement is
+    # stubbed out — the gate runs on the static join alone.
+    spec = importlib.util.spec_from_file_location(
+        "bench", str(REPO / "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    monkeypatch.setattr(
+        roofline.ROOFLINE, "measure_registry",
+        lambda repeats=3, programs=None: {"measured": [], "errors": {}})
+    recorded = json.loads(CHECKED_IN.read_text())
+    recorded["programs"]["pf/newton/dense"]["flops"] *= 4
+    mutated = tmp_path / "roofline_inventory.json"
+    mutated.write_text(json.dumps(recorded))
+    try:
+        with pytest.raises(SystemExit) as exc:
+            bench.bench_roofline(str(mutated), tol=0.5, repeats=1)
+        assert exc.value.code == 1
+        # And the clean path: the same run against the committed file
+        # is diff-clean and reports it was not rewritten.
+        out = bench.bench_roofline(str(CHECKED_IN), tol=0.5, repeats=1)
+        assert out["roofline_inventory_written"] is False
+    finally:
+        roofline.ROOFLINE.reset()
