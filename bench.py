@@ -1011,6 +1011,24 @@ def bench_cache() -> dict:
         out["exact_hit_p50_ms"] = _latency_stats(lats)["p50_ms"]
         out["exact_hit_served"] = len(lats)
 
+        # (a2) receipt overhead on the hottest path: the same exact-hit
+        # ladder with provenance receipts ON (no shadow sampling, no
+        # journal) — the difference vs (a) is the price of a stamped
+        # answer; the disabled-by-default contract keeps it off every
+        # other row.
+        from freedm_tpu.core.provenance import PROVENANCE
+
+        PROVENANCE.configure(enabled=True, rate_spec="0.0")
+        try:
+            lats_r, tiers = measure(svc_on, [base_req] * 200)
+            assert all(t == "exact" for t in tiers)
+        finally:
+            PROVENANCE.reset()
+        out["exact_hit_receipts_p50_ms"] = _latency_stats(lats_r)["p50_ms"]
+        out["serve_receipt_overhead_us"] = round(max(
+            out["exact_hit_receipts_p50_ms"] - out["exact_hit_p50_ms"], 0.0
+        ) * 1e3, 1)
+
         # (b) delta ladder at rank 1/4/16 vs the cache-off full solve
         # over the SAME delta distribution.
         delta = {}

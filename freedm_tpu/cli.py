@@ -218,6 +218,23 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                                       "pf_precision_fallbacks_total per "
                                       "Newton solve (default 0.05; 0 = "
                                       "disabled)")
+    ap.add_argument("--slo-shadow-mismatch-rate", type=float, default=None,
+                    metavar="R", help="shadow-verify objective: mismatches "
+                                      "per shadow-verified answer (default "
+                                      "0.01; 0 = disabled; needs "
+                                      "--shadow-verify-rate > 0)")
+    ap.add_argument("--shadow-verify-rate", default=None, metavar="SPEC",
+                    help="provenance shadow sampler: fraction of served "
+                         "answers re-solved on the background full-f64 "
+                         "lane — a bare rate ('0.05'), per-tier overrides "
+                         "('exact=1.0,delta=0.5'), optional 'seed=N;' "
+                         "prefix.  Any non-empty spec also turns on "
+                         "provenance receipts (docs/observability.md)")
+    ap.add_argument("--provenance-log", default=None, metavar="PATH",
+                    help="append every provenance receipt as a JSONL "
+                         "record (enables receipts even without a shadow "
+                         "rate; joined with trace/event logs by "
+                         "tools/audit_report.py)")
     ap.add_argument("--fault-spec", default=None, metavar="SPEC",
                     help="deterministic fault-injection schedule: "
                          "'[seed=N;]point:rate[:arg=V][:after=N][:max=N]' "
@@ -396,6 +413,9 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("slo_qsts_floor", "slo_qsts_floor"),
         ("slo_watchdog_s", "slo_watchdog_s"),
         ("slo_pf_fallback_rate", "slo_pf_fallback_rate"),
+        ("slo_shadow_mismatch_rate", "slo_shadow_mismatch_rate"),
+        ("shadow_verify_rate", "shadow_verify_rate"),
+        ("provenance_log", "provenance_log"),
         ("fault_spec", "fault_spec"),
         ("router_port", "router_port"),
         ("router_replica", "router_replica"),
@@ -486,6 +506,22 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         from freedm_tpu.core.faults import FAULTS
 
         FAULTS.configure(cfg.fault_spec)
+
+    if cfg.shadow_verify_rate or cfg.provenance_log:
+        # Provenance receipts + shadow verification — on before the
+        # serve stack exists, so the very first served answer already
+        # carries a receipt.  The replica identity stamped into every
+        # receipt is this process's node UUID (the same identity the
+        # fleet config uses), so a fleet-merged receipt log attributes
+        # each answer to its process.
+        from freedm_tpu.core.provenance import PROVENANCE
+
+        PROVENANCE.configure(
+            enabled=True,
+            rate_spec=cfg.shadow_verify_rate or "",
+            log=cfg.provenance_log,
+            replica=cfg.uuid,
+        )
 
     # Config sanity BEFORE any resource is bound: --mesh-devices and
     # --federate are different deployment shapes, and rejecting them
@@ -790,6 +826,7 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             broker_overrun_rate=cfg.slo_overrun_rate,
             qsts_floor_steps_per_sec=cfg.slo_qsts_floor,
             pf_fallback_rate=cfg.slo_pf_fallback_rate,
+            shadow_mismatch_rate=cfg.slo_shadow_mismatch_rate,
             watchdog_s=cfg.slo_watchdog_s,
         ))
         if serve_service is not None:

@@ -381,6 +381,21 @@ class GlobalConfig:
     # Newton/Krylov solver iteration over the burn windows (a
     # mass-fallback regression silently halves throughput; 0 = off).
     slo_pf_fallback_rate: float = 0.05
+    # Shadow-verify mismatch-rate objective (core/provenance.py):
+    # mismatches per shadow-verified answer over the burn windows —
+    # silent numerical drift pages like a latency regression (0 = off;
+    # only meaningful with shadow_verify_rate > 0).
+    slo_shadow_mismatch_rate: float = 0.01
+    # Provenance receipts + shadow verification (core/provenance.py).
+    # shadow_verify_rate is the seeded sampler spec ("0.05",
+    # "exact=1.0,delta=0.5", "seed=7;0.01,full=0"); any non-empty spec
+    # ENABLES the observatory (receipts on every response + the
+    # background full-f64 re-solve lane).  provenance_log appends every
+    # receipt as a provenance.receipt JSONL record (and also enables
+    # receipts, without sampling, when the rate spec is empty) — the
+    # file tools/audit_report.py joins with trace/event logs.
+    shadow_verify_rate: str = ""
+    provenance_log: Optional[str] = None
     # Roofline observatory (freedm_tpu.core.roofline): per-program
     # measured-vs-model MFU attribution against gridprobe's static
     # flops/bytes inventory, exported as roofline_* metrics and the

@@ -60,6 +60,17 @@ def _label_str(names: Tuple[str, ...], values: Tuple[str, ...], extra: str = "")
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def _exemplar_suffix(ex: Optional[Tuple[str, float]]) -> str:
+    """The OpenMetrics exemplar suffix of one sample line:
+    `` # {trace_id="..."} value``, or nothing while no exemplar was
+    recorded (plain Prometheus scrapes stay byte-identical)."""
+    if ex is None:
+        return ""
+    tid, value = ex
+    tid = str(tid).replace("\\", "\\\\").replace('"', '\\"')
+    return f' # {{trace_id="{tid}"}} {_fmt(value)}'
+
+
 def estimate_quantiles(bounds, counts, qs: Sequence[float] = (0.5, 0.95, 0.99)):
     """Estimated quantiles from a fixed-bucket histogram.
 
@@ -122,21 +133,33 @@ class _Child:
 
 
 class _CounterChild(_Child):
-    __slots__ = ("_value",)
+    __slots__ = ("_value", "_exemplar")
 
     def __init__(self, lock):
         super().__init__(lock)
         self._value = 0.0
+        # Optional OpenMetrics exemplar: the last (trace_id, amount)
+        # increment that carried one — links a counter spike straight
+        # to its trace.  None until a caller passes exemplar=.
+        self._exemplar = None
 
     def _zero(self) -> None:
         with self._lock:
             self._value = 0.0
+            self._exemplar = None
 
-    def inc(self, amount: float = 1.0) -> None:
+    def inc(self, amount: float = 1.0,
+            exemplar: Optional[str] = None) -> None:
         if amount < 0:
             raise ValueError("counters only go up")
         with self._lock:
             self._value += amount
+            if exemplar is not None:
+                self._exemplar = (str(exemplar), float(amount))
+
+    def exemplar(self):
+        with self._lock:
+            return self._exemplar
 
     @property
     def value(self) -> float:
@@ -173,7 +196,7 @@ class _GaugeChild(_Child):
 
 
 class _HistogramChild(_Child):
-    __slots__ = ("_bounds", "_counts", "_sum")
+    __slots__ = ("_bounds", "_counts", "_sum", "_exemplars")
 
     def __init__(self, lock, bounds: np.ndarray):
         super().__init__(lock)
@@ -181,20 +204,37 @@ class _HistogramChild(_Child):
         # One slot per finite bucket + the +Inf overflow slot.
         self._counts = np.zeros(len(bounds) + 1, np.int64)
         self._sum = 0.0
+        # Optional OpenMetrics exemplars: bucket index -> the last
+        # (trace_id, value) observed into that bucket with one — a p99
+        # bucket then links straight to its trace.  Empty (and the
+        # exposition unchanged) until a caller passes exemplar=.
+        self._exemplars: Dict[int, Tuple[str, float]] = {}
 
     def _zero(self) -> None:
         with self._lock:
             self._counts[:] = 0
             self._sum = 0.0
+            self._exemplars.clear()
 
-    def observe(self, value) -> None:
+    def observe(self, value, exemplar: Optional[str] = None) -> None:
         """Record one value or an array of values (no device syncs: the
-        caller hands host data)."""
+        caller hands host data).  ``exemplar`` tags the value's bucket
+        with a trace_id (scalar observes only — a batched observe has
+        no single trace)."""
         vals = np.atleast_1d(np.asarray(value, np.float64))
         idx = np.searchsorted(self._bounds, vals, side="left")
         with self._lock:
             np.add.at(self._counts, idx, 1)
             self._sum += float(vals.sum())
+            if exemplar is not None and vals.size == 1:
+                self._exemplars[int(idx[0])] = (
+                    str(exemplar), float(vals[0])
+                )
+
+    def exemplars(self) -> Dict[int, Tuple[str, float]]:
+        """Bucket index -> (trace_id, value) exemplar snapshot."""
+        with self._lock:
+            return dict(self._exemplars)
 
     @property
     def count(self) -> int:
@@ -270,8 +310,9 @@ class Counter(_Metric):
     def _new_child(self):
         return _CounterChild(self._lock)
 
-    def inc(self, amount: float = 1.0) -> None:
-        self.labels().inc(amount)  # type: ignore[attr-defined]
+    def inc(self, amount: float = 1.0,
+            exemplar: Optional[str] = None) -> None:
+        self.labels().inc(amount, exemplar=exemplar)  # type: ignore[attr-defined]
 
 
 class Gauge(_Metric):
@@ -308,8 +349,8 @@ class Histogram(_Metric):
     def _new_child(self):
         return _HistogramChild(self._lock, self._bounds)
 
-    def observe(self, value) -> None:
-        self.labels().observe(value)  # type: ignore[attr-defined]
+    def observe(self, value, exemplar: Optional[str] = None) -> None:
+        self.labels().observe(value, exemplar=exemplar)  # type: ignore[attr-defined]
 
     @property
     def count(self) -> int:
@@ -387,22 +428,34 @@ class MetricsRegistry:
             return [self._metrics[k] for k in sorted(self._metrics)]
 
     def render_prometheus(self) -> str:
-        """The text exposition format (version 0.0.4)."""
+        """The text exposition format (version 0.0.4), with OpenMetrics
+        exemplar suffixes (`` # {trace_id="..."} value``) on any bucket
+        or counter sample that recorded one — absent entirely while no
+        caller passes ``exemplar=``, so plain scrapes are unchanged."""
         lines: List[str] = []
         for m in self._items():
             lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for key, child in m.children():
                 if isinstance(child, _HistogramChild):
-                    for le, c in child.buckets().items():
+                    ex = child.exemplars()
+                    for i, (le, c) in enumerate(child.buckets().items()):
                         ls = _label_str(m.label_names, key, f'le="{le}"')
-                        lines.append(f"{m.name}_bucket{ls} {c}")
+                        lines.append(
+                            f"{m.name}_bucket{ls} {c}"
+                            + _exemplar_suffix(ex.get(i))
+                        )
                     ls = _label_str(m.label_names, key)
                     lines.append(f"{m.name}_sum{ls} {_fmt(child.sum)}")
                     lines.append(f"{m.name}_count{ls} {child.count}")
                 else:
                     ls = _label_str(m.label_names, key)
-                    lines.append(f"{m.name}{ls} {_fmt(child.value)}")
+                    e = (child.exemplar()
+                         if isinstance(child, _CounterChild) else None)
+                    lines.append(
+                        f"{m.name}{ls} {_fmt(child.value)}"
+                        + _exemplar_suffix(e)
+                    )
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> Dict[str, dict]:
@@ -633,11 +686,20 @@ class MetricsServer(BackgroundHttpServer):
                                    default=str) + "\n",
                         "application/json",
                     )
+                elif url.path == "/provenance":
+                    from freedm_tpu.core import provenance as _provenance
+
+                    self._reply(
+                        200,
+                        json.dumps(_provenance.PROVENANCE.report(),
+                                   default=str) + "\n",
+                        "application/json",
+                    )
                 elif url.path == "/":
                     self._reply(
                         200,
                         "freedm_tpu metrics: /metrics /events /trace "
-                        "/profile /slo /roofline\n",
+                        "/profile /slo /roofline /provenance\n",
                         "text/plain; charset=utf-8")
                 else:
                     self._reply(404, "not found\n", "text/plain; charset=utf-8")

@@ -1,0 +1,569 @@
+"""Answer provenance receipts + shadow verification: the numerical-
+honesty observatory of the serve ladder.
+
+The serve path answers from a four-tier ladder (exact / delta / warm /
+full — docs/serving.md) with mixed-precision inners and per-lane f64
+fallback, but the float64 residual check runs inline at the delta tier
+only: once an answer leaves ``scatter`` its numerical pedigree is gone.
+This module keeps that pedigree attached and continuously audits it —
+the machinery every future tier (the roadmap's learned surrogate
+included) must clear before it is allowed to answer:
+
+- **Receipts** — every pf/n1/vvc/topo response carries a structured
+  ``provenance`` object (:data:`RECEIPT_FIELDS`, fixed key order so a
+  receipt is byte-stable per tier): answer tier, resolved pf backend
+  and precision, per-lane f64 fallback count, warm-start source digest,
+  Newton iteration count, the host-f64 residual when one was computed,
+  cache-entry age, shape bucket, replica id, and the fleet-valid
+  trace_id (the router propagates ``X-Trace-Id``/``X-Span-Id``, so the
+  id in the receipt is the id in the router's trace file).  Receipts
+  are assembled at the existing ``scatter``/``_publish_pf``/
+  ``_respond_cached`` boundaries from fields ``BatchInfo``/``ServeCache``
+  already track, counted on ``provenance_receipts_total{tier}``, and
+  optionally journaled to ``--provenance-log`` as
+  ``provenance.receipt`` JSONL records (what ``tools/audit_report.py``
+  joins with trace + event files by trace_id).
+- **Shadow verifier** — a seeded deterministic sampler
+  (``--shadow-verify-rate``, per-tier overridable) enqueues a fraction
+  of *served* pf answers — especially exact/delta cache hits, which
+  skip re-solving entirely — onto a low-priority background lane that
+  re-solves them on the full-f64 path from a flat start and diffs
+  max |Δv| pu against what was served.  Outcomes land on
+  ``shadow_verified_total{tier}`` / ``shadow_mismatch_total{tier}`` /
+  the ``shadow_max_dv_pu`` histogram (exemplared with the trace_id);
+  a mismatch journals a ``shadow.mismatch`` event carrying the full
+  receipt and feeds the ``--slo-shadow-mismatch-rate`` burn objective
+  (core/slo.py) so silent numerical drift pages like a latency
+  regression.  The lane is a bounded queue + one daemon thread:
+  full-queue enqueues DROP (``shadow_queue_drops_total``) — auditing
+  never backpressures serving — and re-solves run on host copies, so
+  the engines' donated dispatch buffers are never touched (GP004).
+- **Drift observatory** — per-(case, tier, precision) rolling windows
+  of (residual, iterations, fallbacks): residual quantiles, iteration
+  drift (recent mean vs window mean), and fallback attribution, served
+  at ``GET /provenance`` and folded into ``/stats``.
+
+Disabled by default with the TRACER/PROFILER contract: instrumented
+hot paths pay ONE attribute check (``if PROVENANCE.enabled:``), and
+:meth:`reset` returns the singleton to the disabled boot state (tests).
+
+Sampler determinism mirrors core/faults.py: each tier draws from its
+own ``random.Random(f"{seed}:{tier}")`` stream, so the same seed picks
+the same request indices regardless of tier interleaving — a replayed
+load samples the same answers (tests/test_provenance.py pins it).
+"""
+
+from __future__ import annotations
+
+import json
+import queue as _queue
+import random
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from freedm_tpu.core import metrics as obs
+
+#: The serve-ladder tiers a receipt can carry (single-flight followers
+#: are answered from their leader's just-inserted solution = "exact";
+#: "warm" is a full solve seeded from a near entry's state).
+TIERS = ("exact", "delta", "warm", "full")
+
+#: The receipt schema, in emission order (dicts preserve insertion
+#: order, so ``json.dumps`` of a receipt is byte-stable given stable
+#: field values — docs/observability.md carries the field table).
+RECEIPT_FIELDS = (
+    "tier",          # serve-ladder tier that answered (TIERS)
+    "workload",      # pf | n1 | vvc | topo
+    "case",          # grid case name
+    "trace_id",      # fleet-valid trace id (None while tracing is off)
+    "replica",       # replica identity (--hostname:port / chaos id)
+    "pf_backend",    # resolved Jacobian backend: dense | sparse
+    "pf_precision",  # resolved inner precision: f64 | mixed
+    "fallbacks",     # per-lane f64 fallback count (mixed inners)
+    "iterations",    # Newton/GMRES outer iterations for THIS lane
+    "residual_pu",   # host-f64 residual when one was computed
+    "warm_source",   # warm-start source entry digest (warm tier)
+    "cache_age_s",   # age of the serving cache entry (exact/delta)
+    "bucket",        # padded shape bucket the batch ran at (0 = cached)
+    "lanes",         # real lanes in the dispatched batch
+    "queue_ms",      # admission -> dispatch wait
+    "solve_ms",      # batched solve wall (shared by the batch)
+)
+
+# -- metrics (registered at import, zero until the observatory runs) --------
+
+PROVENANCE_RECEIPTS = obs.REGISTRY.counter(
+    "provenance_receipts_total",
+    "Provenance receipts stamped onto served answers, by serve tier",
+    labels=("tier",),
+)
+SHADOW_VERIFIED = obs.REGISTRY.counter(
+    "shadow_verified_total",
+    "Served answers re-solved on the full-f64 shadow lane, by tier",
+    labels=("tier",),
+)
+SHADOW_MISMATCH = obs.REGISTRY.counter(
+    "shadow_mismatch_total",
+    "Shadow re-solves that disagreed with the served answer beyond "
+    "tolerance, by tier",
+    labels=("tier",),
+)
+SHADOW_QUEUE_DROPS = obs.REGISTRY.counter(
+    "shadow_queue_drops_total",
+    "Sampled answers dropped because the shadow lane's bounded queue "
+    "was full (auditing never backpressures serving)",
+)
+SHADOW_MAX_DV = obs.REGISTRY.histogram(
+    "shadow_max_dv_pu",
+    "Max |Δv| pu between the shadow full-f64 re-solve and the served "
+    "answer",
+    buckets=(1e-10, 1e-8, 1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0),
+)
+# Pre-seed the tier labels so a scrape shows explicit zeros (the same
+# contract as serve_cache_hits_total's tiers).
+for _t in TIERS:
+    PROVENANCE_RECEIPTS.labels(_t)
+    SHADOW_VERIFIED.labels(_t)
+    SHADOW_MISMATCH.labels(_t)
+
+
+def parse_rate_spec(spec) -> Tuple[Optional[int], Dict[str, float]]:
+    """Parse a ``--shadow-verify-rate`` spec into ``(seed, rates)``.
+
+    Grammar (mirrors the fault-spec shape): an optional ``seed=N;``
+    prefix, then a comma list where a bare float sets the default rate
+    and ``tier=R`` entries override per tier::
+
+        0.05                      # 5% of every tier
+        exact=1.0,delta=0.5       # cache hits only (default stays 0)
+        seed=7;0.01,full=0        # seeded, full tier exempt
+
+    Rates are clamped to [0, 1]; unknown tiers are a typed error (a
+    typo silently sampling nothing is the failure mode this rejects).
+    """
+    rates = {"default": 0.0}
+    seed: Optional[int] = None
+    text = str(spec or "").strip()
+    if not text:
+        return seed, rates
+    if text.startswith("seed="):
+        head, _, text = text.partition(";")
+        try:
+            seed = int(head[len("seed="):])
+        except ValueError:
+            raise ValueError(f"bad shadow-verify seed in {spec!r}") from None
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            tier, _, val = part.partition("=")
+            tier = tier.strip()
+            if tier not in TIERS:
+                raise ValueError(
+                    f"unknown shadow-verify tier {tier!r} "
+                    f"(have: {', '.join(TIERS)})"
+                )
+        else:
+            tier, val = "default", part
+        try:
+            rates[tier] = min(max(float(val), 0.0), 1.0)
+        except ValueError:
+            raise ValueError(
+                f"bad shadow-verify rate {part!r} in {spec!r}"
+            ) from None
+    return seed, rates
+
+
+class _Sampler:
+    """Seeded deterministic per-tier sampler (the faults.py discipline:
+    one ``random.Random(f"{seed}:{tier}")`` stream per tier, so draws
+    for one tier never perturb another's and a same-seed replay picks
+    identical request indices per tier)."""
+
+    def __init__(self, seed: int, rates: Dict[str, float]):
+        self.seed = int(seed)
+        self.rates = dict(rates)
+        self._streams: Dict[str, random.Random] = {}
+
+    def rate(self, tier: str) -> float:
+        return self.rates.get(tier, self.rates.get("default", 0.0))
+
+    def any_rate(self) -> bool:
+        return any(r > 0.0 for r in self.rates.values())
+
+    def should(self, tier: str) -> bool:
+        rate = self.rate(tier)
+        if rate <= 0.0:
+            return False
+        if rate >= 1.0:
+            return True
+        rng = self._streams.get(tier)
+        if rng is None:
+            rng = self._streams[tier] = random.Random(f"{self.seed}:{tier}")
+        return rng.random() < rate
+
+
+class _ShadowItem:
+    """One sampled served answer queued for the background re-solve."""
+
+    __slots__ = ("tier", "case", "sys", "backend", "p", "q", "v", "theta",
+                 "receipt")
+
+    def __init__(self, tier, case, sys, backend, p, q, v, theta, receipt):
+        self.tier = tier
+        self.case = case
+        self.sys = sys
+        self.backend = backend
+        # Host copies: the engines' dispatch buffers are DONATED
+        # (GP004) and cache entries are shared — the shadow lane must
+        # never alias either.
+        self.p = np.array(p, np.float64, copy=True)
+        self.q = np.array(q, np.float64, copy=True)
+        self.v = np.array(v, np.float64, copy=True)
+        self.theta = np.array(theta, np.float64, copy=True)
+        self.receipt = receipt
+
+
+class _DriftWindow:
+    """Rolling (residual, iterations, fallbacks) window for one
+    (case, tier, precision) key — the drift observatory's cell."""
+
+    __slots__ = ("residuals", "iterations", "fallbacks", "count", "_cap")
+
+    def __init__(self, cap: int = 256):
+        self._cap = cap
+        self.residuals: list = []
+        self.iterations: list = []
+        self.fallbacks = 0
+        self.count = 0
+
+    def add(self, residual, iterations, fallbacks) -> None:
+        self.count += 1
+        if fallbacks:
+            self.fallbacks += int(fallbacks)
+        if residual is not None:
+            self.residuals.append(float(residual))
+            if len(self.residuals) > self._cap:
+                del self.residuals[0]
+        if iterations is not None:
+            self.iterations.append(int(iterations))
+            if len(self.iterations) > self._cap:
+                del self.iterations[0]
+
+    def summary(self) -> dict:
+        out = {"count": self.count, "fallbacks_total": self.fallbacks}
+        if self.residuals:
+            rs = sorted(self.residuals)
+            out["residual_p50"] = rs[len(rs) // 2]
+            out["residual_p95"] = rs[min(int(len(rs) * 0.95), len(rs) - 1)]
+            out["residual_max"] = rs[-1]
+        if self.iterations:
+            mean = sum(self.iterations) / len(self.iterations)
+            recent = self.iterations[-32:]
+            out["iterations_mean"] = round(mean, 3)
+            # Iteration drift: recent mean minus window mean.  A tier
+            # whose warm starts are going stale shows up here before it
+            # shows up in latency.
+            out["iterations_drift"] = round(
+                sum(recent) / len(recent) - mean, 3
+            )
+        return out
+
+
+class ProvenanceObservatory:
+    """The process singleton (:data:`PROVENANCE`): receipt assembly,
+    the seeded shadow sampler + background verify lane, and the
+    per-(case, tier, precision) drift windows.  Thread-safe; disabled
+    by default at one-attribute-check cost."""
+
+    #: Bounded shadow-lane depth: past this, sampled answers are
+    #: dropped (counted), never queued — the audit must not become a
+    #: memory leak when the fleet outruns the verifier.
+    QUEUE_MAX = 64
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.RLock()
+        self._sampler = _Sampler(0, {"default": 0.0})
+        self.replica = ""
+        #: Served-vs-shadow max |Δv| pu past this is a mismatch.  Loose
+        #: enough that a healthy mixed-precision delta answer (verified
+        #: inline at ~3e-5 in f32) never false-positives; tight enough
+        #: that any real corruption (cache bytes, solver drift) trips.
+        self.mismatch_tol = 1e-4
+        self._journal = obs.JsonlEventJournal()
+        self._q: _queue.Queue = _queue.Queue(maxsize=self.QUEUE_MAX)
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        # (case, backend) -> jitted single-lane full-f64 solver.
+        self._solvers: Dict[Tuple[str, str], object] = {}
+        self._receipts: Dict[str, int] = {}
+        self._shadow: Dict[str, Dict[str, float]] = {}
+        self._drift: Dict[Tuple[str, str, str], _DriftWindow] = {}
+
+    # -- configuration -------------------------------------------------------
+    def configure(self, enabled: Optional[bool] = None,
+                  rate_spec=None,
+                  seed: Optional[int] = None,
+                  log: Optional[str] = None,
+                  replica: Optional[str] = None,
+                  mismatch_tol: Optional[float] = None) -> "ProvenanceObservatory":
+        """Set any subset of the observatory's knobs; omitted ones
+        persist.  ``rate_spec`` is the ``--shadow-verify-rate`` grammar
+        (:func:`parse_rate_spec`); ``log`` opens (append) the receipt
+        JSONL file (``--provenance-log``)."""
+        with self._lock:
+            if rate_spec is not None:
+                spec_seed, rates = parse_rate_spec(rate_spec)
+                self._sampler = _Sampler(
+                    spec_seed if spec_seed is not None
+                    else (seed if seed is not None else self._sampler.seed),
+                    rates,
+                )
+            elif seed is not None:
+                self._sampler = _Sampler(seed, self._sampler.rates)
+            if replica is not None:
+                self.replica = str(replica)
+            if mismatch_tol is not None:
+                self.mismatch_tol = float(mismatch_tol)
+            if log is not None:
+                self._journal.open(log)
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if self.enabled and self._sampler.any_rate():
+                self._start_worker()
+        return self
+
+    def _start_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._stop.clear()
+        self._worker = threading.Thread(
+            target=self._shadow_run, name="shadow-verify", daemon=True
+        )
+        self._worker.start()
+
+    def reset(self) -> None:
+        """Back to the disabled boot state (tests)."""
+        with self._lock:
+            self.enabled = False
+            self._stop.set()
+            worker = self._worker
+        if worker is not None:
+            worker.join(timeout=5.0)
+        with self._lock:
+            self._worker = None
+            self._sampler = _Sampler(0, {"default": 0.0})
+            self.replica = ""
+            self.mismatch_tol = 1e-4
+            self._journal.close()
+            while True:
+                try:
+                    self._q.get_nowait()
+                except _queue.Empty:
+                    break
+            self._idle.set()
+            self._solvers.clear()
+            self._receipts.clear()
+            self._shadow.clear()
+            self._drift.clear()
+
+    # -- receipt assembly (hot path, guarded by `if PROVENANCE.enabled`) -----
+    def stamp(self, resp, *, workload: str, case: str, tier: str,
+              span=None, backend: Optional[str] = None,
+              precision: Optional[str] = None,
+              fallbacks: Optional[int] = None,
+              iterations: Optional[int] = None,
+              residual: Optional[float] = None,
+              warm_source: Optional[str] = None,
+              cache_age_s: Optional[float] = None,
+              info=None, solution=None) -> dict:
+        """Assemble one receipt, attach it to ``resp.provenance``,
+        count/journal/drift-record it, and (pf only) offer the served
+        answer to the shadow sampler.
+
+        ``solution`` is ``(sys, p, q, v, theta)`` host-side arrays for
+        a pf answer — present iff the answer is shadow-verifiable.
+        """
+        receipt = {
+            "tier": tier,
+            "workload": workload,
+            "case": case,
+            "trace_id": getattr(span, "trace_id", None),
+            "replica": self.replica,
+            "pf_backend": backend,
+            "pf_precision": precision,
+            "fallbacks": None if fallbacks is None else int(fallbacks),
+            "iterations": None if iterations is None else int(iterations),
+            "residual_pu": None if residual is None else float(residual),
+            "warm_source": warm_source,
+            "cache_age_s": None if cache_age_s is None
+            else round(float(cache_age_s), 3),
+            "bucket": 0 if info is None else int(info.bucket),
+            "lanes": 1 if info is None else int(info.lanes),
+            "queue_ms": 0.0 if info is None else float(info.queue_ms),
+            "solve_ms": 0.0 if info is None else float(info.solve_ms),
+        }
+        resp.provenance = receipt
+        PROVENANCE_RECEIPTS.labels(tier).inc()
+        with self._lock:
+            self._receipts[tier] = self._receipts.get(tier, 0) + 1
+            key = (case, tier, precision or "")
+            win = self._drift.get(key)
+            if win is None:
+                win = self._drift[key] = _DriftWindow()
+            win.add(residual, iterations, fallbacks)
+        if self._journal.path is not None:
+            self._journal.emit("provenance.receipt", **receipt)
+        if solution is not None and self._sampler.should(tier):
+            self._enqueue_shadow(tier, case, solution, backend, receipt)
+        return receipt
+
+    # -- shadow lane ---------------------------------------------------------
+    def _enqueue_shadow(self, tier, case, solution, backend, receipt):
+        sys_, p, q, v, theta = solution
+        item = _ShadowItem(tier, case, sys_, backend or "auto",
+                           p, q, v, theta, receipt)
+        try:
+            self._q.put_nowait(item)
+            self._idle.clear()
+        except _queue.Full:
+            # Drop, never block: the audit lane must not backpressure
+            # the serving path it is auditing.
+            SHADOW_QUEUE_DROPS.inc()
+
+    def _solver_for(self, case: str, sys_, backend: str):
+        """The shadow oracle for one case: an independently compiled
+        single-lane solver on the full-f64 path (``precision="f64"``,
+        generous iteration budget, flat start) — deliberately NOT the
+        serving engine's program, so it cannot share a miscompile or a
+        donated buffer with the path it audits."""
+        key = (case, backend)
+        solver = self._solvers.get(key)
+        if solver is None:
+            import jax
+
+            from freedm_tpu.pf.newton import make_newton_solver
+
+            solve, _ = make_newton_solver(
+                sys_, max_iter=32, backend=backend, precision="f64"
+            )
+            solver = jax.jit(lambda p, q: solve(p_inj=p, q_inj=q))
+            self._solvers[key] = solver
+        return solver
+
+    def _shadow_run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._q.get(timeout=0.2)
+            except _queue.Empty:
+                self._idle.set()
+                continue
+            try:
+                self._verify(item)
+            except Exception as e:  # noqa: BLE001 — the lane must survive
+                obs.EVENTS.emit("shadow.error", case=item.case,
+                                tier=item.tier, error=repr(e))
+            finally:
+                if self._q.empty():
+                    self._idle.set()
+
+    def _verify(self, item: _ShadowItem) -> None:
+        solver = self._solver_for(item.case, item.sys, item.backend)
+        r = solver(item.p, item.q)
+        v_ref = np.asarray(r.v, np.float64)
+        res_ref = float(np.asarray(r.mismatch, np.float64))
+        dv = float(np.max(np.abs(v_ref - item.v)))
+        trace_id = item.receipt.get("trace_id")
+        SHADOW_VERIFIED.labels(item.tier).inc()
+        SHADOW_MAX_DV.observe(dv, exemplar=trace_id)
+        mismatch = dv > self.mismatch_tol
+        with self._lock:
+            st = self._shadow.setdefault(item.tier, {
+                "verified": 0, "mismatches": 0, "max_dv_pu": 0.0,
+            })
+            st["verified"] += 1
+            st["max_dv_pu"] = round(max(st["max_dv_pu"], dv), 12)
+            if mismatch:
+                st["mismatches"] += 1
+        if mismatch:
+            SHADOW_MISMATCH.labels(item.tier).inc(exemplar=trace_id)
+            # The alarm carries the full receipt: the page names the
+            # tier, case, precision, and trace of the dishonest answer.
+            obs.EVENTS.emit(
+                "shadow.mismatch",
+                tier=item.tier, case=item.case,
+                max_dv_pu=round(dv, 12),
+                shadow_residual_pu=res_ref,
+                tol=self.mismatch_tol,
+                receipt=item.receipt,
+            )
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until the shadow lane is idle (tests/chaos): True if
+        every queued item was verified within the budget."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._q.empty() and self._idle.wait(timeout=0.05):
+                return True
+        return self._q.empty() and self._idle.is_set()
+
+    # -- reporting -----------------------------------------------------------
+    def report(self) -> dict:
+        """The ``GET /provenance`` document: receipts by tier, shadow
+        outcomes by tier, sampler config, and the drift windows."""
+        with self._lock:
+            drift = {
+                "|".join(k): w.summary() for k, w in sorted(self._drift.items())
+            }
+            return {
+                "enabled": self.enabled,
+                "replica": self.replica,
+                "sampler": {
+                    "seed": self._sampler.seed,
+                    "rates": dict(self._sampler.rates),
+                },
+                "mismatch_tol": self.mismatch_tol,
+                "receipts": dict(sorted(self._receipts.items())),
+                "shadow": {
+                    t: dict(st) for t, st in sorted(self._shadow.items())
+                },
+                "shadow_queue_depth": self._q.qsize(),
+                "drift": drift,
+            }
+
+    def stats_block(self) -> dict:
+        """The condensed block ``Service.stats()`` folds into /stats."""
+        with self._lock:
+            verified = sum(
+                int(st["verified"]) for st in self._shadow.values()
+            )
+            mismatches = sum(
+                int(st["mismatches"]) for st in self._shadow.values()
+            )
+            worst = max(
+                (float(st["max_dv_pu"]) for st in self._shadow.values()),
+                default=0.0,
+            )
+            return {
+                "enabled": self.enabled,
+                "receipts": dict(sorted(self._receipts.items())),
+                "shadow_verified": verified,
+                "shadow_mismatches": mismatches,
+                "shadow_max_dv_pu": worst,
+            }
+
+    def receipt_log_json(self, receipt: dict) -> str:
+        """One receipt as its canonical JSONL line (fixed field order —
+        the byte-stability contract the tests pin)."""
+        return json.dumps({k: receipt.get(k) for k in RECEIPT_FIELDS})
+
+
+#: The process-wide observatory, disabled at import.
+PROVENANCE = ProvenanceObservatory()

@@ -26,6 +26,12 @@ against :data:`~freedm_tpu.core.metrics.REGISTRY`:
                           a mixed-precision regression that mass-falls-back
                           whole batches halves throughput without erroring,
                           so it must page like any other breach (0 disables).
+  ``shadow_mismatch_rate``  ``shadow_mismatch_total`` per
+                          ``shadow_verified_total`` (core/provenance.py's
+                          background full-f64 re-solves of served answers) —
+                          silent numerical drift pages like a latency
+                          regression (0 disables;
+                          ``--slo-shadow-mismatch-rate``).
   =====================  =====================================================
 
 - **Fast+slow burn windows** — each ratio objective is evaluated over a
@@ -109,6 +115,11 @@ class SloConfig:
     broker_overrun_rate: float = 0.05
     qsts_floor_steps_per_sec: float = 0.0
     pf_fallback_rate: float = 0.05
+    #: Shadow-verify mismatches per verified answer (0 disables; only
+    #: meaningful with --shadow-verify-rate > 0).  The default budget
+    #: is deliberately tight: ONE mismatch per hundred audited answers
+    #: is already a numerical-honesty incident.
+    shadow_mismatch_rate: float = 0.01
     watchdog_s: float = 20.0
 
 
@@ -162,7 +173,8 @@ class _Sample:
     """One scrape of the raw cumulative values the objectives need."""
 
     __slots__ = ("ts", "ok", "bad", "lat_counts", "overruns", "rounds",
-                 "qsts_rate", "qsts_running", "pf_fallbacks", "pf_iters")
+                 "qsts_rate", "qsts_running", "pf_fallbacks", "pf_iters",
+                 "shadow_verified", "shadow_mismatches")
 
     def __init__(self, ts: float):
         self.ts = ts
@@ -175,6 +187,8 @@ class _Sample:
         self.qsts_running = _gauge("qsts_jobs_running")
         self.pf_fallbacks = _counter_sum("pf_precision_fallbacks_total")
         self.pf_iters = _histogram_sum("pf_newton_iterations")
+        self.shadow_verified = _counter_sum("shadow_verified_total")
+        self.shadow_mismatches = _counter_sum("shadow_mismatch_total")
 
 
 class SloMonitor:
@@ -256,6 +270,7 @@ class SloMonitor:
             ("broker_overruns", self._judge_overruns),
             ("qsts_throughput", self._judge_qsts),
             ("pf_fallback_rate", self._judge_pf_fallbacks),
+            ("shadow_mismatch_rate", self._judge_shadow_mismatch),
         ):
             v = judge(samples, t)
             if v is not None:
@@ -454,6 +469,34 @@ class SloMonitor:
             target, round(burn_fast, 3), round(burn_slow, 3),
         )
 
+    def _judge_shadow_mismatch(self, samples, now) -> Optional[dict]:
+        cfg = self.config
+        target = cfg.shadow_mismatch_rate
+        if target <= 0:
+            return None
+
+        def rate(span):
+            win = self._window(samples, now, span)
+            if win is None:
+                return None
+            a, b = win
+            verified = b.shadow_verified - a.shadow_verified
+            if verified <= 0:
+                return None  # no shadow re-solves in the window
+            return (b.shadow_mismatches - a.shadow_mismatches) / verified
+
+        fast = rate(cfg.fast_window_s)
+        slow = rate(cfg.slow_window_s)
+        if fast is None and not self._state.get("shadow_mismatch_rate"):
+            return None
+        burn_fast = 0.0 if fast is None else fast / target
+        burn_slow = burn_fast if slow is None else slow / target
+        return self._burn_verdict(
+            "shadow_mismatch_rate",
+            None if fast is None else round(fast, 4),
+            target, round(burn_fast, 3), round(burn_slow, 3),
+        )
+
     # -- transitions ---------------------------------------------------------
     def _transition(self, name: str, verdict: dict) -> None:
         breached = bool(verdict["breached"])
@@ -510,6 +553,8 @@ class SloMonitor:
                     "qsts_floor_steps_per_sec":
                         self.config.qsts_floor_steps_per_sec,
                     "pf_fallback_rate": self.config.pf_fallback_rate,
+                    "shadow_mismatch_rate":
+                        self.config.shadow_mismatch_rate,
                     "watchdog_s": self.config.watchdog_s,
                 },
                 "objectives": dict(self._last),

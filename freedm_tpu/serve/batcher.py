@@ -622,7 +622,12 @@ class MicroBatcher:
                     # wall on both the pipelined and legacy paths.
                     jax.block_until_ready(results)
                 solve_s = time.monotonic() - t0
-                obs.SERVE_SOLVE_LATENCY.labels(workload).observe(solve_s)
+                # Exemplared with the batch span's trace: a p99 solve
+                # bucket on /metrics links straight to its trace tree
+                # (None while tracing is off = no exemplar recorded).
+                obs.SERVE_SOLVE_LATENCY.labels(workload).observe(
+                    solve_s, exemplar=work.span.trace_id
+                )
 
                 from freedm_tpu.serve.service import BatchInfo
 

@@ -579,6 +579,16 @@ class ServeCache:
         tol = self.verify_tol if self.verify_tol is not None else entry.tol
         if err > tol:
             return None  # fall through to the warm tier — never served
+        if self.verify_tol is not None and err > entry.tol:
+            # The OVERRIDDEN bar accepted what the engine bar would have
+            # rejected (the chaos negative-proof configuration): journal
+            # it, so a loosened verify is never silent — the shadow
+            # verifier (core/provenance.py) is now the only gate left.
+            obs.EVENTS.emit(
+                "serve.cache.loose_accept",
+                case=entry.case, residual_pu=float(err),
+                engine_tol=float(entry.tol), verify_tol=float(tol),
+            )
         return {
             "theta": theta, "v": v, "p": p_calc, "q": q_calc,
             "iterations": int(sweeps), "mismatch": err, "converged": True,

@@ -101,6 +101,23 @@ def read_request_body(handler, max_bytes: int = MAX_BODY_BYTES) -> bytes:
     return handler.rfile.read(length) if length else b""
 
 
+def trace_parent_ctx(headers):
+    """Adopt the router's propagated ``X-Trace-Id``/``X-Span-Id``
+    headers as a wire span context (``tracing.Tracer.start``'s
+    ``parent_ctx``), so a replica's ``serve.request`` span parents
+    under the router's ``serve.route`` span in ONE cross-process tree
+    — the fleet-valid trace_id provenance receipts carry.  ``None``
+    when the request arrived untraced."""
+    trace_id = headers.get("X-Trace-Id")
+    if not trace_id:
+        return None
+    ctx = {"trace_id": str(trace_id)}
+    span_id = headers.get("X-Span-Id")
+    if span_id:
+        ctx["span_id"] = str(span_id)
+    return ctx
+
+
 def apply_deadline_budget(payload, header_value) -> None:
     """Clamp a workload payload's ``timeout_s`` to the router's
     propagated ``X-Deadline-Budget-S`` budget (in place).  A request
@@ -217,6 +234,14 @@ class ServeServer(BackgroundHttpServer):
                             200, REGISTRY.render_prometheus(),
                             "text/plain; version=0.0.4; charset=utf-8",
                         )
+                    elif path == "/provenance":
+                        # The drift observatory document (receipts by
+                        # tier, shadow outcomes, drift windows) on the
+                        # serve port, so the router/soak can scrape it
+                        # per replica without a second port.
+                        from freedm_tpu.core.provenance import PROVENANCE
+
+                        self._reply(200, PROVENANCE.report())
                     elif path.startswith("/v1/jobs/"):
                         job_id = path[len("/v1/jobs/"):]
                         self._reply(200, self._jobs().get(job_id))
@@ -227,7 +252,7 @@ class ServeServer(BackgroundHttpServer):
                             + ["/v1/qsts", "/v1/topo/sweep",
                                "/v1/jobs/<id>/cancel"],
                             "get": ["/healthz", "/stats", "/metrics",
-                                    "/v1/jobs/<id>"],
+                                    "/provenance", "/v1/jobs/<id>"],
                         })
                     else:
                         self._reply(404, {"error": {"type": "not_found",
@@ -282,7 +307,10 @@ class ServeServer(BackgroundHttpServer):
                     apply_deadline_budget(
                         payload, self.headers.get("X-Deadline-Budget-S")
                     )
-                    response = svc.request(workload, payload)
+                    response = svc.request(
+                        workload, payload,
+                        parent_ctx=trace_parent_ctx(self.headers),
+                    )
                     self._reply(200, response.to_dict())
                 except ServeError as e:
                     self._error(e)

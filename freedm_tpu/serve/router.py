@@ -164,20 +164,29 @@ def _relabel_exposition(text: str, replica: str,
                 seen_meta.add(key)
             out.append(line)
             continue
+        # Split an OpenMetrics exemplar suffix (` # {trace_id="..."} v`,
+        # core/metrics.py) off FIRST: its braces must not be mistaken
+        # for the sample's label set by the rfind below.
+        exemplar = ""
+        cut = line.find(" # {")
+        if cut != -1:
+            line, exemplar = line[:cut], line[cut:]
         brace = line.find("{")
         space = line.find(" ")
         if brace != -1 and (space == -1 or brace < space):
             close = line.rfind("}")
             inner = line[brace + 1:close]
             if 'replica="' in inner:
-                out.append(line)
+                out.append(line + exemplar)
                 continue
             inner = "{},{}".format(inner, label) if inner else label
-            out.append(line[:brace + 1] + inner + "}" + line[close + 1:])
+            out.append(line[:brace + 1] + inner + "}" + line[close + 1:]
+                       + exemplar)
         elif space != -1:
-            out.append("{}{{{}}}{}".format(line[:space], label, line[space:]))
+            out.append("{}{{{}}}{}{}".format(line[:space], label,
+                                             line[space:], exemplar))
         else:
-            out.append(line)
+            out.append(line + exemplar)
     return out
 
 
@@ -623,7 +632,8 @@ class Router:
                 obs.ROUTER_RETRIES.inc()
             if failover:
                 obs.ROUTER_FAILOVERS.inc()
-            ok, reply = self._forward_once(st, path, body, remaining)
+            ok, reply = self._forward_once(st, path, body, remaining,
+                                           trace_ctx=span.context())
             if ok:
                 return reply, st.id, attempt, _outcome_of(reply)
             last_err = reply  # a ServeError on the failure path
@@ -645,7 +655,7 @@ class Router:
                 time.sleep(back)
 
     def _forward_once(self, st: ReplicaState, path: str, body: bytes,
-                      remaining: float):
+                      remaining: float, trace_ctx=None):
         """One proxy attempt.  Returns ``(True, _ProxyReply)`` on an
         answer the client should see, or ``(False, ServeError)`` on a
         failure the retry loop handles (``_Overloaded`` = fail over
@@ -653,6 +663,20 @@ class Router:
         cfg = self.config
         per_try = remaining if cfg.try_timeout_s is None \
             else min(remaining, cfg.try_timeout_s)
+        headers = {
+            "Content-Type": "application/json",
+            # The deadline budget rides the wire: the replica clamps
+            # its own queue deadline to it, so a retried request
+            # cannot straddle budgets.
+            "X-Deadline-Budget-S": f"{remaining:.3f}",
+        }
+        if trace_ctx:
+            # Trace context rides the wire too (serve/http.py adopts
+            # it): the replica's serve.request span parents under this
+            # route span in one cross-process tree, and the replica's
+            # provenance receipt carries the router-valid trace_id.
+            headers["X-Trace-Id"] = trace_ctx["trace_id"]
+            headers["X-Span-Id"] = trace_ctx["span_id"]
         t0 = time.monotonic()
         try:
             conn = http.client.HTTPConnection(
@@ -660,16 +684,7 @@ class Router:
                 timeout=max(min(per_try, 1e6), 0.001),
             )
             try:
-                conn.request(
-                    "POST", path, body=body,
-                    headers={
-                        "Content-Type": "application/json",
-                        # The deadline budget rides the wire: the
-                        # replica clamps its own queue deadline to it,
-                        # so a retried request cannot straddle budgets.
-                        "X-Deadline-Budget-S": f"{remaining:.3f}",
-                    },
-                )
+                conn.request("POST", path, body=body, headers=headers)
                 resp = conn.getresponse()
                 payload = resp.read()
                 status = resp.status

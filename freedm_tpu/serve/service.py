@@ -45,6 +45,7 @@ import numpy as np
 
 from freedm_tpu.core import metrics as obs
 from freedm_tpu.core import profiling
+from freedm_tpu.core import provenance as _prov
 from freedm_tpu.core import tracing
 from freedm_tpu.serve.queue import (
     AdmissionQueue,
@@ -180,10 +181,16 @@ class PowerFlowResponse:
     batch: BatchInfo
     v: Optional[List[float]] = None  # per-bus |V| (return_state=True)
     theta: Optional[List[float]] = None  # per-bus angle, rad
+    # Provenance receipt (core/provenance.py) — attached only when the
+    # observatory is enabled, so disabled-mode responses are
+    # byte-identical to before.
+    provenance: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["batch"] = self.batch.to_dict()
+        if self.provenance is None:
+            d.pop("provenance")
         return d
 
 
@@ -199,10 +206,13 @@ class N1Response:
     worst_residual_pu: float
     all_converged: bool
     batch: BatchInfo
+    provenance: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["batch"] = self.batch.to_dict()
+        if self.provenance is None:
+            d.pop("provenance")
         return d
 
 
@@ -219,10 +229,13 @@ class VVCResponse:
     v_max_pu: float
     band_violations: int  # live node-phases outside V_BAND
     batch: BatchInfo
+    provenance: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["batch"] = self.batch.to_dict()
+        if self.provenance is None:
+            d.pop("provenance")
         return d
 
 
@@ -247,10 +260,13 @@ class TopoResponse:
     shortlist: List[dict]  # open_branches/objective/ac stamps per entry
     all_verified: bool  # every shortlist entry's AC lane converged
     batch: BatchInfo
+    provenance: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["batch"] = self.batch.to_dict()
+        if self.provenance is None:
+            d.pop("provenance")
         return d
 
 
@@ -342,6 +358,12 @@ class _Engine:
         self.case = case
         self.key = (self.workload, case)
         self.compiled_buckets: set = set()
+        # Resolved solver identity for provenance receipts: the pf/n1
+        # engines overwrite these with the dense/sparse + f64/mixed
+        # resolution their compiled programs actually run; workloads
+        # with no Jacobian/Krylov inner (vvc, topo) keep None.
+        self.pf_backend: Optional[str] = None
+        self.pf_precision: Optional[str] = None
 
     def validate(self, req):  # -> prepared payload (host arrays)
         raise NotImplementedError
@@ -383,10 +405,17 @@ class PowerFlowEngine(_Engine):
         import jax
 
         from freedm_tpu.grid.bus import PQ
+        from freedm_tpu.pf.krylov import resolve_precision
         from freedm_tpu.pf.newton import make_newton_solver
+        from freedm_tpu.pf.sparse import resolve_backend
 
         sys_ = _resolve_bus_case(case)
         self._sys = sys_  # the serving cache keys its entry off this
+        self.pf_backend = resolve_backend(backend, sys_.n_bus)
+        self.pf_precision = (
+            resolve_precision(precision)
+            if self.pf_backend == "sparse" else "f64"
+        )
         # Incremental-tier attach points, set by Service.engine() when a
         # cache is configured: `publish` is the Service-bound callback
         # scatter feeds converged solutions (and flight settles) into;
@@ -501,9 +530,12 @@ class PowerFlowEngine(_Engine):
         q_bal = q.sum(axis=1)
         v_min = v.min(axis=1)
         v_max = v.max(axis=1)
+        fb = getattr(r, "fallbacks", None)
+        if fb is not None:
+            fb = np.asarray(fb)
         for i, t in enumerate(group):
             want_state = bool(t.request.return_state)
-            t.future.set_result(PowerFlowResponse(
+            resp = PowerFlowResponse(
                 workload="pf",
                 case=self.case,
                 scale=float(t.request.scale),
@@ -517,7 +549,23 @@ class PowerFlowEngine(_Engine):
                 v=np.round(v[i], 9).tolist() if want_state else None,
                 theta=np.round(theta[i], 9).tolist() if want_state else None,
                 batch=info,
-            ))
+            )
+            if _prov.PROVENANCE.enabled:
+                warm_src = t.prepared.get("warm_src")
+                _prov.PROVENANCE.stamp(
+                    resp, workload="pf", case=self.case,
+                    tier="warm" if warm_src else info.tier,
+                    span=t.span, backend=self.pf_backend,
+                    precision=self.pf_precision,
+                    fallbacks=None if fb is None else int(fb[i]),
+                    iterations=int(its[i]),
+                    residual=float(mism[i]),
+                    warm_source=warm_src,
+                    info=info,
+                    solution=(self._sys, t.prepared["p"],
+                              t.prepared["q"], v[i], theta[i]),
+                )
+            t.future.set_result(resp)
         if self.publish is not None:
             # Incremental tier: insert converged lanes into the serving
             # cache and settle any single-flight followers parked on
@@ -534,9 +582,16 @@ class N1Engine(_Engine):
     def __init__(self, case: str, max_iter: int = 24, mesh=None,
                  backend: str = "auto", precision: str = "auto"):
         super().__init__(case)
+        from freedm_tpu.pf.krylov import resolve_precision
         from freedm_tpu.pf.n1 import make_n1_screen, secure_outages
+        from freedm_tpu.pf.sparse import resolve_backend
 
         sys_ = _resolve_bus_case(case)
+        self.pf_backend = resolve_backend(backend, sys_.n_bus)
+        self.pf_precision = (
+            resolve_precision(precision)
+            if self.pf_backend == "sparse" else "f64"
+        )
         self.n_branch = sys_.n_branch
         self._secure = sorted(secure_outages(sys_))
         self._secure_set = frozenset(self._secure)
@@ -598,7 +653,7 @@ class N1Engine(_Engine):
             sl = slice(off, off + k)
             off += k
             res = mism[sl].astype(np.float64).tolist()
-            t.future.set_result(N1Response(
+            resp = N1Response(
                 workload="n1",
                 case=self.case,
                 outages=t.prepared["ks"].tolist(),
@@ -609,7 +664,15 @@ class N1Engine(_Engine):
                 worst_residual_pu=max(res),
                 all_converged=bool(conv[sl].all()),
                 batch=info,
-            ))
+            )
+            if _prov.PROVENANCE.enabled:
+                _prov.PROVENANCE.stamp(
+                    resp, workload="n1", case=self.case, tier=info.tier,
+                    span=t.span, backend=self.pf_backend,
+                    precision=self.pf_precision,
+                    residual=max(res), info=info,
+                )
+            t.future.set_result(resp)
 
 
 class VVCEngine(_Engine):
@@ -708,7 +771,7 @@ class VVCEngine(_Engine):
             (vm_live < V_BAND[0]) | (vm_live > V_BAND[1]), axis=1
         )
         for i, t in enumerate(group):
-            t.future.set_result(VVCResponse(
+            resp = VVCResponse(
                 workload="vvc",
                 case=self.case,
                 converged=bool(conv[i]),
@@ -720,7 +783,13 @@ class VVCEngine(_Engine):
                 v_max_pu=float(v_max[i]),
                 band_violations=int(viols[i]),
                 batch=info,
-            ))
+            )
+            if _prov.PROVENANCE.enabled:
+                _prov.PROVENANCE.stamp(
+                    resp, workload="vvc", case=self.case, tier=info.tier,
+                    span=t.span, residual=float(residual[i]), info=info,
+                )
+            t.future.set_result(resp)
 
 
 class TopoEngine(_Engine):
@@ -1009,7 +1078,7 @@ class TopoEngine(_Engine):
                 })
             n_variants = int(nv)
             obs.TOPO_VARIANTS.inc(n_variants)
-            t.future.set_result(TopoResponse(
+            resp = TopoResponse(
                 workload="topo",
                 case=self.case,
                 mode=t.prepared["mode"],
@@ -1025,7 +1094,16 @@ class TopoEngine(_Engine):
                     all(e["ac_converged"] for e in shortlist)
                 ) if shortlist else False,
                 batch=info,
-            ))
+            )
+            if _prov.PROVENANCE.enabled:
+                worst_ac = max(
+                    (e["ac_residual_pu"] for e in shortlist), default=None
+                )
+                _prov.PROVENANCE.stamp(
+                    resp, workload="topo", case=self.case, tier=info.tier,
+                    span=t.span, residual=worst_ac, info=info,
+                )
+            t.future.set_result(resp)
 
 
 _ENGINE_TYPES = {
@@ -1194,6 +1272,13 @@ class ServeConfig(NamedTuple):
     cache_mb: float = 64.0
     cache_ttl_s: float = 600.0
     delta_max_rank: int = 16
+    # Delta-tier inline verify override (None = the engine tolerance).
+    # Exists for the chaos negative proof (tools/chaos.py
+    # --shadow-negative): LOOSENING it deliberately bypasses the inline
+    # residual gate so the shadow verifier (core/provenance.py) must be
+    # the layer that catches a corrupted answer.  Never loosen it in
+    # production service of real queries.
+    cache_verify_tol: Optional[float] = None
     # Topology sweeps (serve workload "topo" + the async sweep jobs;
     # CLI: --topo-max-rank / --topo-max-variants / --topo-top-k):
     # simultaneous-flip cap per variant, per-request variant ceiling
@@ -1267,6 +1352,7 @@ class Service:
                 ttl_s=config.cache_ttl_s,
                 delta_max_rank=config.delta_max_rank,
                 precision=config.pf_precision,
+                verify_tol=config.cache_verify_tol,
             )
         self._engines: Dict[Tuple[str, str], _Engine] = {}
         # Global lock guards the maps only; SLOW engine construction
@@ -1426,12 +1512,17 @@ class Service:
         return done
 
     # -- submission ----------------------------------------------------------
-    def submit(self, workload: str, request):
+    def submit(self, workload: str, request, parent_ctx=None):
         """Validate and admit one request; returns its Future.
 
         ``request`` may be a typed record or a JSON-shaped dict.  Raises
         :class:`InvalidRequest` / :class:`Overloaded` synchronously —
         an unservable request never occupies queue depth.
+        ``parent_ctx`` is an optional wire-propagated span context
+        (``{"trace_id", "span_id"}`` — what serve/http.py builds from
+        the router's ``X-Trace-Id``/``X-Span-Id`` headers), so the
+        replica's ``serve.request`` span parents under the router's
+        ``serve.route`` span in one cross-process tree.
         """
         # Clamp the metric label to the known vocabulary: a typo'd or
         # hostile workload string must not mint unbounded label series.
@@ -1460,7 +1551,7 @@ class Service:
         if timeout <= 0:
             timeout = self.config.default_timeout_s
         span = tracing.TRACER.start(
-            "serve.request", kind="serve",
+            "serve.request", kind="serve", parent_ctx=parent_ctx,
             tags={"workload": workload, "case": request.case, "lanes": lanes},
         )
         ticket = Ticket(
@@ -1507,7 +1598,8 @@ class Service:
             raise
         return ticket.future
 
-    def request(self, workload: str, request, timeout_s: Optional[float] = None):
+    def request(self, workload: str, request,
+                timeout_s: Optional[float] = None, parent_ctx=None):
         """Blocking submit: the typed response, or a raised ServeError.
 
         The wait honors the REQUEST's own ``timeout_s`` (plus a margin
@@ -1527,7 +1619,7 @@ class Service:
                 raise
         if timeout_s is not None and hasattr(request, "timeout_s"):
             request = dataclasses.replace(request, timeout_s=float(timeout_s))
-        fut = self.submit(workload, request)
+        fut = self.submit(workload, request, parent_ctx=parent_ctx)
         t = float(getattr(request, "timeout_s", 0) or 0)
         if t <= 0:
             t = self.config.default_timeout_s
@@ -1607,6 +1699,9 @@ class Service:
             # the cache for steered requests.
             prepared["v0"] = near.v
             prepared["th0"] = near.theta
+            # Receipt seam: the scatter path reads this to classify the
+            # dispatched solve as warm-tier and name its seed solution.
+            prepared["warm_src"] = near.digest
             cache.record("warm")
             ticket.span.tag(cache_tier="warm")
         else:
@@ -1622,6 +1717,18 @@ class Service:
         info = BatchInfo(lanes=1, bucket=0, queue_ms=0.0,
                          solve_ms=solve_ms, tier=tier)
         resp = _response_from_solution(eng, ticket.request, sol, info)
+        if _prov.PROVENANCE.enabled:
+            _prov.PROVENANCE.stamp(
+                resp, workload="pf", case=eng.case, tier=tier,
+                span=ticket.span, backend=eng.pf_backend,
+                precision=eng.pf_precision,
+                iterations=int(sol.iterations),
+                residual=float(sol.mismatch),
+                cache_age_s=_time.monotonic() - sol.stamp,
+                info=info,
+                solution=(eng._sys, sol.p_inj, sol.q_inj, sol.v,
+                          sol.theta),
+            )
         ticket.span.tag(cache_tier=tier)
         ticket.future.set_result(resp)
         self._complete_ok(ticket, info)
@@ -1689,9 +1796,22 @@ class Service:
                               solve_ms=0.0, tier="exact")
             for f in followers:
                 try:
-                    f.future.set_result(
-                        _response_from_solution(eng, f.request, sol, finfo)
-                    )
+                    fresp = _response_from_solution(eng, f.request, sol,
+                                                    finfo)
+                    if _prov.PROVENANCE.enabled:
+                        _prov.PROVENANCE.stamp(
+                            fresp, workload="pf", case=eng.case,
+                            tier="exact", span=f.span,
+                            backend=eng.pf_backend,
+                            precision=eng.pf_precision,
+                            iterations=int(sol.iterations),
+                            residual=float(sol.mismatch),
+                            cache_age_s=_time.monotonic() - sol.stamp,
+                            info=finfo,
+                            solution=(eng._sys, sol.p_inj, sol.q_inj,
+                                      sol.v, sol.theta),
+                        )
+                    f.future.set_result(fresp)
                     self._complete_ok(f, finfo)
                 except Exception as e:  # noqa: BLE001 — never hang the rest
                     self._complete_error(f, e)
@@ -1720,8 +1840,11 @@ class Service:
 
     def _complete_ok(self, ticket: Ticket, info: BatchInfo) -> None:
         self._ok_counters[ticket.key[0]].inc()
+        # The exemplar links a latency bucket straight to its trace
+        # (NOOP.trace_id is None = no exemplar recorded).
         obs.SERVE_REQUEST_LATENCY.observe(
-            max(_time.monotonic() - ticket.enqueued_at, 0.0)
+            max(_time.monotonic() - ticket.enqueued_at, 0.0),
+            exemplar=ticket.span.trace_id,
         )
         span = ticket.span
         if span is not tracing.NOOP:
@@ -1803,6 +1926,10 @@ class Service:
                 {"enabled": True, **self.cache.stats()}
                 if self.cache is not None else {"enabled": False}
             ),
+            # Numerical-honesty observatory: receipt counts by tier +
+            # shadow-verify outcomes (core/provenance.py; full document
+            # at GET /provenance).
+            "provenance": _prov.PROVENANCE.stats_block(),
             "batch_lanes": metric("serve_batch_lanes"),
             "queue_wait_seconds": metric("serve_queue_wait_seconds"),
             "solve_seconds": metric("serve_solve_seconds"),

@@ -1,0 +1,252 @@
+"""Per-request audit trails: receipts x traces x events, joined on trace_id.
+
+The provenance observatory (:mod:`freedm_tpu.core.provenance`) leaves
+three JSONL streams behind a serving run:
+
+- **receipts** (``--provenance-log``): one ``provenance.receipt`` record
+  per served answer — tier, backend/precision, iterations, residual,
+  warm-start source, cache age;
+- **traces** (``--trace-log``, per process): the span records, including
+  the router's ``serve.route`` span and the replica's ``serve.request``
+  span stitched by the wire-propagated context;
+- **events** (``--events-log``): the discrete journal —
+  ``shadow.mismatch`` records (each carrying the full receipt of the
+  answer it indicts), ``serve.cache.loose_accept``, breaker flips, SLO
+  breaches.
+
+Each stream answers a different question; none alone answers *"what
+exactly happened to request X?"*.  This tool joins all three on
+``trace_id`` into one audit trail per request: the receipt that was
+served, the cross-process span tree that produced it, and every journal
+event that mentions it — so a ``shadow.mismatch`` alert resolves to
+the offending request's full story in one command::
+
+    python -m freedm_tpu.tools.audit_report \\
+        --receipts receipts.jsonl --trace trace_*.jsonl \\
+        --events events.jsonl
+    python -m freedm_tpu.tools.audit_report --receipts r.jsonl \\
+        --trace t.jsonl --only-flagged --json audit.json
+
+Streams are optional: with only receipts, the report is a tier/latency
+roll-up; adding traces attaches span trees; adding events attaches
+mismatches.  Unjoinable records (a receipt stamped while tracing was
+off has ``trace_id: null``) are counted, never dropped silently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+#: Journal events that indict a request (the audit flags these).
+_FLAG_EVENTS = ("shadow.mismatch", "serve.cache.loose_accept")
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    """Tolerant JSONL reader: a killed process can truncate its last
+    line mid-write, so unparseable lines are skipped, not fatal."""
+    out: List[dict] = []
+    for line in Path(path).read_text(encoding="utf-8").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def load_receipts(paths: Sequence[str]) -> List[dict]:
+    """Receipt records from provenance logs.  Accepts both the journal
+    form (``event: provenance.receipt``) and bare receipt lines (the
+    ``receipt_log_json`` canonical form used by tests)."""
+    out: List[dict] = []
+    for path in paths:
+        for rec in _read_jsonl(path):
+            if rec.get("event") == "provenance.receipt":
+                out.append(rec)
+            elif "event" not in rec and "tier" in rec and "workload" in rec:
+                out.append(rec)
+    return out
+
+
+def load_events(paths: Sequence[str]) -> List[dict]:
+    """Journal events, excluding the receipt records themselves (those
+    are the left side of the join, not annotations on it)."""
+    out: List[dict] = []
+    for path in paths:
+        for rec in _read_jsonl(path):
+            if rec.get("event") and rec["event"] != "provenance.receipt":
+                out.append(rec)
+    return out
+
+
+def _event_trace_id(event: dict) -> Optional[str]:
+    """An event mentions a request either directly (``trace_id``) or
+    through the receipt it carries (``shadow.mismatch``)."""
+    tid = event.get("trace_id")
+    if tid:
+        return str(tid)
+    receipt = event.get("receipt")
+    if isinstance(receipt, dict) and receipt.get("trace_id"):
+        return str(receipt["trace_id"])
+    return None
+
+
+def _span_summary(trace: dict) -> dict:
+    """Condense one merged trace (trace_report's build_traces shape)
+    into the audit row: tree depth, node list, the root chain."""
+    spans = trace["spans"]
+    return {
+        "spans": len(spans),
+        "nodes": sorted({s.get("node", "") for s in spans}),
+        "roots": [s["name"] for s in trace["roots"]],
+        "duration_ms": round((trace["t1"] - trace["t0"]) * 1e3, 3),
+        "tree": [
+            {
+                "name": s["name"],
+                "kind": s.get("kind", ""),
+                "node": s.get("node", ""),
+                "dur_ms": round((s["t1"] - s["t0"]) * 1e3, 3),
+                "parent_id": s.get("parent_id"),
+            }
+            for s in spans
+        ],
+    }
+
+
+def build_audit(
+    receipt_paths: Sequence[str],
+    trace_paths: Sequence[str] = (),
+    event_paths: Sequence[str] = (),
+) -> dict:
+    """The join: one trail per receipt-bearing trace_id."""
+    receipts = load_receipts(receipt_paths)
+    events = load_events(event_paths)
+
+    traces: Dict[str, dict] = {}
+    if trace_paths:
+        from freedm_tpu.tools import trace_report
+
+        spans, clocks = trace_report.load_records(trace_paths)
+        trace_report.correct_timestamps(spans, clocks)
+        traces = trace_report.build_traces(spans)
+
+    events_by_tid: Dict[str, List[dict]] = {}
+    for e in events:
+        tid = _event_trace_id(e)
+        if tid is not None:
+            events_by_tid.setdefault(tid, []).append(e)
+
+    trails: Dict[str, dict] = {}
+    untraced = 0
+    for r in receipts:
+        tid = r.get("trace_id")
+        if not tid:
+            untraced += 1
+            continue
+        trail = trails.setdefault(
+            str(tid), {"receipts": [], "trace": None, "events": [],
+                       "flagged": False},
+        )
+        trail["receipts"].append(r)
+    for tid, trail in trails.items():
+        if tid in traces:
+            trail["trace"] = _span_summary(traces[tid])
+        trail["events"] = events_by_tid.get(tid, [])
+        trail["flagged"] = any(
+            e.get("event") in _FLAG_EVENTS for e in trail["events"]
+        )
+
+    tiers: Dict[str, int] = {}
+    for r in receipts:
+        tiers[r.get("tier", "?")] = tiers.get(r.get("tier", "?"), 0) + 1
+    return {
+        "receipts": len(receipts),
+        "receipts_by_tier": dict(sorted(tiers.items())),
+        "receipts_without_trace_id": untraced,
+        "trails": trails,
+        "flagged": sorted(
+            tid for tid, t in trails.items() if t["flagged"]
+        ),
+        "events_unjoined": sum(
+            1 for e in events if _event_trace_id(e) is None
+        ),
+    }
+
+
+def render_text(audit: dict, only_flagged: bool = False) -> str:
+    out: List[str] = []
+    out.append(
+        f"audit: {audit['receipts']} receipts "
+        f"({audit['receipts_by_tier']}), "
+        f"{len(audit['trails'])} joinable trails, "
+        f"{len(audit['flagged'])} flagged"
+    )
+    if audit["receipts_without_trace_id"]:
+        out.append(
+            f"  {audit['receipts_without_trace_id']} receipts carry no "
+            "trace_id (tracing was off when they were stamped)"
+        )
+    for tid, trail in sorted(audit["trails"].items()):
+        if only_flagged and not trail["flagged"]:
+            continue
+        r = trail["receipts"][-1]
+        flag = "  ** FLAGGED **" if trail["flagged"] else ""
+        out.append(
+            f"\ntrace {tid}{flag}\n"
+            f"  receipt: tier={r.get('tier')} case={r.get('case')} "
+            f"backend={r.get('pf_backend')}/{r.get('pf_precision')} "
+            f"iters={r.get('iterations')} residual={r.get('residual_pu')} "
+            f"solve={r.get('solve_ms')}ms"
+        )
+        if r.get("warm_source"):
+            out.append(f"  warm-start source: {r['warm_source']}")
+        tr = trail["trace"]
+        if tr is not None:
+            out.append(
+                f"  trace: {tr['spans']} spans over "
+                f"{','.join(tr['nodes'])} roots={tr['roots']} "
+                f"({tr['duration_ms']}ms)"
+            )
+        for e in trail["events"]:
+            detail = ""
+            if e.get("event") == "shadow.mismatch":
+                detail = (f" max_dv_pu={e.get('max_dv_pu')} "
+                          f"tol={e.get('tol')}")
+            out.append(f"  event: {e.get('event')}{detail}")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Join receipts + traces + journal events into "
+        "per-request audit trails"
+    )
+    ap.add_argument("--receipts", nargs="+", required=True, metavar="PATH",
+                    help="provenance receipt JSONL file(s)")
+    ap.add_argument("--trace", nargs="*", default=[], metavar="PATH",
+                    help="trace JSONL file(s) — router + replicas")
+    ap.add_argument("--events", nargs="*", default=[], metavar="PATH",
+                    help="event journal JSONL file(s)")
+    ap.add_argument("--only-flagged", action="store_true",
+                    help="render only trails with an indicting event")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the full JSON artifact here")
+    args = ap.parse_args(argv)
+    audit = build_audit(args.receipts, args.trace, args.events)
+    print(render_text(audit, only_flagged=args.only_flagged))
+    if args.json:
+        Path(args.json).write_text(json.dumps(audit, indent=2))
+    # Exit 1 when any trail is flagged: the tool doubles as a gate.
+    return 1 if audit["flagged"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,16 @@ process that prints ``{"replica_port": N}`` and drains gracefully on
 SIGTERM (stops admitting, finishes in-flight, exits 0).
 ``tools/soak.py --chaos`` folds this rig's artifact into the soak
 artifact.  Exit code 0 iff every check passed.
+
+Every replica also runs the **shadow verifier**
+(:mod:`freedm_tpu.core.provenance`) at rate 1.0 on the cache tiers, and
+the rig gates on **zero shadow mismatches**: a chaos run that passes
+the robustness checks but serves even one numerically-wrong answer
+fails.  ``--shadow-negative`` runs the inverse proof — inject
+``serve.cache.corrupt`` with the inline residual verify loosened
+(``ServeConfig.cache_verify_tol``), and assert the shadow lane CATCHES
+the corrupt answer the inline check no longer can.  A verifier that
+cannot fail a corrupted fleet proves nothing about a clean one.
 """
 
 from __future__ import annotations
@@ -58,12 +68,18 @@ LOAD_CASES = ("case14", "case_ieee30", "mesh20", "mesh24", "mesh28")
 
 
 def run_replica(fault_spec: Optional[str] = None,
-                prewarm: str = "pf/case14") -> int:
+                prewarm: str = "pf/case14",
+                shadow_rate: Optional[str] = None) -> int:
     from freedm_tpu.core.faults import FAULTS
     from freedm_tpu.serve import ServeConfig, ServeServer, Service
 
     if fault_spec:
         FAULTS.configure(fault_spec)
+    if shadow_rate:
+        from freedm_tpu.core.provenance import PROVENANCE
+
+        PROVENANCE.configure(enabled=True, rate_spec=shadow_rate,
+                             replica=f"chaos-{os.getpid()}")
     svc = Service(ServeConfig(
         max_batch=16, queue_depth=256,
         prewarm=(prewarm,) if prewarm else (),
@@ -108,12 +124,14 @@ class _Check:
 
 
 class _Replica:
-    def __init__(self, index: int, fault_spec: Optional[str], env: dict):
+    def __init__(self, index: int, fault_spec: Optional[str], env: dict,
+                 shadow_rate: Optional[str] = None):
         self.index = index
         self.fault_spec = fault_spec
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "freedm_tpu.tools.chaos", "--replica"]
-            + (["--fault-spec", fault_spec] if fault_spec else []),
+            + (["--fault-spec", fault_spec] if fault_spec else [])
+            + (["--shadow-rate", shadow_rate] if shadow_rate else []),
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             env=env, text=True,
         )
@@ -255,6 +273,24 @@ def _cache_counts(replicas: List[_Replica]) -> Dict[str, float]:
     return out
 
 
+def _shadow_counts(replicas: List[_Replica]) -> Dict[str, float]:
+    """Summed provenance/shadow counters over the LIVE replicas' /stats
+    blocks — the fleet-wide numerical-honesty window.  (The killed
+    victim's counters die with it; a mismatch it had flagged before the
+    kill is invisible here, which is why the soak ALSO gates per-slice.)
+    """
+    out = {"receipts": 0.0, "verified": 0.0, "mismatches": 0.0}
+    for rep in replicas:
+        if not rep.alive() or rep.port is None:
+            continue
+        prov = _get_json(rep.port, "/stats").get("provenance") or {}
+        receipts = prov.get("receipts") or {}  # per-tier dict
+        out["receipts"] += sum(float(v) for v in receipts.values())
+        out["verified"] += float(prov.get("shadow_verified", 0) or 0)
+        out["mismatches"] += float(prov.get("shadow_mismatches", 0) or 0)
+    return out
+
+
 def _post_pf(router_port: int, case: str, timeout_s: float = 90.0) -> bool:
     import urllib.error
     import urllib.request
@@ -320,11 +356,18 @@ def run_chaos(n_replicas: int = 3, load_s: float = 6.0,
         f"seed=11;serve.replica.kill:1:after={kill_after}:max=1",
         "seed=12;serve.exec.crash:0.02:max=5",
     ] + [None] * max(n_replicas - 2, 0)
-    replicas = [_Replica(i, specs[i] if i < len(specs) else None, env)
+    # Every replica shadow-verifies ALL cache-tier answers (rate 1.0 on
+    # exact + delta): under a fault schedule is exactly when a silently
+    # wrong cached answer would slip out, so chaos gates on zero
+    # mismatches in addition to the robustness checks.
+    shadow_rate = "seed=13;0.0,exact=1.0,delta=1.0"
+    replicas = [_Replica(i, specs[i] if i < len(specs) else None, env,
+                         shadow_rate=shadow_rate)
                 for i in range(n_replicas)]
     router_server = None
     loader = None
     summary: Dict[str, object] = {}
+    shadow: Dict[str, float] = {}
     try:
         ports = [rep.wait_port(300.0) for rep in replicas]
         check.record("replicas_up", all(p is not None for p in ports),
@@ -427,6 +470,17 @@ def run_chaos(n_replicas: int = 3, load_s: float = 6.0,
             "cache_hit_ratio_retained_after_failover", retained,
             f"pre={pre_ratio} post={post_ratio} range={victim_cases}",
         )
+        # Numerical honesty under chaos: the shadow verifier audited
+        # the cache tiers at rate 1.0 throughout — any mismatch means a
+        # wrong answer was SERVED, and no robustness score excuses that.
+        shadow = _shadow_counts(replicas)
+        check.record(
+            "shadow_zero_mismatches",
+            shadow["receipts"] > 0 and shadow["mismatches"] == 0,
+            f"receipts={shadow['receipts']:.0f} "
+            f"verified={shadow['verified']:.0f} "
+            f"mismatches={shadow['mismatches']:.0f}",
+        )
         # Graceful drain: SIGTERM a SURVIVOR — it must flip /healthz to
         # draining, finish its in-flight work, and exit 0 (the rolling-
         # restart path), while the remaining replica keeps answering.
@@ -469,6 +523,7 @@ def run_chaos(n_replicas: int = 3, load_s: float = 6.0,
         "checks": check.results,
         "load": summary,
         "router": router_stats,
+        "shadow": shadow,
         "fault_specs": specs[:n_replicas],
         "workdir": wd,
     }
@@ -476,6 +531,118 @@ def run_chaos(n_replicas: int = 3, load_s: float = 6.0,
         with open(out, "w") as fh:
             json.dump(artifact, fh, indent=2)
     print(json.dumps({"chaos_pass": artifact["pass"],
+                      "failed": [c["name"] for c in check.results
+                                 if not c["ok"]]}), flush=True)
+    return artifact
+
+
+# ---------------------------------------------------------------------------
+# Shadow-verifier negative proof (--shadow-negative)
+# ---------------------------------------------------------------------------
+
+
+def run_shadow_negative(out: Optional[str] = None) -> Dict:
+    """Prove the shadow verifier CATCHES a wrong served answer.
+
+    The inverse of the zero-mismatch gate: with the delta tier's inline
+    residual verify loosened to uselessness
+    (``ServeConfig(cache_verify_tol=1e9)`` — the knob exists only for
+    this proof) and ``serve.cache.corrupt`` firing on every delta
+    candidate, a small-delta request is SERVED numerically wrong.  The
+    checks assert, in order: the corrupt answer really went out on the
+    delta tier, the inline bypass journaled ``serve.cache.loose_accept``
+    (so the scenario is the one we think it is), and the shadow lane's
+    independent f64 re-solve flagged it — ``shadow_mismatch_total``
+    incremented and a ``shadow.mismatch`` event carrying the answer's
+    full receipt landed in the journal.  In-process, one Service, no
+    router: the proof is about the verifier, not the fleet.
+    """
+    from freedm_tpu.core import metrics as obs
+    from freedm_tpu.core.faults import FAULTS
+    from freedm_tpu.core.provenance import PROVENANCE
+    from freedm_tpu.core.tracing import TRACER
+    from freedm_tpu.serve import ServeConfig, Service
+
+    t0 = time.monotonic()
+    check = _Check()
+    # Tracing on, so the receipt carries a real trace_id and the
+    # mismatch-event join below proves the receipt names the request.
+    TRACER.configure(enabled=True, node="shadow-negative")
+    FAULTS.configure("seed=5;serve.cache.corrupt:1:arg=0.05")
+    PROVENANCE.configure(enabled=True, rate_spec="seed=3;0.0,delta=1.0",
+                         replica="shadow-negative")
+    svc = Service(ServeConfig(max_batch=4, queue_depth=64,
+                              cache_verify_tol=1e9))
+    n_bus = 14
+    base_p = [0.0] * n_bus
+    base_q = [0.0] * n_bus
+    # One bus nudged 0.05 pu: rank-1, well inside the delta tier's
+    # rank/magnitude gates, far outside the 1e-4 pu mismatch tolerance
+    # once the corrupt fault adds 0.05 to |V|.
+    bumped_p = list(base_p)
+    bumped_p[3] = 0.05
+    receipt = None
+    try:
+        prime = svc.request(
+            "pf", {"case": "case14", "p_inj": base_p, "q_inj": base_q,
+                   "timeout_s": 300.0}, timeout_s=300.0)
+        prime_tier = (prime.provenance or {}).get("tier")
+        check.record("prime_full_solve", prime_tier == "full",
+                     f"tier={prime_tier}")
+        served = svc.request(
+            "pf", {"case": "case14", "p_inj": bumped_p, "q_inj": base_q,
+                   "timeout_s": 300.0}, timeout_s=300.0)
+        receipt = served.provenance
+        check.record("corrupt_answer_served_on_delta_tier",
+                     (receipt or {}).get("tier") == "delta",
+                     f"receipt={receipt}")
+        loose = [e for e in obs.EVENTS.tail(500)
+                 if e.get("event") == "serve.cache.loose_accept"]
+        check.record(
+            "inline_verify_bypassed", len(loose) > 0,
+            f"loose_accept events={len(loose)} "
+            + (f"residual={loose[-1].get('residual_pu')}" if loose else ""),
+        )
+        # The shadow lane re-solves on its own jitted f64 program; the
+        # first item pays the compile, so the drain budget is generous.
+        drained = PROVENANCE.drain(timeout_s=300.0)
+        check.record("shadow_queue_drained", drained, "")
+        stats = PROVENANCE.stats_block()
+        check.record(
+            "shadow_caught_mismatch",
+            stats.get("shadow_mismatches", 0) >= 1,
+            f"verified={stats.get('shadow_verified')} "
+            f"mismatches={stats.get('shadow_mismatches')}",
+        )
+        mism = [e for e in obs.EVENTS.tail(500)
+                if e.get("event") == "shadow.mismatch"]
+        ok_evt = bool(mism) and isinstance(mism[-1].get("receipt"), dict) \
+            and mism[-1]["receipt"].get("trace_id") is not None \
+            and mism[-1]["receipt"].get("trace_id") \
+            == (receipt or {}).get("trace_id")
+        check.record(
+            "mismatch_event_carries_receipt", ok_evt,
+            f"events={len(mism)} "
+            + (f"max_dv_pu={mism[-1].get('max_dv_pu')}" if mism else ""),
+        )
+    except Exception as e:  # noqa: BLE001 — the artifact must exist
+        check.record("rig_error", False, repr(e))
+    finally:
+        svc.stop()
+        PROVENANCE.reset()
+        FAULTS.configure(None)
+        TRACER.configure(enabled=False)
+    artifact = {
+        "pass": check.passed,
+        "scenario": "shadow_negative",
+        "duration_s": round(time.monotonic() - t0, 1),
+        "checks": check.results,
+        "receipt": receipt,
+    }
+    if out:
+        with open(out, "w") as fh:
+            json.dump(artifact, fh, indent=2)
+    print(json.dumps({"shadow_negative_pass": artifact["pass"],
                       "failed": [c["name"] for c in check.results
                                  if not c["ok"]]}), flush=True)
     return artifact
@@ -489,6 +656,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="internal: run as one serve replica")
     ap.add_argument("--fault-spec", default=None,
                     help="fault schedule for --replica mode")
+    ap.add_argument("--shadow-rate", default=None, metavar="SPEC",
+                    help="shadow-verify rate spec for --replica mode")
+    ap.add_argument("--shadow-negative", action="store_true",
+                    help="run the shadow-verifier negative proof instead "
+                         "of the kill-one-of-N scenario")
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--load", type=float, default=6.0,
                     help="pre/post-kill load window, seconds")
@@ -499,7 +671,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--workdir", default=None)
     args = ap.parse_args(argv)
     if args.replica:
-        return run_replica(fault_spec=args.fault_spec)
+        return run_replica(fault_spec=args.fault_spec,
+                           shadow_rate=args.shadow_rate)
+    if args.shadow_negative:
+        artifact = run_shadow_negative(out=args.out)
+        return 0 if artifact["pass"] else 1
     artifact = run_chaos(
         n_replicas=args.replicas, load_s=args.load,
         post_kill_s=args.load + 2.0, clients=args.clients,

@@ -837,9 +837,16 @@ def write_configs(
         # What-if query endpoint (freedm_tpu.serve): the soak drives a
         # closed-loop load against one slice to prove serving and the
         # broker round loop coexist through kills/rejoins.
+        # Provenance + shadow verification (core/provenance.py): the
+        # serving slice audits EVERY cache-tier answer on the f64
+        # shadow lane and journals every receipt — run_soak gates on
+        # zero mismatches (a soak that "passes" while serving one wrong
+        # cached answer did not pass).
         serve_line = (
             f"serve-port = {spec.serve_port}\n"
             f"qsts-checkpoint-dir = {workdir}/qsts_{spec.port}\n"
+            f"shadow-verify-rate = seed=17;0.0,exact=1.0,delta=1.0\n"
+            f"provenance-log = {workdir}/receipts_{spec.port}.jsonl\n"
             if spec.serve_port is not None
             else ""
         )
@@ -911,6 +918,7 @@ def run_soak(
     slo_status: Dict = {}
     profile_snap: Dict = {}
     roofline_snaps: Dict[str, Dict] = {}
+    provenance_snaps: Dict[str, Dict] = {}
     plant = subprocess.Popen(
         [sys.executable, "-m", "freedm_tpu.sim.plantserver", str(wd / "rig.xml")],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=_env(), text=True,
@@ -1233,6 +1241,37 @@ def run_soak(
             for p in procs
             if p.alive() and p.spec.metrics_port is not None
         )
+        # Per-slice provenance/shadow snapshots: the numerical-honesty
+        # verdict.  Every cache-tier answer the serving slice produced
+        # was shadow-verified on the independent f64 lane; one mismatch
+        # fails the soak regardless of every other check.
+        provenance_snaps.update(
+            (p.spec.uuid,
+             scrape_json_route(p.spec.metrics_port, "/provenance"))
+            for p in procs
+            if p.alive() and p.spec.metrics_port is not None
+        )
+        shadow_on = {
+            uuid: snap for uuid, snap in provenance_snaps.items()
+            if snap.get("enabled")
+        }
+        mismatches = sum(
+            int(st.get("mismatches", 0) or 0)
+            for snap in shadow_on.values()
+            for st in (snap.get("shadow") or {}).values()
+        )
+        verified = sum(
+            int(st.get("verified", 0) or 0)
+            for snap in shadow_on.values()
+            for st in (snap.get("shadow") or {}).values()
+        )
+        if serve_load:
+            check.record(
+                "shadow_zero_mismatches",
+                bool(shadow_on) and mismatches == 0,
+                f"slices={len(shadow_on)} verified={verified} "
+                f"mismatches={mismatches}",
+            )
     finally:
         if loader is not None:
             serve_summary = loader.stop()
@@ -1321,6 +1360,15 @@ def run_soak(
             "status": slo_status,
         },
         "profile": profile_snap,
+        "provenance": {
+            uuid: {
+                "enabled": bool(snap.get("enabled")),
+                "receipts": snap.get("receipts") or {},
+                "shadow": snap.get("shadow") or {},
+                "drift": snap.get("drift") or {},
+            }
+            for uuid, snap in provenance_snaps.items() if snap
+        },
         "roofline": {
             "fleet": sum_roofline(roofline_snaps),
             "slices": {

@@ -9,7 +9,11 @@ What it does, in order:
 
 1. **Merge** every file's spans by ``trace_id`` — a cross-node trace has
    its round/phase spans on the originating node and its recv/handler
-   spans on the peers, stitched by the wire-propagated context.
+   spans on the peers, stitched by the wire-propagated context.  The
+   same merge stitches the serve tier's cross-process request trees:
+   the router's ``serve.route`` span and the replica's ``serve.request``
+   span (parented via the forwarded ``X-Trace-Id``/``X-Span-Id``
+   headers) land in different files but the same tree.
 2. **Correct timestamps** with each node's clock-sync offset table: the
    ``clock`` records journal the synchronizer's measured offset
    (``virtual_now = clock() + offset``), so adding each node's offset
@@ -373,13 +377,16 @@ def render_text(rep: dict, top: int = 3, trace_id: Optional[str] = None) -> str:
     if trace_id is not None:
         chosen = [tid for tid in rep["traces"] if tid.startswith(trace_id)]
     else:
-        # Round-rooted traces first, the causally richest (cross-node
-        # links) before the merely long: that is where the latency
-        # story of a fleet round lives.
+        # Round- or route-rooted traces first, the causally richest
+        # (cross-node links) before the merely long: that is where the
+        # latency story of a fleet round — or of a routed serve request
+        # whose serve.route (router process) and serve.request (replica
+        # process) spans merged into one tree — lives.
         rounds_first = sorted(
             rep["traces"],
             key=lambda tid: (
-                "round" not in rep["traces"][tid]["roots"],
+                "round" not in rep["traces"][tid]["roots"]
+                and "serve.route" not in rep["traces"][tid]["roots"],
                 -rep["traces"][tid]["cross_node_links"],
                 -rep["traces"][tid]["duration_ms"],
             ),

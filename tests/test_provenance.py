@@ -1,0 +1,301 @@
+"""Provenance receipts + shadow verification (``core/provenance.py``,
+ISSUE 17): rate-spec grammar, same-seed sampler determinism (per-tier
+independent streams), byte-stable receipt JSONL, per-tier receipt
+shapes off a live serve ladder, the disabled-by-default tripwire, the
+``GET /provenance`` route schema, and the ``tools/audit_report.py``
+receipts x traces x events join.  The *negative* proof — an injected
+cache corruption the shadow verifier must catch — lives in
+``tools/chaos.py --shadow-negative`` (CI runs it); these tests pin the
+machinery that proof rides on.
+"""
+
+import json
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from freedm_tpu.core.provenance import (
+    PROVENANCE,
+    RECEIPT_FIELDS,
+    TIERS,
+    _Sampler,
+    parse_rate_spec,
+)
+from freedm_tpu.serve import ServeConfig, ServeServer, Service
+from freedm_tpu.serve.service import PowerFlowRequest
+from freedm_tpu.tools import audit_report
+
+BUCKETS = (1, 2, 4)
+T = 300  # first touches compile
+
+
+@pytest.fixture(scope="module")
+def svc():
+    PROVENANCE.configure(enabled=True, rate_spec="0.0",
+                         replica="prov-test")
+    s = Service(ServeConfig(max_batch=4, max_wait_ms=5.0, queue_depth=64,
+                            buckets=BUCKETS))
+    r = s.request("pf", PowerFlowRequest(case="case14", timeout_s=T))
+    assert r.converged and r.batch.tier == "full"
+    s._prime_receipt = r.provenance  # the full-tier receipt, stashed
+    yield s
+    s.stop()
+    PROVENANCE.reset()
+
+
+def _base_inj(svc):
+    eng = svc.engine("pf", "case14")
+    return np.array(eng._p0), np.array(eng._q0)
+
+
+# ---------------------------------------------------------------------------
+# rate-spec grammar + sampler determinism
+# ---------------------------------------------------------------------------
+
+
+def test_rate_spec_grammar():
+    assert parse_rate_spec("") == (None, {"default": 0.0})
+    assert parse_rate_spec("0.05") == (None, {"default": 0.05})
+    seed, rates = parse_rate_spec("seed=7;0.01,exact=1.0,delta=0.5")
+    assert seed == 7
+    assert rates == {"default": 0.01, "exact": 1.0, "delta": 0.5}
+    # Rates clamp to [0, 1]; a typo'd tier is a typed error, not a
+    # silently-sampling-nothing config.
+    assert parse_rate_spec("exact=7")[1]["exact"] == 1.0
+    with pytest.raises(ValueError, match="unknown shadow-verify tier"):
+        parse_rate_spec("exatc=1.0")
+    with pytest.raises(ValueError, match="bad shadow-verify rate"):
+        parse_rate_spec("exact=lots")
+    with pytest.raises(ValueError, match="bad shadow-verify seed"):
+        parse_rate_spec("seed=x;0.5")
+
+
+def test_same_seed_sampler_picks_identical_indices():
+    a = _Sampler(7, {"default": 0.3})
+    b = _Sampler(7, {"default": 0.3})
+    draws_a = [a.should("exact") for _ in range(200)]
+    draws_b = [b.should("exact") for _ in range(200)]
+    assert draws_a == draws_b
+    assert any(draws_a) and not all(draws_a)  # actually probabilistic
+    # Per-tier streams are independent: interleaving another tier's
+    # draws must not perturb this tier's sequence (the faults.py
+    # discipline — a replayed load samples the same answers per tier).
+    c = _Sampler(7, {"default": 0.3})
+    draws_c = []
+    for _ in range(200):
+        c.should("delta")
+        draws_c.append(c.should("exact"))
+    assert draws_c == draws_a
+    # Boundary rates short-circuit without consuming stream state.
+    z = _Sampler(0, {"default": 0.0, "exact": 1.0})
+    assert all(z.should("exact") for _ in range(10))
+    assert not any(z.should("warm") for _ in range(10))
+
+
+# ---------------------------------------------------------------------------
+# receipt byte-stability
+# ---------------------------------------------------------------------------
+
+
+def test_receipt_log_json_is_byte_stable_and_schema_ordered():
+    span = types.SimpleNamespace(trace_id="cafe0123")
+    kw = dict(workload="pf", case="case14", tier="delta", span=span,
+              backend="dense", precision="mixed", fallbacks=2,
+              iterations=3, residual=1.25e-7, warm_source=None,
+              cache_age_s=0.5)
+    r1 = PROVENANCE.stamp(types.SimpleNamespace(), **kw)
+    r2 = PROVENANCE.stamp(types.SimpleNamespace(), **kw)
+    line1 = PROVENANCE.receipt_log_json(r1)
+    line2 = PROVENANCE.receipt_log_json(r2)
+    assert line1 == line2  # same inputs -> byte-identical JSONL
+    # Emission order is the schema order — the contract that makes a
+    # receipt diffable across runs and joinable by column tools.
+    assert list(json.loads(line1).keys()) == list(RECEIPT_FIELDS)
+    assert list(r1.keys()) == list(RECEIPT_FIELDS)
+
+
+# ---------------------------------------------------------------------------
+# per-tier receipt shapes off the live ladder
+# ---------------------------------------------------------------------------
+
+
+def test_full_tier_receipt_shape(svc):
+    r = svc._prime_receipt
+    assert r is not None and list(r.keys()) == list(RECEIPT_FIELDS)
+    assert r["tier"] == "full" and r["workload"] == "pf"
+    assert r["case"] == "case14" and r["replica"] == "prov-test"
+    assert r["pf_backend"] in ("dense", "sparse")
+    assert r["pf_precision"] in ("f64", "mixed")
+    assert isinstance(r["iterations"], int) and r["iterations"] >= 1
+    assert r["bucket"] in BUCKETS and r["lanes"] >= 1
+    assert r["solve_ms"] > 0.0
+
+
+def test_exact_tier_receipt_shape(svc):
+    r = svc.request("pf", PowerFlowRequest(case="case14", timeout_s=T))
+    assert r.batch.tier == "exact"
+    rec = r.provenance
+    assert list(rec.keys()) == list(RECEIPT_FIELDS)
+    assert rec["tier"] == "exact"
+    assert rec["cache_age_s"] is not None and rec["cache_age_s"] >= 0.0
+    assert rec["bucket"] == 0 and rec["solve_ms"] == 0.0
+    assert rec["trace_id"] is None  # tracing off -> honest null, not ""
+
+
+def test_delta_tier_receipt_carries_measured_residual(svc):
+    p0, q0 = _base_inj(svc)
+    p = p0.copy()
+    p[4] += 0.03  # rank-1, small magnitude: the delta tier's home turf
+    r = svc.request("pf", PowerFlowRequest(
+        case="case14", p_inj=p.tolist(), q_inj=q0.tolist(), timeout_s=T))
+    assert r.batch.tier == "delta"
+    rec = r.provenance
+    assert rec["tier"] == "delta"
+    # residual_pu on a delta receipt is the host-f64 verify, not a claim.
+    assert rec["residual_pu"] is not None and rec["residual_pu"] <= 1e-6
+    assert rec["cache_age_s"] is not None
+
+
+def test_warm_tier_receipt_names_its_source(svc):
+    # One bus past the delta tier's 0.5 pu magnitude cap: too big for
+    # the SMW correction, but a near entry still seeds the warm start.
+    p0, q0 = _base_inj(svc)
+    p = p0.copy()
+    p[8] += 0.6
+    r = svc.request("pf", PowerFlowRequest(
+        case="case14", p_inj=p.tolist(), q_inj=q0.tolist(), timeout_s=T))
+    rec = r.provenance
+    assert rec["tier"] == "warm"
+    assert rec["warm_source"]  # the cache-entry digest it was seeded from
+    assert rec["bucket"] in BUCKETS  # warm IS a dispatched solve
+
+
+# ---------------------------------------------------------------------------
+# disabled-by-default tripwire
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_mode_stamps_nothing(svc):
+    # The acceptance bar: when off, serve paths pay one attribute check
+    # and responses carry no provenance key at all.
+    before = dict(PROVENANCE._receipts)
+    PROVENANCE.enabled = False
+    try:
+        r = svc.request("pf", PowerFlowRequest(case="case14", timeout_s=T))
+        assert r.provenance is None
+        assert "provenance" not in r.to_dict()
+        assert PROVENANCE._receipts == before
+    finally:
+        PROVENANCE.enabled = True
+    # Boot state is disabled (the singleton must not leak between
+    # processes that never opted in).
+    assert type(PROVENANCE)().enabled is False
+
+
+# ---------------------------------------------------------------------------
+# GET /provenance route
+# ---------------------------------------------------------------------------
+
+
+def test_provenance_route_schema(svc):
+    srv = ServeServer(svc, port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/provenance", timeout=10
+        ) as resp:
+            doc = json.loads(resp.read())
+    finally:
+        srv.stop()
+    assert doc["enabled"] is True and doc["replica"] == "prov-test"
+    assert set(doc["sampler"]) == {"seed", "rates"}
+    assert doc["mismatch_tol"] == pytest.approx(1e-4)
+    # Every ladder tier this module exercised shows up, counted.
+    for tier in ("full", "exact", "delta", "warm"):
+        assert doc["receipts"].get(tier, 0) >= 1, tier
+    assert set(doc["receipts"]) <= set(TIERS)
+    assert isinstance(doc["shadow"], dict)
+    assert doc["shadow_queue_depth"] == 0
+    # Drift windows key on case|tier|precision and summarize residuals.
+    assert any(k.startswith("case14|") for k in doc["drift"])
+    win = next(v for k, v in doc["drift"].items()
+               if k.startswith("case14|delta|"))
+    assert win["count"] >= 1 and "residual_p50" in win
+    # The condensed /stats fold agrees with the full document.
+    blk = svc.stats()["provenance"]
+    assert blk["enabled"] is True
+    assert blk["receipts"] == doc["receipts"]
+
+
+# ---------------------------------------------------------------------------
+# audit_report: the receipts x traces x events join
+# ---------------------------------------------------------------------------
+
+
+def test_audit_report_joins_streams_by_trace_id(svc, tmp_path):
+    from freedm_tpu.core.tracing import TRACER
+
+    rlog = tmp_path / "receipts.jsonl"
+    tlog = tmp_path / "trace.jsonl"
+    elog = tmp_path / "events.jsonl"
+    TRACER.configure(enabled=True, node="prov-test", path=str(tlog))
+    PROVENANCE.configure(log=str(rlog))
+    p0, q0 = _base_inj(svc)
+    try:
+        tids = []
+        for bump in (0.011, 0.012):
+            p = p0.copy()
+            p[6] += bump
+            r = svc.request("pf", PowerFlowRequest(
+                case="case14", p_inj=p.tolist(), q_inj=q0.tolist(),
+                timeout_s=T))
+            tids.append(r.provenance["trace_id"])
+        assert all(tids) and tids[0] != tids[1]
+    finally:
+        # Full reset, not just disable: the flight-recorder ring would
+        # otherwise leak this test's batch-less cache-tier
+        # serve.request spans into later modules' tail() polls.
+        TRACER.reset()
+        PROVENANCE._journal.close()
+    # A journal with one indicting event for the second request and one
+    # event that mentions no request at all.
+    elog.write_text(
+        json.dumps({"event": "shadow.mismatch", "max_dv_pu": 0.05,
+                    "tol": 1e-4, "receipt": {"trace_id": tids[1]}}) + "\n"
+        + json.dumps({"event": "slo.breach", "objective": "x"}) + "\n"
+    )
+
+    audit = audit_report.build_audit([str(rlog)], [str(tlog)], [str(elog)])
+    assert audit["receipts"] == 2
+    assert audit["receipts_without_trace_id"] == 0
+    assert set(audit["trails"]) == set(tids)
+    assert audit["events_unjoined"] == 1
+    # The flagged trail is exactly the indicted request...
+    assert audit["flagged"] == [tids[1]]
+    assert audit["trails"][tids[1]]["events"][0]["event"] == "shadow.mismatch"
+    # ...and every trail carries its span tree, serve.request included.
+    for tid in tids:
+        tr = audit["trails"][tid]["trace"]
+        assert tr is not None and tr["spans"] >= 1
+        assert any(s["name"] == "serve.request" for s in tr["tree"])
+
+    text = audit_report.render_text(audit)
+    assert "** FLAGGED **" in text and tids[1] in text
+    # The CLI doubles as a gate: flagged trails -> exit 1.
+    assert audit_report.main(
+        ["--receipts", str(rlog), "--trace", str(tlog),
+         "--events", str(elog), "--only-flagged"]) == 1
+
+
+def test_audit_report_counts_untraced_receipts(tmp_path):
+    # Receipts stamped while tracing was off join nothing — counted,
+    # never silently dropped.
+    rlog = tmp_path / "r.jsonl"
+    rec = {k: None for k in RECEIPT_FIELDS}
+    rec.update(tier="exact", workload="pf", case="case14")
+    rlog.write_text(json.dumps(rec) + "\n")
+    audit = audit_report.build_audit([str(rlog)])
+    assert audit["receipts"] == 1
+    assert audit["receipts_without_trace_id"] == 1
+    assert audit["trails"] == {} and audit["flagged"] == []
