@@ -344,6 +344,13 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--qsts-checkpoint-dir", default=None, metavar="DIR",
                     help="directory for QSTS chunk-boundary checkpoints "
                          "(keyed jobs resume across restarts; unset = none)")
+    ap.add_argument("--qsts-agents-max", type=int, default=None, metavar="N",
+                    help="per-job agent-population ceiling for QSTS "
+                         "'agents' specs (default 1000000; docs/agents.md)")
+    ap.add_argument("--qsts-agents-cells-max", type=int, default=None,
+                    metavar="N",
+                    help="scenarios*agents state-cell ceiling per QSTS job "
+                         "(bounds the agent carry; default 4000000)")
     ap.add_argument("--mqtt-id", default=None, metavar="ID",
                     help="MQTT plug-and-play client id "
                          "(docs/mqtt_discovery.md)")
@@ -437,6 +444,8 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("qsts_workers", "qsts_workers"), ("qsts_max_jobs", "qsts_max_jobs"),
         ("qsts_chunk_steps", "qsts_chunk_steps"),
         ("qsts_checkpoint_dir", "qsts_checkpoint_dir"),
+        ("qsts_agents_max", "qsts_agents_max"),
+        ("qsts_agents_cells_max", "qsts_agents_cells_max"),
         ("migration_step", "migration_step"),
         ("malicious_behavior", "malicious_behavior"),
         ("check_invariant", "check_invariant"), ("verbose", "verbose"),
@@ -769,6 +778,8 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
             max_pending=cfg.qsts_max_jobs,
             checkpoint_dir=cfg.qsts_checkpoint_dir,
             default_chunk_steps=cfg.qsts_chunk_steps,
+            agents_max=cfg.qsts_agents_max,
+            agents_cells_max=cfg.qsts_agents_cells_max,
             default_topo_chunk=cfg.topo_chunk_variants,
             # Submitted studies shard their scenario axis by default;
             # a request's own mesh_devices field overrides.

@@ -331,6 +331,11 @@ class GlobalConfig:
     qsts_max_jobs: int = 16
     qsts_chunk_steps: int = 24
     qsts_checkpoint_dir: Optional[str] = None
+    # Grid-edge agent populations attached to QSTS jobs (docs/agents.md):
+    # per-job population ceiling and scenarios*agents state-cell ceiling
+    # (the chunk carry materializes one state lane per scenario-agent).
+    qsts_agents_max: int = 1_000_000
+    qsts_agents_cells_max: int = 4_000_000
     # Fault injection (freedm_tpu.core.faults): a seeded, deterministic
     # fault schedule as "[seed=N;]point:rate[:arg=V][:after=N][:max=N]"
     # entries over the named injection points (docs/robustness.md).

@@ -971,6 +971,15 @@ QSTS_CHUNK_SECONDS = REGISTRY.histogram(
 QSTS_SCENARIO_RATE = REGISTRY.gauge(
     "qsts_scenario_steps_per_sec",
     "Scenario-timesteps per second of the most recent QSTS chunk")
+QSTS_AGENT_RATE = REGISTRY.gauge(
+    "qsts_agent_steps_per_sec",
+    "Agent-steps per second of the most recent QSTS chunk (scenario-"
+    "timesteps x population size; zero unless the study attached an "
+    "agent population — docs/agents.md)")
+QSTS_AGENTS_TOTAL = REGISTRY.gauge(
+    "qsts_agents_total",
+    "Agent population size of the most recently executed agent-"
+    "population QSTS study")
 QSTS_RESUMES = REGISTRY.counter(
     "qsts_resumes_total", "QSTS jobs resumed from a chunk checkpoint")
 QSTS_REQUEUED = REGISTRY.counter(

@@ -36,6 +36,17 @@ Design points:
   Profile determinism independent of chunking
   (:mod:`freedm_tpu.scenarios.profiles`) is the other half of that
   contract.
+- **Closed-loop agent populations.**  An optional ``StudySpec.agents``
+  population (:mod:`freedm_tpu.scenarios.agents`) steps inside the
+  chunk scan: each timestep the agents observe the PREVIOUS step's
+  solved bus voltages, update their state (EV SoC, thermostat relays,
+  inverter q, DR engagement — all riding the scan carry and the chunk
+  checkpoint), and their per-bus injections are added to the scheduled
+  profile before the solve.  With agents the carry's ``v``/``theta``
+  always hold the last SOLVED point (the observation); ``warm_start``
+  only chooses the solver's seed.  Everything above — bit-exact
+  kill/resume, placement-free checkpoints, one program per chunk
+  shape — holds unchanged (docs/agents.md).
 """
 
 from __future__ import annotations
@@ -52,6 +63,14 @@ import numpy as np
 from freedm_tpu.core import profiling
 from freedm_tpu.core import roofline
 from freedm_tpu.core import tracing
+from freedm_tpu.scenarios.agents import (
+    AgentSpec,
+    AgentState,
+    build_population,
+    dr_signal,
+    population_step,
+    validate_agent_spec,
+)
 from freedm_tpu.scenarios.profiles import PROFILE_KINDS, ProfileSet, ProfileSpec
 
 #: Voltage band for violation accounting, pu (ANSI C84.1 service band —
@@ -66,7 +85,8 @@ CKPT_VERSION = 1
 #: the strip list cannot drift per consumer.  ``mesh_devices`` is
 #: bookkeeping too: the sharded-equals-unsharded contract says WHERE a
 #: study ran must not change WHAT it computed.
-SUMMARY_TIMING_KEYS = ("wall_s", "scenario_steps_per_sec", "compiles",
+SUMMARY_TIMING_KEYS = ("wall_s", "scenario_steps_per_sec",
+                       "agent_steps_per_sec", "compiles",
                        "resumed_from_chunk", "chunks_done", "mesh_devices")
 
 #: StudySpec keys that describe EXECUTION PLACEMENT, not the study —
@@ -135,12 +155,22 @@ class StudySpec:
     # resolved device count.  The lax.scan time axis stays local; only
     # the vmap-over-scenarios axis shards.
     mesh_devices: int = 0
+    # Optional grid-edge agent population (scenarios/agents.py) stepped
+    # closed-loop inside the chunk scan.  Like every non-placement field
+    # it is part of the study's checkpoint identity: a resubmission with
+    # a different population does not match the old checkpoint and
+    # restarts clean.  Bus cases only (the feeder ladder has no per-bus
+    # voltage state for agents to observe).
+    agents: Optional[AgentSpec] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
 
     @classmethod
     def from_dict(cls, d: dict) -> "StudySpec":
+        d = dict(d)
+        if isinstance(d.get("agents"), dict):
+            d["agents"] = AgentSpec(**d["agents"])
         return cls(**d)
 
     def profile_spec(self) -> ProfileSpec:
@@ -166,6 +196,33 @@ class BusState(NamedTuple):
     v_lo: np.ndarray  # [] envelope min
     v_hi: np.ndarray  # [] envelope max
     peak_pu: np.ndarray  # [] peak branch apparent power, pu
+
+
+class AgentBusState(NamedTuple):
+    """Bus-case chunk carry with a grid-edge agent population riding
+    along: the :class:`BusState` fields (with ``v``/``theta`` always
+    the last SOLVED point — the agents' observation) plus per-agent
+    dynamic state and two agent accumulators.  Same lifecycle as
+    :class:`BusState`: numpy at chunk boundaries, donated into the
+    chunk program, serialized whole into the checkpoint."""
+
+    v: np.ndarray  # [S, n] last solved voltage magnitudes (the obs)
+    theta: np.ndarray  # [S, n] last solved angles
+    viol_min: np.ndarray  # [S]
+    loss_puh: np.ndarray  # [S]
+    it_sum: np.ndarray  # [S]
+    it_max: np.ndarray  # []
+    nonconv: np.ndarray  # []
+    v_lo: np.ndarray  # []
+    v_hi: np.ndarray  # []
+    peak_pu: np.ndarray  # []
+    ev_soc: np.ndarray  # [S, n_ev] EV state of charge
+    th_temp: np.ndarray  # [S, n_th] thermostat indoor temperature
+    th_on: np.ndarray  # [S, n_th] thermostat relay (0/1)
+    inv_q: np.ndarray  # [S, n_inv] inverter reactive output
+    dr_eng: np.ndarray  # [S, n_dr] DR engagement level
+    agent_puh: np.ndarray  # [S] cumulative served agent energy, pu·h
+    agent_qpk: np.ndarray  # [] peak inverter |q|, pu
 
 
 class FeederState(NamedTuple):
@@ -262,16 +319,34 @@ class QstsEngine:
         else:
             self._init_feeder()
         self.profiles = ProfileSet(spec.profile_spec(), self._n_profile)
+        # Optional agent population: built ONCE here (all draws at
+        # construction, from the profiles module's population_rng seam
+        # — GL003), stepped closed-loop inside every chunk.
+        self._pop = None
+        self._pop_dev = None  # device-resident copy, placed lazily
+        self._agents_total = 0
+        if spec.agents is not None:
+            if self.kind != "bus":
+                raise ValueError(
+                    "agent populations require a bus case: the feeder "
+                    "ladder has no per-bus voltage state for agents to "
+                    "observe (closed-loop q(v) needs the Newton path)"
+                )
+            validate_agent_spec(spec.agents)
+            self._agents_total = spec.agents.total()
+            self._pop, self._ag0, self._events = build_population(
+                spec.agents, self.profiles, self._p0
+            )
 
-    def _shard_chunk(self, fn, state_ranks, arr_rank: int, n_arrays: int):
+    def _shard_chunk(self, fn, state_ranks, arg_specs):
         """``shard_map`` a chunk body over the scenario axis.
 
         ``state_ranks`` is the state NamedTuple with each field's array
         rank (0 = replicated scalar carry, >0 = lane-sharded on axis 0);
-        injection arrays are rank ``arr_rank`` with the lane axis at 1
-        (axis 0 is time).  Also builds the engine's host-boundary
-        shard/gather fns (profiled as ``mesh.shard_put``/``mesh.gather``)
-        the first time through.
+        ``arg_specs`` is one PartitionSpec (or spec pytree, for the
+        agent population) per non-state chunk argument.  Also builds
+        the engine's host-boundary shard/gather fns (profiled as
+        ``mesh.shard_put``/``mesh.gather``) the first time through.
         """
         from jax.sharding import PartitionSpec as P
 
@@ -281,14 +356,13 @@ class QstsEngine:
         state_specs = type(state_ranks)(*(
             pmesh.lane_spec(mesh, r) if r else P() for r in state_ranks
         ))
-        arr_spec = pmesh.lane_spec(mesh, arr_rank, lane_axis=1)
         if self._shard_in is None:
             self._shard_in, self._gather = pmesh.make_shard_and_gather_fns(
-                mesh, (state_specs, (arr_spec,) * n_arrays)
+                mesh, (state_specs, tuple(arg_specs))
             )
         return pmesh.shard_batched(
             fn, mesh,
-            in_specs=(state_specs,) + (arr_spec,) * n_arrays,
+            in_specs=(state_specs,) + tuple(arg_specs),
             out_specs=state_specs,
         )
 
@@ -353,25 +427,22 @@ class QstsEngine:
             s_t = vt * (ytf * vf + ytt * vt).conj()
             return jnp.maximum(jnp.max(s_f.abs()), jnp.max(s_t.abs()))
 
-        def step(st: BusState, inj):
-            p_t, q_t = inj
+        def solve_step(st, p_t, q_t):
+            """One batched solve from the carry's seed point, plus the
+            accumulator updates shared by both chunk flavors."""
+            v0 = (
+                st.v if spec.warm_start
+                else jnp.broadcast_to(flat_row[None, :], st.v.shape)
+            )
+            th0 = st.theta if spec.warm_start else jnp.zeros_like(st.theta)
             r = jax.vmap(
-                lambda p, q, v0, th0: solve(
-                    p_inj=p, q_inj=q, v0=v0, theta0=th0
-                )
-            )(p_t, q_t, st.v, st.theta)
+                lambda p, q, v, th: solve(p_inj=p, q_inj=q, v0=v, theta0=th)
+            )(p_t, q_t, v0, th0)
             vm = r.v
             outside = (vm < lo) | (vm > hi)
             iters = r.iterations.astype(jnp.int32)
             peak = jax.vmap(flow_peak)(r.v, r.theta)
-            nxt_v = (
-                r.v if spec.warm_start
-                else jnp.broadcast_to(flat_row[None, :], r.v.shape)
-            )
-            nxt_th = r.theta if spec.warm_start else jnp.zeros_like(r.theta)
-            return BusState(
-                v=nxt_v,
-                theta=nxt_th,
+            return r, dict(
                 viol_min=st.viol_min
                 + dt_min * jnp.sum(outside, axis=1).astype(st.viol_min.dtype),
                 loss_puh=st.loss_puh
@@ -383,11 +454,72 @@ class QstsEngine:
                 v_lo=jnp.minimum(st.v_lo, jnp.min(vm)),
                 v_hi=jnp.maximum(st.v_hi, jnp.max(vm)),
                 peak_pu=jnp.maximum(st.peak_pu, jnp.max(peak)),
-            ), None
+            )
 
-        def chunk(state: BusState, p, q):  # p, q: [Tc, S, n]
-            out, _ = jax.lax.scan(step, state, (p, q))
-            return out
+        agents_on = self._pop is not None
+
+        if not agents_on:
+            def step(st: BusState, inj):
+                p_t, q_t = inj
+                r, acc = solve_step(st, p_t, q_t)
+                nxt_v = (
+                    r.v if spec.warm_start
+                    else jnp.broadcast_to(flat_row[None, :], r.v.shape)
+                )
+                nxt_th = (
+                    r.theta if spec.warm_start else jnp.zeros_like(r.theta)
+                )
+                return BusState(v=nxt_v, theta=nxt_th, **acc), None
+
+            def chunk(state: BusState, p, q):  # p, q: [Tc, S, n]
+                out, _ = jax.lax.scan(step, state, (p, q))
+                return out
+        else:
+            aspec = spec.agents
+            n_bus_ct = sys_.n_bus
+
+            def chunk(state: AgentBusState, p, q, sig, hs, pop):
+                # p, q: [Tc, S, n]; sig: [Tc, S]; hs: [Tc]; pop: the
+                # replicated struct-of-arrays population (a runtime
+                # argument — NOT a captured constant, so a million-agent
+                # parameter set is neither baked into the executable nor
+                # re-transferred per chunk).
+                def step(st: AgentBusState, xs):
+                    p_t, q_t, sig_t, h_t = xs
+                    # Agents observe the carry's voltages — the
+                    # PREVIOUS step's solved magnitudes (flat start at
+                    # t=0), or a flat 1.0 pu when replayed.
+                    obs = (
+                        st.v if aspec.closed_loop
+                        else jnp.ones_like(st.v)
+                    )
+                    ag = AgentState(
+                        ev_soc=st.ev_soc, th_temp=st.th_temp,
+                        th_on=st.th_on, inv_q=st.inv_q, dr_eng=st.dr_eng,
+                    )
+                    ag2, dp, dq, served, qpk = jax.vmap(
+                        lambda v_row, ag_row, s: population_step(
+                            pop, ag_row, v_row, s, h_t, dt_h, n_bus_ct
+                        )
+                    )(obs, ag, sig_t)
+                    r, acc = solve_step(st, p_t + dp, q_t + dq)
+                    # The carry ALWAYS holds the solved point here — the
+                    # closed-loop observation must be honest regardless
+                    # of warm_start, which only picks the solver's seed
+                    # (solve_step).
+                    return AgentBusState(
+                        v=r.v, theta=r.theta,
+                        ev_soc=ag2.ev_soc, th_temp=ag2.th_temp,
+                        th_on=ag2.th_on, inv_q=ag2.inv_q,
+                        dr_eng=ag2.dr_eng,
+                        agent_puh=st.agent_puh
+                        + served.astype(st.agent_puh.dtype) * dt_h,
+                        agent_qpk=jnp.maximum(st.agent_qpk, jnp.max(qpk)),
+                        **acc,
+                    ), None
+
+                out, _ = jax.lax.scan(step, state, (p, q, sig, hs))
+                return out
 
         if self._mesh is None:
             # The state carry round-trips through host numpy at every
@@ -397,17 +529,43 @@ class QstsEngine:
             return jax.jit(chunk, donate_argnums=(0,))
 
         # Sharded form: the SAME chunk body under shard_map, each device
-        # scanning its local lane block.  Per-scenario accumulators are
-        # purely lane-local; the scalar reductions combine across
-        # devices at chunk exit — max/min are exact and idempotent, so
-        # the carried global value rides through the local scan, while
-        # the int sum restarts from zero and psums its delta.  Result:
-        # byte-identical to the unsharded chunk.
-        ax = _lane_axes(self._mesh)
+        # scanning its local lane block.  Per-scenario accumulators (and
+        # per-agent state — the agent axis shards WITH its scenario
+        # lane) are purely lane-local; the scalar reductions combine
+        # across devices at chunk exit — max/min are exact and
+        # idempotent, so the carried global value rides through the
+        # local scan, while the int sum restarts from zero and psums
+        # its delta.  Result: byte-identical to the unsharded chunk.
+        from jax.sharding import PartitionSpec as P
 
-        def chunk_sharded(state: BusState, p, q):
+        from freedm_tpu.parallel import mesh as pmesh
+
+        ax = _lane_axes(self._mesh)
+        arr3 = pmesh.lane_spec(self._mesh, 3, lane_axis=1)
+
+        if not agents_on:
+            def chunk_sharded(state: BusState, p, q):
+                out = chunk(
+                    state._replace(nonconv=jnp.zeros_like(state.nonconv)),
+                    p, q,
+                )
+                return out._replace(
+                    nonconv=state.nonconv + jax.lax.psum(out.nonconv, ax),
+                    it_max=jax.lax.pmax(out.it_max, ax),
+                    v_lo=jax.lax.pmin(out.v_lo, ax),
+                    v_hi=jax.lax.pmax(out.v_hi, ax),
+                    peak_pu=jax.lax.pmax(out.peak_pu, ax),
+                )
+
+            return self._shard_chunk(chunk_sharded, BusState(
+                v=2, theta=2, viol_min=1, loss_puh=1, it_sum=1,
+                it_max=0, nonconv=0, v_lo=0, v_hi=0, peak_pu=0,
+            ), (arr3, arr3))
+
+        def chunk_sharded(state: AgentBusState, p, q, sig, hs, pop):
             out = chunk(
-                state._replace(nonconv=jnp.zeros_like(state.nonconv)), p, q
+                state._replace(nonconv=jnp.zeros_like(state.nonconv)),
+                p, q, sig, hs, pop,
             )
             return out._replace(
                 nonconv=state.nonconv + jax.lax.psum(out.nonconv, ax),
@@ -415,12 +573,17 @@ class QstsEngine:
                 v_lo=jax.lax.pmin(out.v_lo, ax),
                 v_hi=jax.lax.pmax(out.v_hi, ax),
                 peak_pu=jax.lax.pmax(out.peak_pu, ax),
+                agent_qpk=jax.lax.pmax(out.agent_qpk, ax),
             )
 
-        return self._shard_chunk(chunk_sharded, BusState(
+        sig2 = pmesh.lane_spec(self._mesh, 2, lane_axis=1)
+        pop_specs = jax.tree_util.tree_map(lambda _: P(), self._pop)
+        return self._shard_chunk(chunk_sharded, AgentBusState(
             v=2, theta=2, viol_min=1, loss_puh=1, it_sum=1,
             it_max=0, nonconv=0, v_lo=0, v_hi=0, peak_pu=0,
-        ), arr_rank=3, n_arrays=2)
+            ev_soc=2, th_temp=2, th_on=2, inv_q=2, dr_eng=2,
+            agent_puh=1, agent_qpk=0,
+        ), (arr3, arr3, sig2, P(), pop_specs))
 
     def _bus_injections(self, t0: int, t1: int):
         """[Tc, S, n] scheduled injections for timesteps [t0, t1):
@@ -433,6 +596,29 @@ class QstsEngine:
         p = np.ascontiguousarray(p.swapaxes(0, 1)).astype(self.rdtype)
         q = np.ascontiguousarray(q.swapaxes(0, 1)).astype(self.rdtype)
         return p, q
+
+    def _agent_arrays(self, t0: int, t1: int):
+        """Agent-chunk runtime extras for timesteps ``[t0, t1)``: the
+        broadcast DR signal [Tc, S] and hour-of-day [Tc] (both pure
+        functions of the timestep index, like the profile tensors), and
+        the population itself.  The population converts to device
+        arrays ONCE (unsharded path) or is placed replicated by the
+        shard fns (sharded path; re-placement of an already-placed
+        array is a no-op), so steady-state chunks re-transfer nothing.
+        """
+        h = self.profiles.hours(t0, t1)
+        sig = dr_signal(self._events, h).astype(self.rdtype)
+        if self._pop_dev is None:
+            if self._mesh is None:
+                import jax
+                import jax.numpy as jnp
+
+                self._pop_dev = jax.tree_util.tree_map(
+                    jnp.asarray, self._pop
+                )
+            else:
+                self._pop_dev = self._pop
+        return sig, h.astype(self.rdtype), self._pop_dev
 
     # -- feeder (ladder) path ------------------------------------------------
     def _init_feeder(self):
@@ -525,10 +711,13 @@ class QstsEngine:
                 peak_kva=jax.lax.pmax(out.peak_kva, ax),
             )
 
+        from freedm_tpu.parallel import mesh as pmesh
+
+        arr4 = pmesh.lane_spec(self._mesh, 4, lane_axis=1)
         return self._shard_chunk(chunk_sharded, FeederState(
             viol_min=1, loss_kwh=1, it_sum=1,
             it_max=0, nonconv=0, v_lo=0, v_hi=0, peak_kva=0,
-        ), arr_rank=4, n_arrays=2)
+        ), (arr4, arr4))
 
     def _feeder_injections(self, t0: int, t1: int):
         """[Tc, S, nb, 3] net loads: base loads under the multiplier,
@@ -549,7 +738,7 @@ class QstsEngine:
         rd = self.rdtype
         if self.kind == "bus":
             n = self._case.n_bus
-            return BusState(
+            base = BusState(
                 v=np.broadcast_to(self._v_flat, (s, n)).astype(rd),
                 theta=np.zeros((s, n), rd),
                 viol_min=np.zeros(s, rd),
@@ -560,6 +749,26 @@ class QstsEngine:
                 v_lo=rd.type(_V_LO_INIT),
                 v_hi=rd.type(_V_HI_INIT),
                 peak_pu=rd.type(0.0),
+            )
+            if self._pop is None:
+                return base
+            # Per-agent initial state (drawn at construction) broadcast
+            # over the scenario axis; scenarios diverge through the
+            # voltages and profiles they observe.
+            ag = self._ag0
+
+            def rep(x):
+                return np.broadcast_to(x, (s,) + x.shape).astype(rd)
+
+            return AgentBusState(
+                *base,
+                ev_soc=rep(ag.ev_soc),
+                th_temp=rep(ag.th_temp),
+                th_on=rep(ag.th_on),
+                inv_q=rep(ag.inv_q),
+                dr_eng=rep(ag.dr_eng),
+                agent_puh=np.zeros(s, rd),
+                agent_qpk=rd.type(0.0),
             )
         return FeederState(
             viol_min=np.zeros(s, rd),
@@ -585,10 +794,13 @@ class QstsEngine:
             )
         with tracing.TRACER.start(
             "qsts.chunk", kind="qsts",
-            tags={"t0": t0, "steps": tc, "scenarios": spec.scenarios},
+            tags={"t0": t0, "steps": tc, "scenarios": spec.scenarios,
+                  "agents": self._agents_total},
         ):
             if self.kind == "bus":
                 arrays = self._bus_injections(t0, t1)
+                if self._pop is not None:
+                    arrays = arrays + self._agent_arrays(t0, t1)
             else:
                 arrays = self._feeder_injections(t0, t1)
             new_shape = tc not in self._fns
@@ -604,6 +816,11 @@ class QstsEngine:
                 # profiled as mesh.shard_put) — the shard half of the
                 # shard/gather-fns host boundary.
                 state, arrays = self._shard_in((state, tuple(arrays)))
+                if self._pop is not None:
+                    # Keep the placed (replicated) population: the next
+                    # chunk's device_put of it is then a no-op instead
+                    # of a host->mesh re-transfer.
+                    self._pop_dev = arrays[-1]
             t_solve = time.monotonic()
             with tracing.TRACER.start(
                 f"pf.solve:{self.solver_name}", kind="solve",
@@ -630,7 +847,8 @@ class QstsEngine:
             # dispatched scenario-step count; the compile-tainted first
             # dispatch of a shape is counted but not credited wall.
             roofline.ROOFLINE.record_dispatch(
-                "qsts/bus_chunk" if self.kind == "bus"
+                ("qsts/agents_chunk" if self._pop is not None
+                 else "qsts/bus_chunk") if self.kind == "bus"
                 else "qsts/feeder_chunk",
                 device_s=None if new_shape
                 else time.monotonic() - t_solve,
@@ -651,7 +869,10 @@ class QstsEngine:
         return {k: np.asarray(v).tolist() for k, v in state._asdict().items()}
 
     def state_from_jsonable(self, d: dict):
-        cls = BusState if self.kind == "bus" else FeederState
+        if self.kind == "bus":
+            cls = AgentBusState if self._pop is not None else BusState
+        else:
+            cls = FeederState
         ref = self.initial_state()
         return cls(**{
             k: np.asarray(d[k], dtype=np.asarray(getattr(ref, k)).dtype)
@@ -697,6 +918,17 @@ class QstsEngine:
             out["energy_balance_ok"] = bool(
                 np.min(np.asarray(state.loss_puh, np.float64)) > -1e-4
             )
+            if self._pop is not None:
+                out["agents_total"] = self._agents_total
+                out["agents_closed_loop"] = bool(spec.agents.closed_loop)
+                out["agent_energy_puh_mean"] = round(
+                    float(np.mean(state.agent_puh)), 6
+                )
+                out["agent_q_peak_pu"] = round(float(state.agent_qpk), 6)
+                if wall_s > 0:
+                    out["agent_steps_per_sec"] = round(
+                        lane_steps * self._agents_total / wall_s, 1
+                    )
         else:
             loss_kwh = np.asarray(state.loss_kwh, np.float64)
             out["energy_loss_kwh_mean"] = float(np.mean(loss_kwh))

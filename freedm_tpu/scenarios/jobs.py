@@ -26,7 +26,9 @@ Each run records the ``qsts.job`` span; the engine's per-chunk
 thread-local stack.  Metrics: ``qsts_jobs_submitted_total``,
 ``qsts_jobs_total{outcome}``, ``qsts_jobs_running``,
 ``qsts_chunk_seconds``, ``qsts_scenario_steps_per_sec``,
-``qsts_resumes_total`` (:mod:`freedm_tpu.core.metrics`).
+``qsts_agent_steps_per_sec`` / ``qsts_agents_total`` (agent-population
+studies — docs/agents.md), ``qsts_resumes_total``
+(:mod:`freedm_tpu.core.metrics`).
 """
 
 from __future__ import annotations
@@ -59,6 +61,12 @@ MAX_STEPS = 100_000
 MAX_CHUNK_STEPS = 2048
 MAX_LANE_CELLS = 1_000_000  # scenarios * n_bus ceiling
 
+#: Agent-population defaults for the ``--qsts-agents-*`` config keys:
+#: population ceiling per job and scenarios*agents state-cell ceiling
+#: (the chunk carry materializes that many per-agent state lanes).
+DEFAULT_AGENTS_MAX = 1_000_000
+DEFAULT_AGENTS_CELLS_MAX = 4_000_000
+
 #: Topology sweep job bounds (``POST /v1/topo/sweep``): async sweeps
 #: may enumerate far past the sync endpoint's per-request cap, but the
 #: variant space must still be bounded up front.
@@ -72,18 +80,23 @@ _JOB_KEY_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
 _FIELDS = {
     "case", "scenarios", "steps", "dt_minutes", "seed", "profile",
     "chunk_steps", "warm_start", "max_iter", "job_key", "mesh_devices",
-    "pf_backend", "pf_precision",
+    "pf_backend", "pf_precision", "agents",
 }
 
 
 def parse_job_request(payload: dict, default_chunk_steps: int = 24,
-                      default_mesh_devices: int = 0):
+                      default_mesh_devices: int = 0,
+                      agents_max: int = DEFAULT_AGENTS_MAX,
+                      agents_cells_max: int = DEFAULT_AGENTS_CELLS_MAX):
     """``(StudySpec, job_key)`` from a JSON payload, every field range-
     checked with typed errors (mirrors ``serve.service.parse_request``).
 
     ``mesh_devices`` (request field, default from the server config)
     shards the scenario axis over that many local devices (-1 = all);
-    the scenario count must divide by the resolved device count."""
+    the scenario count must divide by the resolved device count.
+    ``agents`` (optional object — docs/agents.md) attaches a grid-edge
+    agent population, bounded by ``agents_max`` / ``agents_cells_max``
+    (the ``--qsts-agents-*`` server config keys)."""
     if not isinstance(payload, dict):
         raise InvalidRequest("request body must be a JSON object")
     unknown = set(payload) - _FIELDS
@@ -137,6 +150,14 @@ def parse_job_request(payload: dict, default_chunk_steps: int = 24,
             f"unknown pf_precision {pf_precision!r} "
             f"(have: {', '.join(PF_PRECISIONS)})"
         )
+    agents = None
+    if payload.get("agents") is not None:
+        from freedm_tpu.scenarios.agents import parse_agents_field
+
+        agents = parse_agents_field(
+            payload["agents"], scenarios,
+            max_agents=int(agents_max), max_cells=int(agents_cells_max),
+        )
     mesh_devices = _int("mesh_devices", int(default_mesh_devices), -1, 4096)
     if mesh_devices not in (0, 1):
         from freedm_tpu.parallel.mesh import resolve_device_count
@@ -162,13 +183,19 @@ def parse_job_request(payload: dict, default_chunk_steps: int = 24,
         case=case, scenarios=scenarios, steps=steps, dt_minutes=float(dt),
         seed=seed, profile=profile, chunk_steps=chunk_steps,
         warm_start=warm, max_iter=max_iter, mesh_devices=mesh_devices,
-        pf_backend=pf_backend, pf_precision=pf_precision,
+        pf_backend=pf_backend, pf_precision=pf_precision, agents=agents,
     )
     # Resolve the case NOW (typed error, and the lane-cell bound needs
     # its size); the engine built later resolves it again cheaply.
     from freedm_tpu.scenarios.engine import _resolve_case
 
     kind, case_obj = _resolve_case(case)
+    if agents is not None and kind != "bus":
+        raise InvalidRequest(
+            f"'agents' requires a bus case (got feeder case {case!r}): "
+            f"the ladder has no per-bus voltage state for agents to "
+            f"observe"
+        )
     n = case_obj.n_bus if kind == "bus" else case_obj.n_branches
     if scenarios * n > MAX_LANE_CELLS:
         raise InvalidRequest(
@@ -352,13 +379,17 @@ class JobManager:
                  checkpoint_dir: Optional[str] = None,
                  default_chunk_steps: int = 24,
                  default_mesh_devices: int = 0,
-                 default_topo_chunk: int = 4096):
+                 default_topo_chunk: int = 4096,
+                 agents_max: int = DEFAULT_AGENTS_MAX,
+                 agents_cells_max: int = DEFAULT_AGENTS_CELLS_MAX):
         self.workers = max(int(workers), 1)
         self.max_pending = max(int(max_pending), 1)
         self.checkpoint_dir = checkpoint_dir
         self.default_chunk_steps = int(default_chunk_steps)
         self.default_mesh_devices = int(default_mesh_devices)
         self.default_topo_chunk = int(default_topo_chunk)
+        self.agents_max = int(agents_max)
+        self.agents_cells_max = int(agents_cells_max)
         self._cond = threading.Condition()
         self._pending: deque = deque()
         self._jobs: "OrderedDict[str, JobRecord]" = OrderedDict()
@@ -398,6 +429,8 @@ class JobManager:
         spec, job_key = parse_job_request(
             payload, self.default_chunk_steps,
             default_mesh_devices=self.default_mesh_devices,
+            agents_max=self.agents_max,
+            agents_cells_max=self.agents_cells_max,
         )
         rec = JobRecord(id=os.urandom(8).hex(), spec=spec, job_key=job_key)
         rec.chunks_total = math.ceil(spec.steps / spec.chunk_steps)
@@ -566,6 +599,10 @@ class JobManager:
                       "scenarios": spec.scenarios, "steps": spec.steps},
             )
 
+        n_agents = (spec.agents.total()
+                    if not is_topo and getattr(spec, "agents", None)
+                    else 0)
+
         def on_chunk(done, total, chunk_s, lane_steps):
             rec.chunks_done = done
             rec.chunks_total = total
@@ -576,6 +613,13 @@ class JobManager:
                 obs.QSTS_CHUNK_SECONDS.observe(chunk_s)
                 if chunk_s > 0:
                     obs.QSTS_SCENARIO_RATE.set(lane_steps / chunk_s)
+                    if n_agents:
+                        # lane_steps is scenario-steps; every one stepped
+                        # the full agent population once.
+                        obs.QSTS_AGENT_RATE.set(
+                            lane_steps * n_agents / chunk_s)
+                if n_agents:
+                    obs.QSTS_AGENTS_TOTAL.set(n_agents)
             # Kind-scoped injection points: a schedule chaos-testing
             # QSTS studies must not also kill concurrent topo sweeps
             # (and vice versa) — docs/robustness.md.

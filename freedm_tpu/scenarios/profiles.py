@@ -27,6 +27,7 @@ scenario trajectories.
 
 from __future__ import annotations
 
+import zlib
 from dataclasses import dataclass
 from typing import Tuple
 
@@ -37,6 +38,25 @@ PROFILE_KINDS = ("residential", "commercial", "mixed")
 #: Floor on the load multiplier: a "night valley" scenario still draws
 #: something, and solvers never see an exactly-zero system.
 MIN_LOAD_MULT = 0.05
+
+
+def population_rng(seed: int, stream: str) -> np.random.Generator:
+    """The documented construction seam for populations built ON TOP of
+    a profile set (agent populations — :mod:`freedm_tpu.scenarios.agents`).
+
+    One study seed drives everything: the profile draws consume
+    ``default_rng(seed)`` in :class:`ProfileSet.__init__`'s fixed order,
+    and any sibling population derives an INDEPENDENT stream from the
+    same seed plus a stable stream label — so adding agents never
+    perturbs the profile bytes, and the same seed yields byte-identical
+    populations under any chunking (there is no second RNG convention
+    to keep in sync).  GL003 polices this seam: it is the only place
+    outside ``__init__`` where this package may construct an RNG, and
+    callers may draw from it only inside their own construction seams
+    (``build_population``).
+    """
+    return np.random.default_rng(np.random.SeedSequence(
+        [int(seed), zlib.crc32(stream.encode("utf-8"))]))
 
 
 @dataclass(frozen=True)
@@ -108,7 +128,11 @@ class ProfileSet:
         self.cloud_c = rng.uniform(7.0, 19.0, (s, spec.n_clouds))
         self.cloud_w = rng.uniform(0.08, 0.5, (s, spec.n_clouds))
         self.cloud_d = rng.uniform(0.2, 0.9, (s, spec.n_clouds))
-        # Bus-level draws: diversity jitter on the daily shape, PV siting.
+        # Bus-level draws: diversity jitter on the daily shape, PV
+        # siting.  Agent populations (scenarios/agents.py) reuse these
+        # as their per-bus diversity — siting bias from
+        # ``bus_residential``/``pv_cap``, micro-climate from
+        # ``bus_jitter_h`` — instead of inventing a second convention.
         self.bus_jitter_h = rng.uniform(-0.75, 0.75, nb)
         self.pv_cap = np.where(
             rng.uniform(0.0, 1.0, nb) < spec.pv_frac,

@@ -1,0 +1,157 @@
+"""CI smoke for agent-population QSTS jobs: submit, poll, verify.
+
+Starts a real :class:`~freedm_tpu.serve.ServeServer` with a
+:class:`~freedm_tpu.scenarios.jobs.JobManager` on an ephemeral port,
+submits a small closed-loop agent-population study on case14 through
+``POST /v1/qsts`` (the ``agents`` field — docs/agents.md), polls
+``GET /v1/jobs/<id>`` to completion, and sanity-asserts the agent
+summary rows (population count, agent-step rate, energy/Q aggregates)
+plus the ``qsts_agent_steps_per_sec`` / ``qsts_agents_total`` gauges on
+``GET /metrics``.  The typed-rejection paths the agents field adds are
+exercised too: unknown sub-field, feeder case, population over the
+``qsts_agents_max`` ceiling.  One command, exit code 0 iff healthy:
+
+    python -m freedm_tpu.tools.agents_smoke
+
+Used by ``.github/workflows/ci.yml``; also a handy local sanity check
+after touching the agents path.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Tuple
+
+POLL_TIMEOUT_S = 300.0
+
+
+def _post(port: int, path: str, payload: dict) -> Tuple[int, dict]:
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+def _get_raw(port: int, path: str) -> Tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, e.read()
+
+
+def _get(port: int, path: str) -> Tuple[int, dict]:
+    code, body = _get_raw(port, path)
+    return code, json.loads(body)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from freedm_tpu.scenarios.jobs import JobManager
+    from freedm_tpu.serve import ServeConfig, ServeServer, Service
+
+    svc = Service(ServeConfig(max_batch=4, buckets=(1, 4)))
+    jm = JobManager(workers=1).start()
+    srv = ServeServer(svc, port=0, jobs=jm).start()
+    print(f"[agents-smoke] server on port {srv.port}", flush=True)
+    failures: List[str] = []
+
+    def ok(name: str, cond: bool, detail: str = "") -> None:
+        print(f"[agents-smoke] {'ok  ' if cond else 'FAIL'} {name}  {detail}",
+              flush=True)
+        if not cond:
+            failures.append(name)
+
+    agents = {"ev": 60, "thermostat": 50, "inverter": 40, "dr": 30}
+    try:
+        code, d = _post(srv.port, "/v1/qsts", {
+            "case": "case14", "scenarios": 4, "steps": 24,
+            "dt_minutes": 60.0, "chunk_steps": 8, "seed": 3,
+            "agents": agents,
+        })
+        ok("submit_202", code == 202 and "job_id" in d, f"code={code} {d}")
+        job_id = d.get("job_id", "")
+        deadline = time.monotonic() + POLL_TIMEOUT_S
+        j = {}
+        while time.monotonic() < deadline:
+            code, j = _get(srv.port, f"/v1/jobs/{job_id}")
+            if code != 200 or j.get("state") in ("completed", "failed",
+                                                 "cancelled"):
+                break
+            time.sleep(0.5)
+        ok("job_completed", j.get("state") == "completed",
+           f"state={j.get('state')} error={j.get('error')}")
+        s = j.get("summary") or {}
+        ok("agents_total_stamped",
+           s.get("agents_total") == sum(agents.values()),
+           f"agents_total={s.get('agents_total')}")
+        ok("closed_loop_stamped", s.get("agents_closed_loop") is True,
+           f"closed={s.get('agents_closed_loop')}")
+        ok("agent_rate_stamped",
+           (s.get("agent_steps_per_sec") or 0) > 0,
+           f"rate={s.get('agent_steps_per_sec')}")
+        ok("agent_energy_finite",
+           math.isfinite(s.get("agent_energy_puh_mean", math.nan)),
+           f"energy={s.get('agent_energy_puh_mean')}")
+        ok("all_converged", s.get("lane_steps_not_converged") == 0,
+           f"nonconv={s.get('lane_steps_not_converged')}")
+
+        code, body = _get_raw(srv.port, "/metrics")
+        text = body.decode()
+        rate = total = None
+        for line in text.splitlines():
+            if line.startswith("qsts_agent_steps_per_sec "):
+                rate = float(line.split()[1])
+            elif line.startswith("qsts_agents_total "):
+                total = float(line.split()[1])
+        ok("metric_agent_rate", code == 200 and (rate or 0) > 0,
+           f"qsts_agent_steps_per_sec={rate}")
+        ok("metric_agents_total", total == sum(agents.values()),
+           f"qsts_agents_total={total}")
+
+        code, d = _post(srv.port, "/v1/qsts", {
+            "case": "case14", "scenarios": 2, "steps": 8,
+            "agents": {"evs": 5},
+        })
+        ok("typed_unknown_field",
+           code == 400 and d["error"]["type"] == "invalid_request",
+           f"code={code}")
+        code, d = _post(srv.port, "/v1/qsts", {
+            "case": "vvc_9bus", "scenarios": 2, "steps": 8,
+            "agents": {"ev": 5},
+        })
+        ok("typed_feeder_rejected",
+           code == 400 and d["error"]["type"] == "invalid_request",
+           f"code={code}")
+        code, d = _post(srv.port, "/v1/qsts", {
+            "case": "case14", "scenarios": 2, "steps": 8,
+            "agents": {"ev": 2_000_000},
+        })
+        ok("typed_over_ceiling",
+           code == 400 and d["error"]["type"] == "invalid_request",
+           f"code={code}")
+    finally:
+        srv.stop()
+        jm.stop()
+        svc.stop()
+    print(json.dumps({"agents_smoke_pass": not failures,
+                      "failed": failures}), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -248,6 +248,20 @@ def _qsts_feeder_chunk():
     return fn, (eng.initial_state(), s_re, s_im)
 
 
+def _qsts_agents_chunk():
+    from freedm_tpu.scenarios.agents import AgentSpec
+    from freedm_tpu.scenarios.engine import QstsEngine, StudySpec
+
+    eng = QstsEngine(StudySpec(
+        case="case14", scenarios=2, steps=8, chunk_steps=4, seed=7,
+        agents=AgentSpec(ev=6, thermostat=6, inverter=4, dr=4),
+    ))
+    fn = eng._build_bus_chunk(4)
+    p, q = eng._bus_injections(0, 4)
+    sig, hs, pop = eng._agent_arrays(0, 4)
+    return fn, (eng.initial_state(), p, q, sig, hs, pop)
+
+
 def _lb_round():
     import jax
     import jax.numpy as jnp
@@ -345,6 +359,15 @@ PROGRAM_REGISTRY: List[ProgramSpec] = [
     ProgramSpec("qsts/feeder_chunk", "freedm_tpu/scenarios/engine.py",
                 _qsts_feeder_chunk, f64=True,
                 donatable=tuple(range(8))),
+    # Agent-population chunk: the fused agent-step + Newton-solve scan
+    # body (docs/agents.md).  The carry grows to the 17-leaf
+    # AgentBusState (per-agent SoC/temperature/Q/engagement lanes ride
+    # the checkpointed state), all donated; the population itself is a
+    # runtime argument (GP003) and must NOT donate — it is reused
+    # unchanged every chunk.
+    ProgramSpec("qsts/agents_chunk", "freedm_tpu/scenarios/engine.py",
+                _qsts_agents_chunk, f64=True,
+                donatable=tuple(range(17))),
     ProgramSpec("lb/auction_round", "freedm_tpu/modules/lb.py",
                 _lb_round, f64=False),
 ]

@@ -3,11 +3,14 @@
 Bit-for-bit chunk-checkpoint resume (docs/scenarios.md) rests on two
 statically checkable facts:
 
-1. **All randomness in ``scenarios/profiles.py`` is drawn at
-   construction.**  ``ProfileSet.chunk(t0, t1)`` must be a pure
-   function of the timestep index; an RNG draw in any method other
-   than ``__init__`` makes the profile depend on chunking order and
-   silently breaks byte-identical resume.
+1. **All randomness in ``scenarios/profiles.py`` and
+   ``scenarios/agents.py`` is drawn at construction.**
+   ``ProfileSet.chunk(t0, t1)`` and the agent ``step`` functions must
+   be pure in the timestep index; an RNG draw anywhere but a declared
+   construction seam (:data:`CONSTRUCTION_SEAMS` — ``__init__``, the
+   ``population_rng`` derivation seam, ``build_population``) makes the
+   trajectory depend on chunking order and silently breaks
+   byte-identical resume.
 2. **Nothing feeding checkpoint identity reads clocks or RNG.**  The
    functions that serialize specs/state or name checkpoint files
    (``to_dict``/``from_dict``, ``state_to_jsonable``,
@@ -39,6 +42,16 @@ SEED_NAMES = {
 }
 SEED_SUBSTRINGS = ("checkpoint", "ckpt", "identity", "digest")
 
+#: Function names allowed to construct/consume RNGs in the policed
+#: construction-only files (profiles.py / agents.py): object
+#: constructors, the profiles-module stream-derivation seam, and the
+#: agent population builder.  Everything else must be pure in the
+#: timestep index.
+CONSTRUCTION_SEAMS = {"__init__", "population_rng", "build_population"}
+
+#: Files under scenarios/ whose randomness must be construction-only.
+CONSTRUCTION_FILES = ("profiles.py", "agents.py")
+
 IMPURE_PREFIX = (
     "time.", "random.", "numpy.random.", "datetime.", "uuid.",
 )
@@ -61,19 +74,22 @@ class ChunkPurity(Rule):
         scen_files = [project.files[r] for r in sorted(project.files)
                       if _is_scenarios(project.files[r].rel)]
         for fi in scen_files:
-            if fi.rel.endswith("profiles.py"):
+            if fi.rel.endswith(CONSTRUCTION_FILES):
                 yield from self._check_rng_in_profiles(fi)
         yield from self._check_checkpoint_identity(scen_files)
 
-    # -- rule 1: construction-only RNG in profiles.py ------------------------
+    # -- rule 1: construction-only RNG in profiles.py / agents.py -----------
     def _check_rng_in_profiles(self, fi: FileIndex) -> Iterable[Finding]:
-        # Names bound from np.random.default_rng(...) anywhere in the file.
+        # Names bound from np.random.default_rng(...) — or the profiles
+        # module's population_rng(...) seam — anywhere in the file.
         rng_names: Set[str] = set()       # rng = np.random.default_rng(...)
         rng_attrs: Set[str] = set()       # self.rng = np.random.default_rng(...)
         for node in ast.walk(fi.tree):
             if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
                 f = node.value.func
-                if isinstance(f, ast.Attribute) and f.attr == "default_rng":
+                fname = (f.attr if isinstance(f, ast.Attribute)
+                         else f.id if isinstance(f, ast.Name) else None)
+                if fname in ("default_rng", "population_rng"):
                     for t in node.targets:
                         if isinstance(t, ast.Name):
                             rng_names.add(t.id)
@@ -82,8 +98,9 @@ class ChunkPurity(Rule):
                                 t.value.id == "self":
                             rng_attrs.add(t.attr)
         for call in fi.calls:
-            in_init = call.func is not None and call.func.name == "__init__"
-            if in_init:
+            in_seam = (call.func is not None
+                       and call.func.name in CONSTRUCTION_SEAMS)
+            if in_seam:
                 continue
             is_draw = False
             if call.dotted is not None and call.dotted.startswith("numpy.random."):
@@ -98,7 +115,8 @@ class ChunkPurity(Rule):
                 yield self.finding(
                     fi.rel, call.lineno, call.col,
                     f"RNG draw `{'.'.join(call.chain or ('np.random',))}` "
-                    f"outside __init__ (in `{where}`): profile chunks must "
+                    f"outside __init__ or a declared construction seam "
+                    f"(in `{where}`): profile chunks and agent steps must "
                     f"be pure in the timestep index — draw once at "
                     f"construction",
                 )

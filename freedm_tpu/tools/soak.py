@@ -696,6 +696,35 @@ class TopoProbe(QstsProbe):
         return strip_topo_timing(summary)
 
 
+class AgentsProbe(QstsProbe):
+    """One agent-population QSTS job driven across the kill/restart
+    schedule — the grid-edge twin of :class:`QstsProbe`: the closed
+    loop's per-agent state lanes (EV SoC, thermostat temperature,
+    inverter Q, DR engagement) ride the chunk checkpoint, so the
+    killed-and-resumed study must STILL match the uninterrupted
+    reference exactly (docs/agents.md resume contract)."""
+
+    #: Two days of 15-min steps on case14 with a small mixed
+    #: population: long enough to straddle the kill, cheap enough for
+    #: a busy CPU slice stepping 180 agent lanes per scenario-step.
+    SPEC = {
+        "case": "case14", "scenarios": 4, "steps": 192,
+        "dt_minutes": 15.0, "chunk_steps": 24, "seed": 13,
+        "agents": {"ev": 60, "thermostat": 50, "inverter": 40, "dr": 30},
+        "job_key": "agentsprobe",
+    }
+
+    def reference_summary(self) -> Dict:
+        """The uninterrupted run, computed in THIS process (same jax
+        platform/dtype as the slices)."""
+        from freedm_tpu.scenarios.agents import AgentSpec
+        from freedm_tpu.scenarios.engine import StudySpec, run_study
+
+        spec = {k: v for k, v in self.SPEC.items() if k != "job_key"}
+        spec["agents"] = AgentSpec(**spec["agents"])
+        return run_study(StudySpec(**spec))
+
+
 def wait_for(procs: List[Proc], cond, timeout_s: float) -> bool:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
@@ -880,6 +909,7 @@ def run_soak(
     serve_load: bool = True,
     qsts_probe: bool = False,
     topo_probe: bool = False,
+    agents_probe: bool = False,
     chaos: bool = False,
 ) -> Dict:
     import tempfile
@@ -1030,6 +1060,19 @@ def run_soak(
                     tprobe.wait_chunks(1, timeout_s=form_timeout),
                     f"chunks_done={tprobe.chunks_before_kill}",
                 )
+        # Agent-population probe: the closed-loop study whose per-agent
+        # state lanes must survive the kill inside the checkpoint.
+        aprobe: Optional[AgentsProbe] = None
+        if agents_probe and member.spec.serve_port is not None:
+            aprobe = AgentsProbe(member.spec.serve_port)
+            check.record("agents_probe_submitted", aprobe.submit(),
+                         f"target={member.spec.uuid}")
+            if aprobe.submitted:
+                check.record(
+                    "agents_probe_checkpointed_before_kill",
+                    aprobe.wait_chunks(1, timeout_s=form_timeout),
+                    f"chunks_done={aprobe.chunks_before_kill}",
+                )
         kill_ts = time.time()
         member.kill()
         survivors = [p for p in procs if p.alive()]
@@ -1054,6 +1097,10 @@ def run_soak(
         if tprobe is not None and tprobe.submitted:
             check.record("topo_probe_resubmitted",
                          tprobe.submit(timeout_s=form_timeout),
+                         "same job_key after restart")
+        if aprobe is not None and aprobe.submitted:
+            check.record("agents_probe_resubmitted",
+                         aprobe.submit(timeout_s=form_timeout),
                          "same job_key after restart")
 
         # Kill the LEADER: re-election among survivors + slave VVC
@@ -1167,6 +1214,23 @@ def run_soak(
                     "qsts_probe_matches_reference", got == want,
                     f"killed-and-resumed summary vs uninterrupted: "
                     f"{'exact' if got == want else f'{got} != {want}'}",
+                )
+        if aprobe is not None and aprobe.submitted:
+            ajob = aprobe.wait(timeout_s=max(2.0 * form_timeout, 300.0))
+            a_completed = ajob.get("state") == "completed"
+            check.record(
+                "agents_probe_completes", a_completed,
+                f"state={ajob.get('state')} err={ajob.get('error')}",
+            )
+            if a_completed:
+                aref = aprobe.reference_summary()
+                agot = AgentsProbe.strip_timing(ajob["summary"])
+                awant = AgentsProbe.strip_timing(aref)
+                check.record(
+                    "agents_probe_matches_reference", agot == awant,
+                    "killed-and-resumed agent study vs uninterrupted: "
+                    + ("exact" if agot == awant
+                       else f"{agot} != {awant}"),
                 )
 
         # SLO verdict: the member-kill schedule restarts two slices,
@@ -1412,6 +1476,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the topology-sweep kill/resume probe")
     ap.add_argument("--no-qsts-probe", action="store_true",
                     help="skip the QSTS kill/resume determinism probe")
+    ap.add_argument("--no-agents-probe", action="store_true",
+                    help="skip the agent-population kill/resume probe")
     ap.add_argument("--chaos", action="store_true",
                     help="also run the replicated-serving chaos phase "
                          "(3 replicas + router, deterministic kill "
@@ -1423,6 +1489,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         serve_load=not args.no_serve_load,
         qsts_probe=not args.no_qsts_probe,
         topo_probe=not args.no_topo_probe,
+        agents_probe=not args.no_agents_probe,
         chaos=args.chaos,
     )
     return 0 if artifact["pass"] else 1

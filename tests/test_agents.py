@@ -1,0 +1,458 @@
+"""Grid-edge agent populations (docs/agents.md): per-kind step physics
+vs plain-Python oracles, construction determinism, closed-loop vs
+replayed divergence, SIGKILL resume, mesh-vs-vmap byte identity, and
+the typed validation surfaces.
+
+Mesh sizing note: the byte-identity halves run at S = 2·D2 (local
+batch >= 2) — at local batch 1 the CPU backend's vectorization
+re-tiles and even the agent-free engine moves by ~eps (see
+tests/test_mesh.py's module docstring for the same constraint).
+"""
+
+import math
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import replace
+
+import jax
+import numpy as np
+import pytest
+from jax.experimental import enable_x64
+
+from freedm_tpu.scenarios.agents import (
+    AgentSpec,
+    AMB_MEAN_C,
+    AMB_PEAK_H,
+    AMB_SWING_C,
+    DR_TAU_H,
+    EV_V_FULL,
+    EV_V_MIN,
+    build_population,
+    dr_signal,
+    dr_step,
+    ev_step,
+    inverter_step,
+    parse_agents_field,
+    population_step,
+    thermostat_step,
+    validate_agent_spec,
+)
+from freedm_tpu.scenarios.engine import StudySpec, run_study, strip_timing
+from freedm_tpu.scenarios.jobs import parse_job_request
+from freedm_tpu.scenarios.profiles import ProfileSet, ProfileSpec
+from freedm_tpu.serve import InvalidRequest
+
+D = jax.local_device_count()
+D2 = max(d for d in (1, 2, 4) if d <= D and D % d == 0)
+needs_mesh = pytest.mark.skipif(D2 < 2, reason="single-device host")
+
+_AGENTS = AgentSpec(ev=12, thermostat=10, inverter=8, dr=6)
+_SPEC = dict(case="case14", scenarios=4, steps=12, dt_minutes=60.0,
+             chunk_steps=4, seed=7)
+
+
+@pytest.fixture(scope="module")
+def small_world():
+    """A (profiles, population) pair on a 6-bus synthetic injection."""
+    profiles = ProfileSet(ProfileSpec(scenarios=2, steps=8, seed=5), 6)
+    p0 = np.array([-1.0, -0.5, 0.0, -2.0, -0.3, 0.2])
+    pop, state0, events = build_population(_AGENTS, profiles, p0)
+    return profiles, p0, pop, state0, events
+
+
+# ---------------------------------------------------------------------------
+# per-kind step oracles: the jax steps vs independent plain-Python math
+# ---------------------------------------------------------------------------
+
+
+def _row(prm, i):
+    return type(prm)(*(np.asarray(f)[i] for f in prm))
+
+
+def _ev_ref(soc, v, h, prm, dt):
+    if prm.arr_h <= prm.dep_h:
+        present = prm.arr_h <= h < prm.dep_h
+    else:
+        present = h >= prm.arr_h or h < prm.dep_h
+    droop = min(max((v - EV_V_MIN) / (EV_V_FULL - EV_V_MIN), 0.0), 1.0)
+    p_chg = prm.rate_pu * droop * (1.0 if present and soc < 1.0 else 0.0)
+    soc_next = min(soc + p_chg * dt / prm.cap_puh, 1.0) if present \
+        else prm.soc0
+    return soc_next, -p_chg, 0.0
+
+
+def test_ev_step_matches_python_oracle(small_world):
+    _, _, pop, _, _ = small_world
+    dt = 0.25
+    for i in range(pop.ev.bus.shape[0]):
+        prm = _row(pop.ev, i)
+        # Sample hours inside/outside the session window and voltages
+        # across the droop: full rate, partial, fully shed.
+        for h in (0.0, prm.arr_h, (prm.arr_h + 1.0) % 24.0,
+                  (prm.dep_h + 1.0) % 24.0):
+            for v in (1.0, 0.91, 0.8):
+                for soc in (0.3, 1.0):
+                    got = ev_step(soc, v, h, prm, dt)
+                    want = _ev_ref(soc, v, h, prm, dt)
+                    np.testing.assert_allclose(
+                        [float(x) for x in got], want, rtol=1e-12,
+                        err_msg=f"agent {i} h={h} v={v} soc={soc}")
+
+
+def _th_ref(temp, on, h, prm, dt):
+    if temp > prm.set_c + 0.5 * prm.db_c:
+        on_next = 1.0
+    elif temp < prm.set_c - 0.5 * prm.db_c:
+        on_next = 0.0
+    else:
+        on_next = on
+    amb = AMB_MEAN_C + prm.amb_off_c + AMB_SWING_C * math.cos(
+        2.0 * math.pi * (h - AMB_PEAK_H) / 24.0)
+    a = math.exp(-dt / prm.tau_h)
+    temp_next = amb + (temp - amb) * a - prm.gain_c * (1.0 - a) * on_next
+    return temp_next, on_next, -prm.p_pu * on_next
+
+
+def test_thermostat_step_matches_python_oracle(small_world):
+    _, _, pop, _, _ = small_world
+    dt = 0.25
+    for i in range(pop.th.bus.shape[0]):
+        prm = _row(pop.th, i)
+        # Above band (must switch on), below band (off), inside the
+        # deadband with both relay histories (hysteresis holds).
+        cases = [(prm.set_c + prm.db_c, 0.0), (prm.set_c - prm.db_c, 1.0),
+                 (prm.set_c, 0.0), (prm.set_c, 1.0)]
+        for temp, on in cases:
+            for h in (3.0, 15.0):
+                (t2, on2), p, q = thermostat_step(temp, on, 1.0, h, prm, dt)
+                wt, won, wp = _th_ref(temp, on, h, prm, dt)
+                np.testing.assert_allclose(
+                    [float(t2), float(on2), float(p), float(q)],
+                    [wt, won, wp, 0.0], rtol=1e-12,
+                    err_msg=f"agent {i} temp={temp} on={on} h={h}")
+                if temp == prm.set_c:
+                    assert float(on2) == on  # deadband holds the relay
+
+
+def _inv_ref(q, v, prm, dt):
+    rise = min(max((prm.v2 - v) / (prm.v2 - prm.v1), 0.0), 1.0)
+    fall = min(max((v - prm.v3) / (prm.v4 - prm.v3), 0.0), 1.0)
+    q_tgt = prm.qmax_pu * (rise - fall)
+    return q + (1.0 - math.exp(-dt / prm.tau_h)) * (q_tgt - q)
+
+
+def test_inverter_step_matches_python_oracle(small_world):
+    _, _, pop, _, _ = small_world
+    dt = 0.25
+    for i in range(pop.inv.bus.shape[0]):
+        prm = _row(pop.inv, i)
+        mid_rise = 0.5 * (prm.v1 + prm.v2)
+        mid_fall = 0.5 * (prm.v3 + prm.v4)
+        for v in (prm.v1 - 0.02, mid_rise, 1.0, mid_fall, prm.v4 + 0.02):
+            for q in (0.0, 0.5 * prm.qmax_pu):
+                q2, p, qi = inverter_step(q, v, 12.0, prm, dt)
+                want = _inv_ref(q, v, prm, dt)
+                np.testing.assert_allclose(float(q2), want, rtol=1e-12)
+                assert float(p) == 0.0 and float(qi) == float(q2)
+        # Curve shape: deep undervoltage asymptotes to +qmax, deep
+        # overvoltage to -qmax, deadband target is zero.
+        q_lo = _inv_ref(0.0, prm.v1 - 0.1, prm, 1e9)
+        q_hi = _inv_ref(0.0, prm.v4 + 0.1, prm, 1e9)
+        np.testing.assert_allclose(q_lo, prm.qmax_pu, rtol=1e-9)
+        np.testing.assert_allclose(q_hi, -prm.qmax_pu, rtol=1e-9)
+
+
+def _dr_ref(eng, sig, prm, dt):
+    eng2 = eng + (1.0 - math.exp(-dt / DR_TAU_H)) * (sig * prm.comply - eng)
+    return eng2, -prm.p_pu * (1.0 - prm.depth * eng2)
+
+
+def test_dr_step_matches_python_oracle(small_world):
+    _, _, pop, _, _ = small_world
+    dt = 0.25
+    for i in range(pop.dr.bus.shape[0]):
+        prm = _row(pop.dr, i)
+        for sig in (0.0, 1.0):
+            for eng in (0.0, 0.4, 1.0):
+                e2, p, q = dr_step(eng, sig, 12.0, prm, dt)
+                we, wp = _dr_ref(eng, sig, prm, dt)
+                np.testing.assert_allclose(
+                    [float(e2), float(p), float(q)], [we, wp, 0.0],
+                    rtol=1e-12)
+        if not prm.comply:
+            # Non-compliant agents never engage.
+            e2, p, _ = dr_step(0.0, 1.0, 12.0, prm, dt)
+            assert float(e2) == 0.0
+
+
+def test_population_step_aggregates_per_bus(small_world):
+    """segment_sum aggregation == a plain-Python per-bus accumulation
+    of the same per-agent injections."""
+    _, _, pop, state0, _ = small_world
+    n_bus, dt, h, sig = 6, 0.25, 18.5, 1.0
+    obs_v = np.linspace(0.9, 1.06, n_bus)
+    ag2, p_bus, q_bus, served, q_peak = population_step(
+        pop, state0, obs_v, sig, h, dt, n_bus)
+    wp = np.zeros(n_bus)
+    wq = np.zeros(n_bus)
+    for i in range(pop.ev.bus.shape[0]):
+        prm = _row(pop.ev, i)
+        _, p, _ = _ev_ref(state0.ev_soc[i], obs_v[prm.bus], h, prm, dt)
+        wp[prm.bus] += p
+    for i in range(pop.th.bus.shape[0]):
+        prm = _row(pop.th, i)
+        _, _, p = _th_ref(state0.th_temp[i], state0.th_on[i], h, prm, dt)
+        wp[prm.bus] += p
+    q_abs = []
+    for i in range(pop.inv.bus.shape[0]):
+        prm = _row(pop.inv, i)
+        q = _inv_ref(state0.inv_q[i], obs_v[prm.bus], prm, dt)
+        wq[prm.bus] += q
+        q_abs.append(abs(q))
+    for i in range(pop.dr.bus.shape[0]):
+        prm = _row(pop.dr, i)
+        _, p = _dr_ref(state0.dr_eng[i], sig, prm, dt)
+        wp[prm.bus] += p
+    np.testing.assert_allclose(np.asarray(p_bus), wp, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(q_bus), wq, rtol=1e-12)
+    np.testing.assert_allclose(float(served), -wp.sum(), rtol=1e-12)
+    np.testing.assert_allclose(float(q_peak), max(q_abs), rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# construction determinism
+# ---------------------------------------------------------------------------
+
+
+def test_same_seed_builds_byte_identical_population(small_world):
+    profiles, p0, pop, state0, events = small_world
+    pop2, state2, events2 = build_population(_AGENTS, profiles, p0)
+    for a, b in ((pop, pop2), (state0, state2), (events, events2)):
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+    # A different seed moves the draws.
+    other = ProfileSet(ProfileSpec(scenarios=2, steps=8, seed=6), 6)
+    pop3, _, _ = build_population(_AGENTS, other, p0)
+    assert pop3.ev.arr_h.tobytes() != pop.ev.arr_h.tobytes()
+    # Attaching agents never perturbs the profile bytes: the agent
+    # stream is independent of the profile draws (population_rng seam).
+    again = ProfileSet(ProfileSpec(scenarios=2, steps=8, seed=5), 6)
+    assert again.scale.tobytes() == profiles.scale.tobytes()
+
+
+def test_dr_signal_is_pure_in_index_and_wraps(small_world):
+    profiles, _, _, _, events = small_world
+    h_all = profiles.hours(0, 8)
+    sig_all = dr_signal(events, h_all)
+    # Chunked evaluation is byte-identical to the full window.
+    sig_chunks = np.concatenate([
+        dr_signal(events, profiles.hours(0, 3)),
+        dr_signal(events, profiles.hours(3, 8)),
+    ])
+    assert sig_all.tobytes() == sig_chunks.tobytes()
+    # A window straddling midnight is active on both sides.
+    from freedm_tpu.scenarios.agents import DrEvents
+
+    ev = DrEvents(start_h=np.array([[23.5]]), dur_h=np.array([[1.0]]))
+    sig = dr_signal(ev, np.array([23.0, 23.75, 0.25, 0.75]))
+    assert sig[:, 0].tolist() == [0.0, 1.0, 1.0, 0.0]
+
+
+# ---------------------------------------------------------------------------
+# typed validation surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_validate_agent_spec_is_typed():
+    validate_agent_spec(AgentSpec(ev=1))
+    for bad in (
+        AgentSpec(),                       # empty population
+        AgentSpec(ev=-1),
+        AgentSpec(ev=True),                # bool is not a count
+        AgentSpec(ev=1, dr_events=9),      # past MAX_DR_EVENTS
+        AgentSpec(ev=1, ev_frac=1.5),
+        AgentSpec(ev=1, dr_depth=-0.1),
+        AgentSpec(ev=1, closed_loop=1),    # not a bool
+    ):
+        with pytest.raises(ValueError):
+            validate_agent_spec(bad)
+
+
+def test_parse_agents_field_is_typed():
+    spec = parse_agents_field({"ev": 3, "closed_loop": False}, 2,
+                              max_agents=100, max_cells=1000)
+    assert spec.ev == 3 and spec.closed_loop is False
+    for bad in (
+        "not-an-object",
+        {"evs": 3},                        # unknown field
+        {"ev": "three"},                   # constructor TypeError
+        {"ev": 0},                         # empty population
+        {"ev": 200},                       # over max_agents
+        {"ev": 90},                        # 2 * 90 > max_cells=100
+    ):
+        with pytest.raises(InvalidRequest):
+            parse_agents_field(bad, 2, max_agents=100, max_cells=100)
+
+
+def test_jobs_api_threads_agents_spec():
+    spec, _ = parse_job_request({"case": "case14", "scenarios": 2,
+                                 "steps": 8, "agents": {"ev": 5}})
+    assert spec.agents.ev == 5
+    d = spec.to_dict()
+    assert isinstance(d["agents"], dict)
+    assert StudySpec.from_dict(d) == spec  # checkpoint-identity roundtrip
+    with pytest.raises(InvalidRequest):
+        parse_job_request({"case": "vvc_9bus", "scenarios": 2, "steps": 8,
+                           "agents": {"ev": 5}})  # feeder case
+
+
+def test_engine_rejects_feeder_case():
+    with pytest.raises(ValueError, match="bus case"):
+        run_study(StudySpec(case="vvc_9bus", scenarios=2, steps=4,
+                            chunk_steps=2, agents=AgentSpec(ev=2)))
+
+
+# ---------------------------------------------------------------------------
+# closed-loop studies: summary, divergence, resume
+# ---------------------------------------------------------------------------
+
+
+def test_agent_summary_stamped_and_chunking_invariant():
+    s = run_study(StudySpec(agents=_AGENTS, **_SPEC))
+    assert s["agents_total"] == _AGENTS.total()
+    assert s["agents_closed_loop"] is True
+    assert s["agent_energy_puh_mean"] > 0
+    assert s["agent_steps_per_sec"] > 0
+    assert s["lane_steps_not_converged"] == 0
+    # Different chunking, identical physics (chunk counts aside).
+    other = run_study(StudySpec(agents=_AGENTS,
+                                **{**_SPEC, "chunk_steps": 5}))
+    drop = ("chunks_total", "compiles")
+    a = {k: v for k, v in strip_timing(s).items() if k not in drop}
+    b = {k: v for k, v in strip_timing(other).items() if k not in drop}
+    assert a == b
+
+
+def test_closed_loop_diverges_from_replayed():
+    closed = run_study(StudySpec(agents=_AGENTS, **_SPEC))
+    replayed = run_study(StudySpec(
+        agents=replace(_AGENTS, closed_loop=False), **_SPEC))
+    assert replayed["agents_closed_loop"] is False
+    # The flat 1.0 pu observation sits in every inverter's deadband.
+    assert replayed["agent_q_peak_pu"] == 0.0
+    assert closed["agent_q_peak_pu"] > 0.0
+    assert closed["energy_loss_mwh_mean"] != replayed["energy_loss_mwh_mean"]
+
+
+def test_resume_from_chunk_checkpoint_is_exact(tmp_path):
+    ck = str(tmp_path / "study.json")
+    spec = StudySpec(agents=_AGENTS, **_SPEC)
+    partial = run_study(spec, checkpoint_path=ck, stop_after_chunks=1)
+    assert partial["completed"] is False
+    resumed = run_study(spec, checkpoint_path=ck)
+    assert resumed["resumed_from_chunk"] == 1
+    assert strip_timing(resumed) == strip_timing(run_study(spec))
+
+
+def test_mismatched_agent_spec_restarts_clean(tmp_path):
+    ck = str(tmp_path / "study.json")
+    run_study(StudySpec(agents=_AGENTS, **_SPEC), checkpoint_path=ck,
+              stop_after_chunks=1)
+    other = StudySpec(agents=replace(_AGENTS, ev=13), **_SPEC)
+    s = run_study(other, checkpoint_path=ck)
+    assert s["resumed_from_chunk"] == 0 and s["completed"]
+
+
+_CHILD = """
+import os, sys
+from freedm_tpu.scenarios.agents import AgentSpec
+from freedm_tpu.scenarios.engine import StudySpec, run_study
+spec = StudySpec(case="case14", scenarios=4, steps=48, dt_minutes=15.0,
+                 chunk_steps=4, seed=7,
+                 agents=AgentSpec(ev=12, thermostat=10, inverter=8, dr=6))
+run_study(spec, checkpoint_path=sys.argv[1])
+"""
+
+
+def test_resume_after_sigkill_mid_study_is_exact(tmp_path):
+    """A real SIGKILL (no cleanup, no atexit) mid-study: the chunk
+    checkpoint on disk must resume to the exact uninterrupted summary
+    in THIS process — cross-process bit determinism."""
+    ck = str(tmp_path / "study.json")
+    # Match conftest's config: the child must write its checkpoint at
+    # the same precision this process resumes at.
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_ENABLE_X64="1")
+    child = subprocess.Popen([sys.executable, "-c", _CHILD, ck], env=env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            if os.path.exists(ck) or child.poll() is not None:
+                break
+            time.sleep(0.005)
+        assert os.path.exists(ck), "child never wrote a chunk checkpoint"
+        if child.poll() is None:
+            child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    spec = StudySpec(case="case14", scenarios=4, steps=48, dt_minutes=15.0,
+                     chunk_steps=4, seed=7, agents=_AGENTS)
+    resumed = run_study(spec, checkpoint_path=ck)
+    assert resumed["resumed_from_chunk"] >= 1
+    assert resumed["completed"]
+    assert strip_timing(resumed) == strip_timing(run_study(spec))
+
+
+# ---------------------------------------------------------------------------
+# mesh: sharded == unsharded, checkpoints placement-free
+# ---------------------------------------------------------------------------
+
+
+@needs_mesh
+def test_mesh_agent_summary_byte_identical():
+    # Byte identity is the f32 contract (test_mesh.py's convention);
+    # local batch 2 (see module docstring).
+    spec = dict(_SPEC, scenarios=2 * D2)
+    with enable_x64(False):
+        sharded = run_study(StudySpec(agents=_AGENTS, mesh_devices=D2,
+                                      **spec))
+        unsharded = run_study(StudySpec(agents=_AGENTS, **spec))
+        assert sharded["mesh_devices"] == D2
+        assert strip_timing(sharded) == strip_timing(unsharded)
+
+
+@needs_mesh
+def test_mesh_agent_summary_close_in_x64():
+    # The x64 cousin: equal except GEMM-derived floats at 1e-12.
+    spec = dict(_SPEC, scenarios=2 * D2)
+    a = strip_timing(run_study(StudySpec(agents=_AGENTS, **spec)))
+    b = strip_timing(run_study(StudySpec(agents=_AGENTS, mesh_devices=D2,
+                                         **spec)))
+    assert set(a) == set(b)
+    for k in a:
+        if isinstance(a[k], float):
+            np.testing.assert_allclose(b[k], a[k], rtol=1e-12, err_msg=k)
+        else:
+            assert a[k] == b[k], k
+
+
+@needs_mesh
+def test_mesh_agent_checkpoint_is_placement_free(tmp_path):
+    ck = str(tmp_path / "study.json")
+    spec = dict(_SPEC, scenarios=2 * D2)
+    with enable_x64(False):
+        # Kill on a D2-device mesh, resume on a single device.
+        run_study(StudySpec(agents=_AGENTS, mesh_devices=D2, **spec),
+                  checkpoint_path=ck, stop_after_chunks=1)
+        resumed = run_study(StudySpec(agents=_AGENTS, **spec),
+                            checkpoint_path=ck)
+        assert resumed["resumed_from_chunk"] == 1
+        uninterrupted = run_study(StudySpec(agents=_AGENTS, **spec))
+        assert strip_timing(resumed) == strip_timing(uninterrupted)

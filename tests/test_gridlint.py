@@ -230,6 +230,54 @@ def test_gl003_clean_fixture_passes(tmp_path):
     assert res.findings == []
 
 
+# agents.py is policed by the same construction-only contract: draws
+# are allowed only in the declared seams (__init__, population_rng,
+# build_population); a draw in an agent step breaks chunk-invariant
+# resume exactly like one in a profile chunk.
+GL003_AGENTS_BAD = """
+    import numpy as np
+
+    def population_rng(seed, stream):
+        return np.random.default_rng(seed)
+
+    def build_population(spec):
+        rng = population_rng(spec, "agents")
+        return rng.uniform(0.0, 1.0, 8)
+
+    def ev_step(soc, obs_v, h):
+        rng = np.random.default_rng(0)
+        return soc + rng.normal()  # draw inside a step function
+"""
+
+GL003_AGENTS_CLEAN = """
+    import numpy as np
+
+    def population_rng(seed, stream):
+        return np.random.default_rng(seed)
+
+    def build_population(spec):
+        rng = population_rng(spec, "agents")
+        return rng.uniform(0.0, 1.0, 8)
+
+    def ev_step(soc, obs_v, h, prm):
+        return min(soc + prm * obs_v * h, 1.0)
+"""
+
+
+def test_gl003_flags_agent_step_draws(tmp_path):
+    _write(tmp_path, "scenarios/agents.py", GL003_AGENTS_BAD)
+    res = _lint(tmp_path, rules=["GL003"])
+    assert _rules_of(res) == ["GL003"]
+    msgs = " ".join(f.message for f in res.findings)
+    assert "ev_step" in msgs and "outside __init__" in msgs
+
+
+def test_gl003_agents_construction_seams_pass(tmp_path):
+    _write(tmp_path, "scenarios/agents.py", GL003_AGENTS_CLEAN)
+    res = _lint(tmp_path, rules=["GL003"])
+    assert res.findings == []
+
+
 # ---------------------------------------------------------------------------
 # GL004 config threading
 # ---------------------------------------------------------------------------
