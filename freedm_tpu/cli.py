@@ -69,6 +69,7 @@ class Runtime:
     qsts_jobs: Optional[object] = None  # scenarios.JobManager (--serve-port)
     slo_monitor: Optional[object] = None  # slo.SloMonitor (--slo-enabled)
     router_server: Optional[object] = None  # serve.router (--router-port)
+    snapshot_coord: Optional[object] = None  # SnapshotCoordinator (--federate)
 
     def start(self) -> "Runtime":
         if self.endpoint is not None:
@@ -89,6 +90,14 @@ class Runtime:
             # /slo.
             if slo_mod.MONITOR is self.slo_monitor:
                 slo_mod.install(None)
+        if self.snapshot_coord is not None:
+            from freedm_tpu.core import snapshot as snap_mod
+
+            # Un-publish before the endpoint dies so a late POST
+            # /snapshot on the metrics server gets a typed "no
+            # coordinator" answer, not a cut over a dead socket.
+            if snap_mod.COORDINATOR is self.snapshot_coord:
+                snap_mod.install(None)
         if self.endpoint is not None:
             self.endpoint.stop()
         if self.router_server is not None:
@@ -261,6 +270,17 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--router-breaker-cooldown-s", type=float, default=None,
                     metavar="S", help="breaker open -> half-open cooldown "
                                       "(default 2)")
+    ap.add_argument("--snapshot-timeout-s", type=float, default=None,
+                    metavar="S",
+                    help="consistent-cut snapshot deadline: a cut that "
+                         "cannot assemble within S seconds is abandoned "
+                         "as a typed snapshot.incomplete event, never a "
+                         "wedge (default 10; docs/snapshots.md)")
+    ap.add_argument("--snapshot-max-bytes", type=int, default=None,
+                    metavar="N",
+                    help="byte ceiling on one node's contribution to an "
+                         "assembled cut; oversized recorded-message lists "
+                         "are trimmed to counts (default 4000000)")
     ap.add_argument("--serve-port", type=int, default=None, metavar="PORT",
                     help="serve the JSON what-if query API (pf/N-1/VVC) on "
                          "PORT (0 = ephemeral; unset = disabled)")
@@ -429,6 +449,8 @@ def _load_config(args: argparse.Namespace) -> GlobalConfig:
         ("router_probe_interval_s", "router_probe_interval_s"),
         ("router_breaker_failures", "router_breaker_failures"),
         ("router_breaker_cooldown_s", "router_breaker_cooldown_s"),
+        ("snapshot_timeout_s", "snapshot_timeout_s"),
+        ("snapshot_max_bytes", "snapshot_max_bytes"),
         ("serve_port", "serve_port"), ("serve_max_batch", "serve_max_batch"),
         ("serve_max_wait_ms", "serve_max_wait_ms"),
         ("serve_queue_depth", "serve_queue_depth"),
@@ -713,6 +735,21 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
         broker.attach_clock_sync(
             ClockSynchronizer(cfg.uuid, federation.known, endpoint.send)
         )
+    snapshot_coord = None
+    if endpoint is not None:
+        # Consistent-cut observatory (core/snapshot.py): the federation
+        # endpoint doubles as the marker channel, the broker's module
+        # walk is the local-state provider.  Installed globally so the
+        # metrics server's POST /snapshot can initiate a cut.
+        from freedm_tpu.core import snapshot as snap_mod
+
+        snapshot_coord = snap_mod.SnapshotCoordinator(
+            endpoint,
+            state_provider=broker.snapshot_state,
+            timeout_s=cfg.snapshot_timeout_s,
+            max_bytes=cfg.snapshot_max_bytes,
+        )
+        snap_mod.install(snapshot_coord)
     from freedm_tpu.runtime.telemetry import TelemetryModule
 
     telemetry = TelemetryModule()
@@ -814,6 +851,8 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
                 probe_interval_s=cfg.router_probe_interval_s,
                 breaker_failures=cfg.router_breaker_failures,
                 breaker_cooldown_s=cfg.router_breaker_cooldown_s,
+                snapshot_timeout_s=cfg.snapshot_timeout_s,
+                snapshot_max_bytes=cfg.snapshot_max_bytes,
             )),
             port=cfg.router_port,
         ).start()
@@ -859,7 +898,7 @@ def build_runtime(cfg: GlobalConfig, timings: Optional[Timings] = None) -> Runti
     return Runtime(
         cfg, timings, broker, fleet, factories, vvc, endpoint, federation,
         telemetry, mesh_mod, metrics_server, serve_service, serve_server,
-        qsts_jobs, slo_monitor, router_server,
+        qsts_jobs, slo_monitor, router_server, snapshot_coord,
     )
 
 

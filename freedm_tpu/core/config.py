@@ -354,6 +354,13 @@ class GlobalConfig:
     # the open -> half-open cooldown.
     router_breaker_failures: int = 3
     router_breaker_cooldown_s: float = 2.0
+    # Consistent-cut snapshots (core/snapshot.py, docs/snapshots.md):
+    # bound on how long one Chandy-Lamport cut may take before the
+    # initiator abandons it as a typed snapshot.incomplete (never a
+    # wedge), and a byte ceiling on any single node's contribution to
+    # an assembled cut document.
+    snapshot_timeout_s: float = 10.0
+    snapshot_max_bytes: int = 4_000_000
     # Profiling registry (freedm_tpu.core.profiling): per-(workload,
     # shape-bucket) jit compile accounting, device-memory peaks, and
     # host hot-path timers, exported as profile_* metrics and the

@@ -496,6 +496,7 @@ class JsonlEventJournal:
                  max_bytes: int = 50_000_000):
         self._lock = threading.RLock()
         self._ring: deque = deque(maxlen=int(capacity))
+        self._seq = 0
         self._fh = None
         self._written = 0
         self.path: Optional[str] = None
@@ -529,8 +530,13 @@ class JsonlEventJournal:
     def emit(self, event: str, **fields) -> dict:
         rec = {"ts": round(time.time(), 6), "event": event}
         rec.update(fields)
-        line = json.dumps(rec, default=str)
         with self._lock:
+            # Monotone per-journal sequence number: the ``/events?since=``
+            # cursor tooling (snapshot assembly, soak probes) tails the
+            # ring incrementally instead of re-reading it whole.
+            self._seq += 1
+            rec["seq"] = self._seq
+            line = json.dumps(rec, default=str)
             self._ring.append(rec)
             if self._fh is not None:
                 if self._written and self._written + len(line) + 1 > self.max_bytes:
@@ -551,6 +557,16 @@ class JsonlEventJournal:
         with self._lock:
             items = list(self._ring)
         return items[-int(n):]
+
+    def since(self, seq: int) -> List[dict]:
+        """Every ring record with ``seq`` strictly greater than the
+        cursor, oldest first — pass the last record's ``seq`` back to
+        resume.  Records that aged out of the ring before being read
+        are gone (the cursor can observe the gap: the first returned
+        ``seq`` jumps past ``cursor + 1``)."""
+        cursor = int(seq)
+        with self._lock:
+            return [r for r in self._ring if r.get("seq", 0) > cursor]
 
     def __len__(self) -> int:
         with self._lock:
@@ -588,7 +604,10 @@ class MetricsServer(BackgroundHttpServer):
     """Zero-dependency exposition endpoint (``--metrics-port``).
 
     ``GET /metrics`` — Prometheus text format of the registry;
-    ``GET /events?n=K`` — the journal's newest K events as JSONL;
+    ``GET /events?n=K`` — the journal's newest K events as JSONL
+    (``?since=<seq>`` instead returns everything after that journal
+    sequence number, oldest first — the cursor snapshot/soak tooling
+    tails with);
     ``GET /trace?n=K[&trace_id=T]`` — the tracing flight recorder's
     newest K records as JSONL (``freedm_tpu.core.tracing``; empty until
     tracing is enabled);
@@ -605,6 +624,11 @@ class MetricsServer(BackgroundHttpServer):
     ``POST /profile/capture?ms=N`` — capture a :mod:`jax.profiler`
     trace for N milliseconds into a TensorBoard-loadable directory
     (409 while a capture is already running);
+    ``GET /snapshot[?id=S]`` — the installed snapshot coordinator's
+    status, or the stored cut document for snapshot ``S``;
+    ``POST /snapshot`` — initiate a Chandy–Lamport fleet snapshot via
+    the installed coordinator (``freedm_tpu.core.snapshot``; 409 while
+    one is in flight, 503 until a coordinator is installed);
     anything else — a one-line index.  Runs ``http.server`` on a daemon
     thread; ``port=0`` binds an ephemeral port (read it back from
     ``.port``).
@@ -638,9 +662,15 @@ class MetricsServer(BackgroundHttpServer):
                                 "text/plain; version=0.0.4; charset=utf-8")
                 elif url.path == "/events":
                     q = parse_qs(url.query)
-                    n = int(q.get("n", ["100"])[0])
+                    if "since" in q:
+                        # Cursor pagination: everything after the given
+                        # journal seq, oldest first (tooling resumes by
+                        # passing the last seen seq back).
+                        recs = jnl.since(int(q["since"][0]))
+                    else:
+                        recs = jnl.tail(int(q.get("n", ["100"])[0]))
                     body = "\n".join(
-                        json.dumps(e, default=str) for e in jnl.tail(n)
+                        json.dumps(e, default=str) for e in recs
                     )
                     self._reply(200, body + ("\n" if body else ""),
                                 "application/x-ndjson")
@@ -695,11 +725,30 @@ class MetricsServer(BackgroundHttpServer):
                                    default=str) + "\n",
                         "application/json",
                     )
+                elif url.path == "/snapshot":
+                    from freedm_tpu.core import snapshot as _snapshot
+
+                    coord = _snapshot.COORDINATOR
+                    q = parse_qs(url.query)
+                    sid = q.get("id", [None])[0]
+                    if coord is None:
+                        body = {"enabled": False}
+                    elif sid:
+                        doc = coord.result(sid)
+                        if doc is None:
+                            self._reply(404, "unknown snapshot_id\n",
+                                        "text/plain; charset=utf-8")
+                            return
+                        body = doc
+                    else:
+                        body = coord.status()
+                    self._reply(200, json.dumps(body, default=str) + "\n",
+                                "application/json")
                 elif url.path == "/":
                     self._reply(
                         200,
                         "freedm_tpu metrics: /metrics /events /trace "
-                        "/profile /slo /roofline /provenance\n",
+                        "/profile /slo /roofline /provenance /snapshot\n",
                         "text/plain; charset=utf-8")
                 else:
                     self._reply(404, "not found\n", "text/plain; charset=utf-8")
@@ -735,6 +784,27 @@ class MetricsServer(BackgroundHttpServer):
                                     "application/json")
                         return
                     self._reply(200, json.dumps(out) + "\n",
+                                "application/json")
+                elif url.path == "/snapshot":
+                    from freedm_tpu.core import snapshot as _snapshot
+
+                    coord = _snapshot.COORDINATOR
+                    if coord is None:
+                        self._reply(503,
+                                    json.dumps({"error": "no snapshot "
+                                                "coordinator installed"})
+                                    + "\n",
+                                    "application/json")
+                        return
+                    try:
+                        sid = coord.initiate()
+                    except _snapshot.SnapshotInProgress as e:
+                        # One cut at a time, like /profile/capture.
+                        self._reply(409,
+                                    json.dumps({"error": str(e)}) + "\n",
+                                    "application/json")
+                        return
+                    self._reply(200, json.dumps({"snapshot_id": sid}) + "\n",
                                 "application/json")
                 else:
                     self._reply(404, "not found\n",
@@ -945,6 +1015,27 @@ ROUTER_FEDERATION_UP = REGISTRY.gauge(
     "1 if the replica answered the last GET /metrics federation "
     "scrape on the router, else 0",
     labels=("replica",))
+
+# -- consistent-cut snapshots (freedm_tpu.core.snapshot) --------------------
+SNAPSHOT_CUTS = REGISTRY.counter(
+    "snapshot_cuts_total",
+    "Chandy–Lamport snapshot attempts by outcome (complete = every "
+    "channel/replica reported before the deadline, incomplete = the "
+    "--snapshot-timeout-s bound fired first, rejected = a cut was "
+    "already in flight)",
+    labels=("outcome",))
+for _outcome in ("complete", "incomplete", "rejected"):
+    SNAPSHOT_CUTS.labels(_outcome)
+SNAPSHOT_VIOLATIONS = REGISTRY.counter(
+    "snapshot_violations_total",
+    "Invariant violations reported by the snapshot auditor, by check "
+    "(zero on a healthy fleet — the chaos gate asserts exactly that)",
+    labels=("check",))
+SNAPSHOT_CAPTURE = REGISTRY.histogram(
+    "snapshot_capture_seconds",
+    "Snapshot initiation to cut completion (local state + every "
+    "channel's marker, or every replica's dump)",
+    buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0))
 
 # -- fault injection (freedm_tpu.core.faults) -------------------------------
 FAULTS_INJECTED = REGISTRY.counter(

@@ -1,0 +1,546 @@
+"""Consistent-cut fleet snapshots: Chandy–Lamport capture + auditor.
+
+The reference DGI's third pillar is ``sc/`` StateCollection
+(``Broker/src/sc/StateCollection.cpp``): marker-based Chandy–Lamport
+snapshots that capture a *consistent global cut* — every node's local
+state plus the messages in flight on every channel — which is what
+makes distributed invariants checkable at all.  This module is that
+pillar for the reproduction, split into three pieces:
+
+**Capture** — :class:`SnapshotCoordinator` drives the marker protocol
+over a :class:`~freedm_tpu.dcn.endpoint.UdpEndpoint`: on initiation it
+captures local state (a pluggable ``state_provider``), freezes every
+SR channel's counters (``SrChannel.snap_begin``), and sends a MARKER
+frame to every peer; each channel records inbound messages until its
+own marker arrives (``SrChannel._accept_marker``).  Because the SR
+channel is FIFO and exactly-once, the recorded messages plus the
+frozen counters ARE the channel's consistent cut — no clock sync, no
+pause.  A node that first learns of a snapshot from an inbound marker
+joins the cut the same way (capture + markers on all channels), with
+the delivering channel recorded empty, per the algorithm.  The whole
+capture is bounded by ``--snapshot-timeout-s``: a dead or pre-marker
+peer (whose channel silently drops the unknown MARKER status) makes
+the cut *typed incomplete*, never a hang.
+
+**Audit** — :func:`audit_cut` checks fleet invariants against an
+assembled cut document and returns typed :class:`Violation` findings:
+
+- ``channel_conservation`` — a channel's messages sent at the marker
+  can exceed messages accepted at marker receipt only by losses (TTL
+  expiry is legal on an SR channel); an *excess* of accepts means
+  duplicate delivery.
+- ``channel_recording`` — messages recorded between capture and marker
+  must equal the accept-counter delta over the same interval (each
+  in-flight message captured exactly once).
+- ``channel_counter_mismatch`` — the sender's independently captured
+  send counter must agree with the marker it stamped.
+- ``single_leader`` — at most one coordinator per group, in-process
+  and across federated slices sharing a member set.
+- ``ticket_accounting`` — serve admission ledger: every offered
+  request is admitted, shed, or rejected; every admitted request is
+  settled ok/error or in flight *in the cut*.
+- ``job_accounting`` — the job table's total equals the sum of its
+  per-state counts.
+- ``cache_bytes`` — the cache's byte gauge equals the bytes its
+  entries account for.
+
+**Torn-read negative proof** — :func:`torn_serve_doc` builds the
+document an *uncoordinated* scrape would produce (counters from one
+instant, the rest from another); under traffic it fails the ticket
+audit, demonstrating the markers are load-bearing, not decorative.
+
+Observability: ``snapshot.{start,channel_done,node,complete,
+incomplete,violation}`` events, ``snapshot_*`` metrics, and
+``snapshot``-kind spans, all joined by ``snapshot_id``
+(docs/snapshots.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid as uuid_mod
+from collections import OrderedDict
+from dataclasses import asdict, dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from freedm_tpu.core import metrics, tracing
+
+DEFAULT_TIMEOUT_S = 10.0
+DEFAULT_MAX_BYTES = 4_000_000
+
+#: Completed cuts kept per coordinator/router (oldest evicted).
+KEEP_CUTS = 8
+
+
+class SnapshotInProgress(RuntimeError):
+    """A cut is already in flight — one snapshot at a time (the marker
+    protocol has no epoch field; concurrent cuts would interleave)."""
+
+
+@dataclass
+class Violation:
+    """One typed invariant violation found by the auditor."""
+
+    check: str
+    node: str
+    detail: str
+
+    def as_dict(self) -> Dict[str, str]:
+        return asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# capture: the DCN-side coordinator
+# ---------------------------------------------------------------------------
+
+
+class SnapshotCoordinator:
+    """Drives Chandy–Lamport capture for one process over its DCN
+    endpoint.  All state is guarded by the *endpoint's* lock: marker
+    upcalls already hold it (they surface inside ``accept_frames``),
+    and taking the same lock from ``initiate``/``tick`` is what makes
+    the local capture + channel freeze a single consistent instant.
+    """
+
+    def __init__(
+        self,
+        endpoint,
+        state_provider: Optional[Callable[[], Dict[str, Any]]] = None,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+    ):
+        self.endpoint = endpoint
+        self.state_provider = state_provider
+        self.timeout_s = float(timeout_s)
+        self.max_bytes = int(max_bytes)
+        self._active: Optional[Dict[str, Any]] = None
+        self._cuts: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        endpoint.snapshots = self
+
+    # -- public surface ------------------------------------------------------
+    def initiate(self, snapshot_id: Optional[str] = None) -> str:
+        """Start a cut from this node; returns the ``snapshot_id``.
+        Raises :class:`SnapshotInProgress` (→ typed 409) if one is
+        already in flight."""
+        with self.endpoint._lock:
+            if self._active is not None:
+                metrics.SNAPSHOT_CUTS.labels("rejected").inc()
+                raise SnapshotInProgress(
+                    f"snapshot {self._active['snapshot_id']} in flight"
+                )
+            sid = snapshot_id or uuid_mod.uuid4().hex[:12]
+            self._begin(sid, origin=self.endpoint.uuid, via=None)
+            return sid
+
+    def handle_marker(self, peer: str, payload: Dict[str, Any]) -> None:
+        """Upcall from a channel that just accepted a MARKER (already
+        under the endpoint lock)."""
+        sid = payload.get("snapshot_id")
+        if sid is None:
+            return
+        if self._active is None:
+            # First contact: join the cut.  The delivering channel
+            # already froze itself (marker-before-capture path).
+            self._begin(str(sid), origin=str(payload.get("origin", peer)),
+                        via=peer)
+            return
+        if self._active["snapshot_id"] != sid:
+            return  # a different (stale/foreign) cut's marker — ignore
+        self._channel_done(peer)
+
+    def tick(self, now: float) -> None:
+        """Pump-loop heartbeat: bound the cut by ``timeout_s``."""
+        act = self._active  # racy pre-check; re-read under the lock
+        if act is None or now < act["deadline"]:
+            return
+        with self.endpoint._lock:
+            act = self._active
+            if act is None or now < act["deadline"]:
+                return
+            self._finish("incomplete")
+
+    def result(self, snapshot_id: str) -> Optional[Dict[str, Any]]:
+        with self.endpoint._lock:
+            return self._cuts.get(snapshot_id)
+
+    def status(self) -> Dict[str, Any]:
+        with self.endpoint._lock:
+            act = self._active
+            return {
+                "enabled": True,
+                "node": self.endpoint.uuid,
+                "active": act["snapshot_id"] if act else None,
+                "pending": sorted(act["pending"]) if act else [],
+                "cuts": list(self._cuts),
+            }
+
+    # -- internals (endpoint lock held) --------------------------------------
+    def _begin(self, sid: str, origin: str, via: Optional[str]) -> None:
+        now = time.monotonic()
+        span = tracing.NOOP
+        if tracing.TRACER.enabled:
+            span = tracing.TRACER.start(
+                "snapshot.node", kind="snapshot",
+                tags={"snapshot_id": sid, "node": self.endpoint.uuid},
+            )
+        local: Dict[str, Any] = {}
+        if self.state_provider is not None:
+            try:
+                local = self.state_provider() or {}
+            except Exception as e:  # a broken provider must not wedge DCN
+                local = {"error": repr(e)}
+        channels_out: Dict[str, Dict[str, int]] = {}
+        pending = set()
+        for peer, st in self.endpoint._peers.items():
+            ch = st.channel
+            channels_out[peer] = {
+                "sent_at_capture": ch.sent,
+                "expired_at_capture": ch.expired,
+            }
+            if peer != via:
+                ch.snap_begin()
+                pending.add(peer)
+            ch.send_marker({"snapshot_id": sid, "origin": origin}, now)
+        self._active = {
+            "snapshot_id": sid,
+            "origin": origin,
+            "started": now,
+            "deadline": now + self.timeout_s,
+            "local": local,
+            "channels_out": channels_out,
+            "pending": pending,
+            "span": span,
+        }
+        metrics.EVENTS.emit(
+            "snapshot.start", snapshot_id=sid, node=self.endpoint.uuid,
+            origin=origin, peers=len(channels_out),
+        )
+        if not pending:
+            self._finish("complete")
+
+    def _channel_done(self, peer: str) -> None:
+        act = self._active
+        if act is None or peer not in act["pending"]:
+            return
+        act["pending"].discard(peer)
+        ch = self.endpoint._peers[peer].channel
+        metrics.EVENTS.emit(
+            "snapshot.channel_done", snapshot_id=act["snapshot_id"],
+            node=self.endpoint.uuid, peer=peer,
+            recorded=len(ch._snap_record),
+        )
+        if not act["pending"]:
+            self._finish("complete")
+
+    def _finish(self, outcome: str) -> None:
+        act, self._active = self._active, None
+        now = time.monotonic()
+        capture_s = now - act["started"]
+        channels_in = {
+            peer: st.channel.snap_state()
+            for peer, st in self.endpoint._peers.items()
+            if peer in act["channels_out"]
+        }
+        doc = {
+            "snapshot_id": act["snapshot_id"],
+            "node": self.endpoint.uuid,
+            "origin": act["origin"],
+            "status": outcome,
+            "captured_at": round(time.time(), 6),
+            "capture_ms": round(capture_s * 1000.0, 3),
+            "pending": sorted(act["pending"]),
+            "local": act["local"],
+            "channels_out": act["channels_out"],
+            "channels_in": channels_in,
+        }
+        doc = bound_doc(doc, self.max_bytes)
+        self._cuts[act["snapshot_id"]] = doc
+        while len(self._cuts) > KEEP_CUTS:
+            self._cuts.popitem(last=False)
+        metrics.SNAPSHOT_CUTS.labels(outcome).inc()
+        metrics.SNAPSHOT_CAPTURE.observe(capture_s)
+        metrics.EVENTS.emit("snapshot.node", snapshot_id=act["snapshot_id"],
+                            node=self.endpoint.uuid, doc=doc)
+        if outcome == "complete":
+            metrics.EVENTS.emit(
+                "snapshot.complete", snapshot_id=act["snapshot_id"],
+                node=self.endpoint.uuid,
+                capture_ms=doc["capture_ms"],
+            )
+        else:
+            metrics.EVENTS.emit(
+                "snapshot.incomplete", snapshot_id=act["snapshot_id"],
+                node=self.endpoint.uuid, pending=doc["pending"],
+                timeout_s=self.timeout_s,
+            )
+        span = act["span"]
+        span.tag(outcome=outcome, capture_ms=doc["capture_ms"])
+        span.end()
+
+
+#: Process-wide coordinator (installed by the CLI for federated
+#: runtimes; the MetricsServer's ``/snapshot`` routes use it).
+COORDINATOR: Optional[SnapshotCoordinator] = None
+
+
+def install(coordinator: Optional[SnapshotCoordinator]) -> None:
+    global COORDINATOR
+    COORDINATOR = coordinator
+
+
+# ---------------------------------------------------------------------------
+# cut assembly + size bounding
+# ---------------------------------------------------------------------------
+
+
+def assemble_cut(snapshot_id: str, node_docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Join per-node cut documents (matching ``snapshot_id``) into one
+    fleet cut.  Nodes reporting a different snapshot_id are dropped —
+    mixing cuts is exactly the torn read this machinery exists to kill.
+    """
+    nodes: Dict[str, Dict[str, Any]] = {}
+    status = "complete"
+    for doc in node_docs:
+        if doc.get("snapshot_id") not in (None, snapshot_id):
+            continue
+        nodes[str(doc.get("node", f"node{len(nodes)}"))] = doc
+        if doc.get("status", "complete") != "complete":
+            status = "incomplete"
+    return {"snapshot_id": snapshot_id, "status": status, "nodes": nodes}
+
+
+def bound_doc(doc: Dict[str, Any], max_bytes: int) -> Dict[str, Any]:
+    """Enforce ``--snapshot-max-bytes`` on a cut document: first the
+    per-channel recorded-message lists collapse to their counts (the
+    audit only needs ``recorded_n``), then an oversize doc is replaced
+    by a stub that says so rather than silently truncated JSON."""
+    blob = json.dumps(doc, default=str)
+    if len(blob) <= max_bytes:
+        return doc
+    doc = json.loads(json.dumps(doc, default=str))  # private copy
+    for cin in doc.get("channels_in", {}).values():
+        if isinstance(cin, dict) and "recorded" in cin:
+            cin["recorded"] = f"trimmed:{cin.get('recorded_n', 0)}"
+    doc["trimmed"] = True
+    blob = json.dumps(doc, default=str)
+    if len(blob) <= max_bytes:
+        return doc
+    return {
+        "snapshot_id": doc.get("snapshot_id"),
+        "node": doc.get("node"),
+        "status": "oversize",
+        "bytes": len(blob),
+        "max_bytes": int(max_bytes),
+    }
+
+
+# ---------------------------------------------------------------------------
+# audit: fleet invariants over an assembled cut
+# ---------------------------------------------------------------------------
+
+
+def audit_cut(cut: Dict[str, Any]) -> List[Violation]:
+    """Run every applicable invariant check over an assembled cut and
+    return the violations (empty list ⇒ the cut is consistent)."""
+    out: List[Violation] = []
+    nodes = cut.get("nodes", {})
+    for name, doc in nodes.items():
+        out.extend(_check_channels(name, doc, nodes))
+        local = doc.get("local", {})
+        out.extend(_check_groups(name, local.get("gm")))
+        serve = doc.get("serve")
+        if serve is not None:
+            out.extend(_check_tickets(name, serve.get("ledger", serve)))
+        jobs = doc.get("jobs")
+        if jobs is not None:
+            out.extend(_check_jobs(name, jobs))
+        cache = doc.get("cache")
+        if cache is not None:
+            out.extend(_check_cache(name, cache))
+    out.extend(_check_fed_leaders(nodes))
+    return out
+
+
+def record_violations(snapshot_id: str, violations: List[Violation]) -> None:
+    """Journal each violation and bump the per-check counter."""
+    for v in violations:
+        metrics.SNAPSHOT_VIOLATIONS.labels(v.check).inc()
+        metrics.EVENTS.emit("snapshot.violation", snapshot_id=snapshot_id,
+                            check=v.check, node=v.node, detail=v.detail)
+
+
+def _check_channels(name: str, doc: Dict[str, Any],
+                    nodes: Dict[str, Any]) -> List[Violation]:
+    out: List[Violation] = []
+    for peer, cin in doc.get("channels_in", {}).items():
+        if not isinstance(cin, dict) or not cin.get("done"):
+            continue  # no marker ⇒ this channel's cut never closed
+        if cin.get("resynced"):
+            # The sender re-SYNed (new incarnation / stale-window
+            # reconnect) while this cut was recording: the counters
+            # straddle two channel epochs, so none of the per-channel
+            # equations apply.  Epoch resets OUTSIDE a cut are already
+            # absorbed by the accept-counter reset at resync time.
+            continue
+        marker = cin.get("marker") or {}
+        sent = marker.get("sent_at_marker")
+        acc_mark = cin.get("accepted_at_marker")
+        acc_cap = cin.get("accepted_at_capture")
+        if sent is None or acc_mark is None or acc_cap is None:
+            continue
+        # Lossy-channel conservation: an SR channel may legally LOSE
+        # pre-marker messages (TTL expiry + kill-number skip), so the
+        # one-sided bound is the invariant — more accepts than sends
+        # can only mean duplicate delivery.
+        if acc_mark > sent:
+            out.append(Violation(
+                "channel_conservation", name,
+                f"channel {peer}->{name}: accepted_at_marker={acc_mark} "
+                f"exceeds sent_at_marker={sent}",
+            ))
+        n_rec = cin.get("recorded_n")
+        if n_rec is None and isinstance(cin.get("recorded"), list):
+            n_rec = len(cin["recorded"])
+        if n_rec is not None and n_rec != acc_mark - acc_cap:
+            out.append(Violation(
+                "channel_recording", name,
+                f"channel {peer}->{name}: recorded {n_rec} in-flight "
+                f"messages but the accept counter moved "
+                f"{acc_mark - acc_cap} (capture {acc_cap} -> marker "
+                f"{acc_mark}) — a message was double-recorded or missed",
+            ))
+        # Cross-check against the sender's independently captured
+        # counter, when the sender is in the cut.
+        peer_doc = nodes.get(peer)
+        if peer_doc is not None:
+            cout = peer_doc.get("channels_out", {}).get(name)
+            if cout is not None and cout.get("sent_at_capture") != sent:
+                out.append(Violation(
+                    "channel_counter_mismatch", name,
+                    f"channel {peer}->{name}: marker says "
+                    f"sent_at_marker={sent} but the sender captured "
+                    f"sent_at_capture={cout.get('sent_at_capture')}",
+                ))
+    return out
+
+
+def _check_groups(name: str, gm: Optional[Dict[str, Any]]) -> List[Violation]:
+    if not isinstance(gm, dict):
+        return []
+    out: List[Violation] = []
+    per_group = gm.get("coordinators_per_group")
+    if isinstance(per_group, list):
+        for gi, n in enumerate(per_group):
+            if n != 1:
+                out.append(Violation(
+                    "single_leader", name,
+                    f"group {gi} has {n} coordinators (want exactly 1)",
+                ))
+    return out
+
+
+def _check_fed_leaders(nodes: Dict[str, Any]) -> List[Violation]:
+    """Across federated slices: at most one coordinator per member set."""
+    claims: Dict[frozenset, List[str]] = {}
+    for name, doc in nodes.items():
+        local = doc.get("local", {})
+        fed = local.get("fed")
+        if fed is None and isinstance(local.get("gm"), dict):
+            fed = local["gm"].get("fed")  # GmModule nests its federation view
+        if isinstance(fed, dict) and fed.get("is_coordinator"):
+            members = frozenset(fed.get("members", [name]))
+            claims.setdefault(members, []).append(name)
+    out: List[Violation] = []
+    for members, leaders in claims.items():
+        if len(leaders) > 1:
+            out.append(Violation(
+                "single_leader", ",".join(sorted(leaders)),
+                f"{len(leaders)} nodes claim federation leadership of "
+                f"the same member set {sorted(members)}",
+            ))
+    return out
+
+
+def _check_tickets(name: str, ledger: Dict[str, Any]) -> List[Violation]:
+    out: List[Violation] = []
+    try:
+        offered = int(ledger["offered"])
+        admitted = int(ledger["admitted"])
+        shed = int(ledger["shed"])
+        rejected = int(ledger["rejected"])
+        ok = int(ledger["ok"])
+        error = int(ledger["error"])
+        inflight = int(ledger["inflight"])
+    except (KeyError, TypeError, ValueError):
+        return [Violation("ticket_accounting", name,
+                          f"malformed serve ledger: {ledger!r}")]
+    if offered != admitted + shed + rejected:
+        out.append(Violation(
+            "ticket_accounting", name,
+            f"offered={offered} != admitted={admitted} + shed={shed} "
+            f"+ rejected={rejected}",
+        ))
+    if admitted != ok + error + inflight:
+        out.append(Violation(
+            "ticket_accounting", name,
+            f"admitted={admitted} != ok={ok} + error={error} "
+            f"+ in-flight-in-cut={inflight}",
+        ))
+    return out
+
+
+def _check_jobs(name: str, jobs: Dict[str, Any]) -> List[Violation]:
+    by_state = jobs.get("by_state")
+    total = jobs.get("total")
+    if not isinstance(by_state, dict) or total is None:
+        return []
+    counted = sum(int(v) for v in by_state.values())
+    if int(total) != counted:
+        return [Violation(
+            "job_accounting", name,
+            f"job table holds {total} jobs but per-state counts sum "
+            f"to {counted}: {by_state}",
+        )]
+    return []
+
+
+def _check_cache(name: str, cache: Dict[str, Any]) -> List[Violation]:
+    b = cache.get("bytes")
+    ab = cache.get("accounted_bytes")
+    if b is None or ab is None:
+        return []
+    if int(b) != int(ab):
+        return [Violation(
+            "cache_bytes", name,
+            f"cache byte gauge {b} != bytes accounted by live entries "
+            f"{ab}",
+        )]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# torn-read negative proof
+# ---------------------------------------------------------------------------
+
+
+def torn_serve_doc(early: Dict[str, Any], late: Dict[str, Any]) -> Dict[str, Any]:
+    """The document an *uncoordinated* scrape produces: admission
+    counters frozen at one instant (``early``) glued to offer/settle
+    counters from a later one (``late``).  Any request offered between
+    the two scrapes breaks ``offered == admitted + shed + rejected`` —
+    the bogus violation that proves the markers are load-bearing."""
+    e = early.get("ledger", early)
+    l = late.get("ledger", late)
+    return {
+        "torn": True,
+        "ledger": {
+            "offered": l.get("offered", 0),
+            "admitted": e.get("admitted", 0),
+            "shed": e.get("shed", 0),
+            "rejected": e.get("rejected", 0),
+            "ok": l.get("ok", 0),
+            "error": l.get("error", 0),
+            "inflight": l.get("inflight", 0),
+        },
+    }

@@ -82,6 +82,9 @@ class UdpEndpoint:
         self._sock.settimeout(resend_time_s / 2)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Duck-typed snapshot coordinator (core.snapshot.SnapshotCoordinator):
+        # receives marker upcalls and a periodic tick for its timeout.
+        self.snapshots = None
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -105,6 +108,7 @@ class UdpEndpoint:
                     addr,
                     100 if reliability is None else reliability,
                 )
+                st.channel.on_marker = self._on_marker
                 self._peers[uuid] = st
             else:
                 if addr is not None:
@@ -163,6 +167,12 @@ class UdpEndpoint:
                         self._flush(st, now)
             except Exception:
                 logging.getLogger(__name__).exception("dcn flush error")
+            snap = self.snapshots
+            if snap is not None:
+                try:
+                    snap.tick(time.monotonic())
+                except Exception:
+                    logging.getLogger(__name__).exception("snapshot tick error")
 
     def _on_datagram(self, data: bytes, addr: Tuple[str, int]) -> None:
         metrics.DCN_DATAGRAMS_IN.inc()
@@ -186,6 +196,7 @@ class UdpEndpoint:
                     SrChannel(src, self.resend_time_s, self.ttl_s, src_uuid=self.uuid),
                     addr,
                 )
+                st.channel.on_marker = self._on_marker
                 self._peers[src] = st
             elif st.addr is None:
                 st.addr = addr
@@ -194,6 +205,15 @@ class UdpEndpoint:
         for m in accepted:
             if self.sink is not None:
                 self.sink(m)
+
+    def _on_marker(self, peer: str, payload) -> None:
+        """Channel marker upcall → the installed snapshot coordinator.
+        Runs under ``self._lock`` (markers surface inside
+        ``accept_frames``); the coordinator relies on that to capture
+        every channel's state at one consistent instant."""
+        snap = self.snapshots
+        if snap is not None:
+            snap.handle_marker(peer, payload)
 
     def _flush(self, st: _PeerState, now: float) -> None:
         frames = st.channel.poll(now)

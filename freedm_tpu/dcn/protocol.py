@@ -32,11 +32,11 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import replace
-from typing import Deque, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 from freedm_tpu.core import metrics, tracing
 from freedm_tpu.dcn import wire
-from freedm_tpu.dcn.wire import ACCEPTED, BAD_REQUEST, CREATED, MESSAGE, Frame
+from freedm_tpu.dcn.wire import ACCEPTED, BAD_REQUEST, CREATED, MARKER, MESSAGE, Frame
 from freedm_tpu.runtime.messages import ModuleMessage
 
 # CProtocolSR.hpp:91-95.
@@ -99,6 +99,20 @@ class SrChannel:
         # Tracing: live send span per in-flight seq (ended on ACK or
         # expiry; empty while tracing is disabled).
         self._spans: Dict[int, object] = {}
+        # Chandy–Lamport snapshot seam (core.snapshot).  An attached
+        # marker handler opts this channel into MARKER frames; the
+        # recording state captures in-flight messages between the local
+        # state capture and this channel's marker receipt.  With
+        # ``on_marker`` unset the channel behaves byte-for-byte like a
+        # pre-marker build: the frame is dropped unACKed and dies at the
+        # sender's TTL.
+        self.on_marker: Optional[Callable[[str, Dict[str, Any]], None]] = None
+        self._snap_base: Optional[Dict[str, int]] = None
+        self._snap_recording = False
+        self._snap_record: List[Dict[str, Any]] = []
+        self._snap_marker: Optional[Dict[str, Any]] = None
+        self._snap_accepted_at_marker = 0
+        self._snap_resynced = False
 
     # -- sender side ---------------------------------------------------------
     def send(self, msg: ModuleMessage, now: float) -> None:
@@ -147,6 +161,32 @@ class SrChannel:
         metrics.DCN_SENDS.inc()
         self._g_outstanding.set(len(self._out_window))
         self._next_resend = now  # fire immediately on next poll
+
+    def send_marker(self, payload: Dict[str, Any], now: float) -> None:
+        """Queue a Chandy–Lamport MARKER (core.snapshot).  Markers ride
+        the SR window with a real sequence number — FIFO-ordered against
+        MESSAGE frames and delivered at most once, which is exactly the
+        channel property the snapshot algorithm requires.  The payload
+        is stamped with ``sent_at_marker`` — how many messages this side
+        has ever sent — so the receiver's conservation audit can compare
+        it against its accept counter frozen at marker receipt."""
+        m = ModuleMessage(
+            "_snapshot", "marker",
+            dict(payload, sent_at_marker=self.sent),
+            source=self.src_uuid,
+        )
+        probe = Frame(
+            status=MARKER, seq=SEQUENCE_MODULO - 1, hash=m.hash(),
+            expire=now + self.ttl_s, msg=wire.pack_message(m),
+        )
+        wire.encode_window(self.src_uuid, [probe], now, margin=_STAMP_MARGIN)
+        if not self._out_synced:
+            self._push_syn(now)
+        # Markers do not bump ``self.sent``: that counter is the
+        # conservation ledger of *module messages* only.
+        self._out_window.append(replace(probe, seq=self._take_seq()))
+        self._g_outstanding.set(len(self._out_window))
+        self._next_resend = now
 
     def _take_seq(self) -> int:
         seq = self._out_seq
@@ -260,7 +300,17 @@ class SrChannel:
         for f in frames:
             if f.status == ACCEPTED:
                 self._receive_ack(f, now)
+            elif f.status == MARKER and self.on_marker is None:
+                # Forward-compat pin: without a snapshot handler a
+                # MARKER is an unknown status — dropped unACKed, exactly
+                # what a pre-marker build does.  The sender's marker
+                # expires at its TTL and the snapshot initiator times
+                # this channel out with a typed ``snapshot.incomplete``.
+                continue
             elif self._receive(f, now) and f.msg is not None:
+                if f.status == MARKER:
+                    self._accept_marker(f)
+                    continue
                 m = wire.unpack_message(f.msg)
                 if tracing.TRACER.enabled:
                     # The accept logic delivers exactly once, so exactly
@@ -280,6 +330,11 @@ class SrChannel:
                         m = replace(m, trace=rctx)
                 out.append(m)
                 self.accepted += 1
+                if self._snap_recording:
+                    self._snap_record.append(
+                        {"seq": f.seq, "hash": f.hash, "type": m.type,
+                         "module": m.recipient_module}
+                    )
         return out
 
     def _receive_ack(self, f: Frame, now: float) -> None:
@@ -322,6 +377,18 @@ class SrChannel:
             if f.sync_time is not None and f.sync_time == self._in_sync_time:
                 self._queue_ack(f)
                 return False
+            if self._in_sync:
+                # A NEW sync stamp on an already-synced channel is a new
+                # sender incarnation (kill + restart) or a stale-window
+                # reconnect: either way the peer's sent counter restarted
+                # from zero, so the conservation ledger must open a new
+                # epoch — a lifetime accept count would read as a bogus
+                # channel_conservation violation in the next cut.  A cut
+                # recording in progress straddles the epoch boundary; it
+                # is marked so the auditor skips its channel checks.
+                self.accepted = 0
+                if self._snap_recording:
+                    self._snap_resynced = True
             self._in_seq = (f.seq + 1) % SEQUENCE_MODULO
             self._in_sync_time = f.sync_time
             self._in_resyncs += 1
@@ -338,7 +405,7 @@ class SrChannel:
                 )
             )
             return False
-        if f.status == MESSAGE:
+        if f.status in (MESSAGE, MARKER):
             if not f.hash:
                 return False  # this protocol NEEDS hashes
             if f.seq == self._in_seq:
@@ -372,6 +439,64 @@ class SrChannel:
             Frame(status=ACCEPTED, seq=f.seq, hash=f.hash, expire=f.expire,
                   trace=f.trace)
         )
+
+    # -- snapshot recording (Chandy–Lamport, core.snapshot) ------------------
+    def snap_begin(self) -> Dict[str, int]:
+        """Freeze the counter base at local-state capture and start
+        recording inbound messages until this channel's marker arrives."""
+        self._snap_base = {
+            "accepted_at_capture": self.accepted,
+            "sent_at_capture": self.sent,
+            "expired_at_capture": self.expired,
+        }
+        self._snap_recording = True
+        self._snap_record = []
+        self._snap_marker = None
+        self._snap_resynced = False
+        return dict(self._snap_base)
+
+    @property
+    def snap_done(self) -> bool:
+        return self._snap_marker is not None
+
+    def snap_state(self) -> Dict[str, Any]:
+        """This channel's inbound contribution to the node's cut doc."""
+        return {
+            **(self._snap_base or {}),
+            "recorded": list(self._snap_record),
+            "recorded_n": len(self._snap_record),
+            "accepted_at_marker": self._snap_accepted_at_marker,
+            "marker": self._snap_marker,
+            "done": self._snap_marker is not None,
+            "resynced": self._snap_resynced,
+        }
+
+    def _accept_marker(self, f: Frame) -> None:
+        """Marker accepted in-order: stop recording, freeze the accept
+        counter, and upcall the coordinator.  Because the SR channel is
+        FIFO and exactly-once, every pre-marker message that survived
+        its TTL has been accepted by now — the counters here ARE the
+        consistent cut of this channel."""
+        payload = dict(wire.unpack_message(f.msg).payload)
+        if not self._snap_recording:
+            # Marker before local capture: per Chandy–Lamport the
+            # delivering channel's recorded state is empty by
+            # definition; the coordinator captures local state from the
+            # on_marker upcall.
+            self._snap_base = {
+                "accepted_at_capture": self.accepted,
+                "sent_at_capture": self.sent,
+                "expired_at_capture": self.expired,
+            }
+            self._snap_record = []
+            # Base and marker freeze at the same instant: internally
+            # consistent in the CURRENT epoch whatever came before.
+            self._snap_resynced = False
+        self._snap_recording = False
+        self._snap_marker = payload
+        self._snap_accepted_at_marker = self.accepted
+        if self.on_marker is not None:
+            self.on_marker(self.uuid, payload)
 
     # -- introspection -------------------------------------------------------
     @property

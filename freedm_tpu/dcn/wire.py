@@ -27,6 +27,14 @@ MESSAGE = "MESSAGE"
 ACCEPTED = "ACCEPTED"  # an ACK
 CREATED = "CREATED"  # a SYN
 BAD_REQUEST = "BAD_REQUEST"
+# Chandy–Lamport snapshot marker (StateCollection's marker message,
+# ``Broker/src/sc/StateCollection.cpp``): rides the SR window like a
+# MESSAGE but is consumed by the snapshot coordinator, never dispatched.
+# Forward-compat pin: a pre-marker build sees an unknown status string,
+# drops the frame unACKed (``SrChannel._receive`` falls through), and
+# the sender's marker dies at its TTL — the initiator times out with a
+# typed ``snapshot.incomplete``, never a hang or a decode error.
+MARKER = "MARKER"
 
 # CGlobalConfiguration::MAX_PACKET_SIZE = SHRT_MAX.
 MAX_PACKET_SIZE = 32767

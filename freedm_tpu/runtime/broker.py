@@ -177,6 +177,20 @@ class Broker:
     def stop(self) -> None:
         self._stop = True
 
+    def snapshot_state(self) -> Dict[str, Any]:
+        """Aggregate every registered module's ``snapshot_state()`` into
+        this process's local-state contribution to a consistent cut
+        (``freedm_tpu.core.snapshot``), keyed by module name."""
+        doc: Dict[str, Any] = {"round": self.round_index}
+        for ph in self._phases:
+            try:
+                st = ph.module.snapshot_state()
+            except Exception as e:  # one broken module must not void the cut
+                st = {"error": repr(e)}
+            if st is not None:
+                doc[ph.module.name] = st
+        return doc
+
     # -- the loop (CBroker::Run / ChangePhase / Worker) ----------------------
     def _fire_due_timers(self) -> List[str]:
         now = time.monotonic()

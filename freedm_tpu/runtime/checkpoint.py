@@ -259,3 +259,6 @@ class CheckpointModule(DgiModule):
         metrics.EVENTS.emit(
             "checkpoint.save", path=self.path, round=ctx.round_index + 1
         )
+
+    def snapshot_state(self):
+        return {"saves": self.saves, "path": self.path, "every": self.every}

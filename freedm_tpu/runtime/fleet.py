@@ -582,6 +582,40 @@ class GmModule(DgiModule):
             )
         return "\n".join(lines)
 
+    def snapshot_state(self):
+        """GM's cut contribution: leadership + membership as captured —
+        the single-leader audit checks the coordinator/is_coordinator
+        arrays agree (exactly one coordinator per group) and, across
+        federated slices, that one process claims the leader role."""
+        doc = {
+            "elections": self.counters["elections"],
+            "groups_broken": self.counters["groups_broken"],
+        }
+        group = self.last
+        if group is not None:
+            coord = np.asarray(group.coordinator).astype(int)
+            is_coord = np.asarray(group.is_coordinator).astype(bool)
+            members_of: Dict[int, list] = {}
+            for i, c in enumerate(coord.tolist()):
+                members_of.setdefault(c, []).append(i)
+            doc.update(
+                n_groups=int(group.n_groups),
+                coordinator_of=coord.tolist(),
+                coordinators_per_group=[
+                    int(sum(bool(is_coord[i]) for i in members))
+                    for _, members in sorted(members_of.items())
+                ],
+            )
+        if self.fed is not None:
+            v = self.fed.view()
+            doc["fed"] = {
+                "leader": v.leader,
+                "members": sorted(v.members),
+                "state": str(v.state),
+                "is_coordinator": bool(self.fed.is_coordinator),
+            }
+        return doc
+
 
 class ScModule(DgiModule):
     name = "sc"
@@ -639,6 +673,12 @@ class ScModule(DgiModule):
                 "intransit": float(jnp.sum(intransit)) + self.fed.fed_intransit,
             }
             ctx.shared["fed_collected"] = self.fed.sc_step(totals)
+
+    def snapshot_state(self):
+        return {
+            "accepts_pending": self._accepts,
+            "accepts_total": self.total_accepts,
+        }
 
 
 class LbModule(DgiModule):
@@ -790,6 +830,17 @@ class LbModule(DgiModule):
             )
         lines.append("  ---------------------------------------------")
         return "\n".join(lines)
+
+    def snapshot_state(self):
+        doc = {
+            "rounds": self.rounds,
+            "syncs": self.syncs,
+            "migrations": self.total_migrations,
+            "synchronized": bool(self._synchronized),
+        }
+        if self.predicted is not None:
+            doc["predicted_gateway_total"] = round(float(np.sum(self.predicted)), 6)
+        return doc
 
 
 class VvcModule(DgiModule):
@@ -1006,6 +1057,17 @@ class VvcModule(DgiModule):
         self.improved_rounds += int(improved)
         self.last = out
         ctx.shared["vvc"] = out
+
+    def snapshot_state(self):
+        return {
+            "rounds": self.rounds,
+            "improved_rounds": self.improved_rounds,
+            "skipped_rounds": self.skipped_rounds,
+            "slave_rounds": self.slave_rounds,
+            "stale_reads": self.stale_reads,
+            "alpha": round(float(self.alpha), 6),
+            "q_ctrl_abs_kvar": round(float(np.abs(self.q_kvar).sum()), 6),
+        }
 
 
 def omega_invariant(tolerance: float = 0.05):

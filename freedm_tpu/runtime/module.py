@@ -50,3 +50,12 @@ class DgiModule(ABC):
 
     def handle_peer_list(self, coordinator: int, members) -> None:
         """Group view push (``ProcessPeerList`` counterpart)."""
+
+    def snapshot_state(self) -> Optional[Dict[str, Any]]:
+        """This module's contribution to a consistent-cut snapshot
+        (``freedm_tpu.core.snapshot``) — a JSON-serializable dict of
+        the state the invariant auditor reasons about, or ``None`` to
+        stay out of the cut.  Called between phases (or from the DCN
+        pump on marker receipt), so implementations must read only
+        host-side state — no device round-trips."""
+        return None

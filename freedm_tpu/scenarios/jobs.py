@@ -551,6 +551,21 @@ class JobManager:
                 "workers": self.workers,
             }
 
+    def snapshot_state(self) -> dict:
+        """Job-table cut for the snapshot auditor
+        (:mod:`freedm_tpu.core.snapshot`): ``total`` and ``by_state``
+        read in one lock hold, so the auditor's partition check
+        (``total == Σ by_state``) can only fail on a torn scrape."""
+        with self._cond:
+            states: Dict[str, int] = {}
+            for rec in self._jobs.values():
+                states[rec.state] = states.get(rec.state, 0) + 1
+            return {
+                "total": len(self._jobs),
+                "by_state": states,
+                "pending": len(self._pending),
+            }
+
     # -- worker --------------------------------------------------------------
     def _checkpoint_path(self, rec: JobRecord) -> Optional[str]:
         if rec.job_key is None or not self.checkpoint_dir:

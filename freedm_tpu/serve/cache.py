@@ -778,3 +778,28 @@ class ServeCache:
                     / max(sum(self._counts.values()), 1), 4
                 ),
             }
+
+    def snapshot_state(self) -> dict:
+        """Byte-accounting cut for the snapshot auditor
+        (:mod:`freedm_tpu.core.snapshot`): the running ``bytes`` counter
+        versus a from-scratch walk of the same structures under the same
+        lock hold — any difference is an accounting leak (a solution or
+        artifact added/removed without its byte delta)."""
+        with self._lock:
+            accounted = sum(
+                sol.nbytes
+                for ent in self._entries.values()
+                for sol in ent.solutions.values()
+            ) + sum(
+                ent.artifact_bytes
+                for ent in self._entries.values() if ent.accounted
+            )
+            return {
+                "bytes": self.bytes,
+                "accounted_bytes": accounted,
+                "budget_bytes": self.max_bytes,
+                "entries": len(self._entries),
+                "solutions": sum(len(e.solutions)
+                                 for e in self._entries.values()),
+                "inflight_leads": len(self._flights),
+            }

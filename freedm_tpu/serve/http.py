@@ -20,6 +20,10 @@ Routes:
   ``{"job_id": ...}``.
 - ``GET /v1/jobs/<id>`` — poll a job (progress, then the summary);
   ``POST /v1/jobs/<id>/cancel`` — stop it at the next chunk boundary.
+- ``POST /v1/snapshot`` — this replica's contribution to a router-
+  initiated consistent cut (:mod:`freedm_tpu.core.snapshot`): the
+  request-conservation ledger, cache byte accounting, and job table,
+  each read atomically.  Body: ``{"snapshot_id": ..., "node": ...}``.
 - ``GET /healthz`` — liveness + the workload/case table.
 - ``GET /metrics`` — this replica's Prometheus registry rendering, the
   per-replica half of the router's fleet federation scrape
@@ -62,6 +66,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from http.server import BaseHTTPRequestHandler
 from urllib.parse import urlparse
 
@@ -250,7 +255,7 @@ class ServeServer(BackgroundHttpServer):
                             "service": "freedm_tpu serve",
                             "post": [f"/v1/{w}" for w in WORKLOADS]
                             + ["/v1/qsts", "/v1/topo/sweep",
-                               "/v1/jobs/<id>/cancel"],
+                               "/v1/jobs/<id>/cancel", "/v1/snapshot"],
                             "get": ["/healthz", "/stats", "/metrics",
                                     "/provenance", "/v1/jobs/<id>"],
                         })
@@ -302,6 +307,33 @@ class ServeServer(BackgroundHttpServer):
                         # Async topology sweep beside QSTS: chunked +
                         # checkpointed, polled via GET /v1/jobs/<id>.
                         self._reply(202, self._jobs().submit_topo(payload))
+                        return
+                    if path == "/v1/snapshot":
+                        # This replica's contribution to a router-
+                        # initiated consistent cut (core/snapshot.py):
+                        # ledger + cache + job table, each read
+                        # atomically under its own leaf lock.  The
+                        # router supplies snapshot_id and the node name
+                        # it knows this replica by.
+                        if not isinstance(payload, dict):
+                            raise InvalidRequest(
+                                "snapshot body must be a JSON object"
+                            )
+                        doc = {
+                            "snapshot_id": payload.get("snapshot_id"),
+                            "node": payload.get("node")
+                            or f"replica:{self.server.server_port}",
+                            "status": "complete",
+                            "captured_at": time.time(),
+                            "serve": {
+                                "ledger": svc.ledger.snapshot_state()
+                            },
+                        }
+                        if svc.cache is not None:
+                            doc["cache"] = svc.cache.snapshot_state()
+                        if jm is not None:
+                            doc["jobs"] = jm.snapshot_state()
+                        self._reply(200, doc)
                         return
                     workload = path[len("/v1/"):]
                     apply_deadline_budget(

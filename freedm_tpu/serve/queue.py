@@ -111,6 +111,7 @@ class Ticket:
     __slots__ = (
         "key", "request", "prepared", "lanes", "enqueued_at",
         "deadline", "future", "span", "taken", "cache_flight",
+        "ledger_state",
     )
 
     def __init__(self, key: Tuple[str, str], request, prepared, lanes: int,
@@ -127,6 +128,10 @@ class Ticket:
         # Single-flight leadership (serve/cache.py): the (entry key,
         # injection digest) this ticket's solve populates, or None.
         self.cache_flight = None
+        # Conservation-ledger phase (serve/service.py SnapshotLedger):
+        # None → "inflight" → "ok"/"error"; guarded by the ledger's own
+        # lock so a ticket settles exactly once in a consistent cut.
+        self.ledger_state = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

@@ -46,7 +46,11 @@ liveness + the replica table), ``/stats``, and ``GET /metrics`` — the
 fleet federation scrape: every replica's registry with a ``replica``
 label injected plus the router's own series, so one scrape target
 covers the whole fleet (``router_federation_up`` marks replicas that
-missed the scrape).
+missed the scrape).  ``POST /v1/snapshot`` initiates a fleet-wide
+consistent cut (every replica's conservation ledger, cache accounting,
+and job table captured and audited — :mod:`freedm_tpu.core.snapshot`;
+docs/snapshots.md); ``GET /v1/snapshot/<id>`` serves the retained,
+audited cut document.
 
 Scope: the router fronts the synchronous what-if workloads
 (``POST /v1/pf|n1|vvc``).  QSTS jobs are replica-local state (a job id
@@ -63,6 +67,7 @@ import random
 import socket
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, NamedTuple, Optional, Tuple
 from urllib.parse import urlparse
@@ -251,6 +256,12 @@ class RouterConfig(NamedTuple):
     vnodes: int = 64
     #: Backoff-jitter seed (deterministic retries for tests/replays).
     seed: int = 0
+    #: Consistent-cut snapshot bound (``--snapshot-timeout-s``): the
+    #: fan-out to replicas never blocks the initiator past this — a
+    #: dead/stalled replica yields a typed incomplete cut, not a hang.
+    snapshot_timeout_s: float = 10.0
+    #: Per-node cut document cap (``--snapshot-max-bytes``).
+    snapshot_max_bytes: int = 4_000_000
 
 
 class _ProxyReply(NamedTuple):
@@ -277,6 +288,13 @@ class Router:
             self.add_replica(r)
         self._stop = threading.Event()
         self._prober: Optional[threading.Thread] = None
+        # Consistent-cut snapshot state (core/snapshot.py): one cut at
+        # a time (concurrent initiations answer a typed 409), bounded
+        # result retention.
+        self._snapshot_lock = threading.Lock()
+        self._snapshot_active = False
+        self._snapshot_counter = 0
+        self._snapshots: "OrderedDict[str, dict]" = OrderedDict()
 
     # -- membership ----------------------------------------------------------
     def add_replica(self, addr: str) -> None:
@@ -723,6 +741,129 @@ class Router:
             return False, _Overloaded(f"replica {st.id} overloaded")
         return True, _ProxyReply(status, payload, retry_after)
 
+    # -- consistent-cut snapshots (core/snapshot.py) -------------------------
+    def snapshot(self, snapshot_id: Optional[str] = None) -> dict:
+        """Initiate one fleet-wide consistent cut: fan out
+        ``POST /v1/snapshot`` to EVERY replica (dead ones stub in as
+        ``incomplete`` — the cut must cover the fleet, not the healthy
+        subset), assemble, audit, and retain the cut.  Bounded by
+        ``snapshot_timeout_s`` — a stalled replica can never wedge the
+        initiator — and serialized: a second initiation while one runs
+        answers the typed 409."""
+        from freedm_tpu.core import snapshot as snapmod
+
+        with self._snapshot_lock:
+            if self._snapshot_active:
+                obs.SNAPSHOT_CUTS.labels("rejected").inc()
+                raise _SnapshotBusy(
+                    "a fleet snapshot is already in progress; "
+                    "poll GET /v1/snapshot/<id> and retry"
+                )
+            self._snapshot_active = True
+            self._snapshot_counter += 1
+            sid = snapshot_id or (
+                f"cut-{self._snapshot_counter}-{int(time.time() * 1e3)}"
+            )
+        try:
+            return self._snapshot_run(snapmod, sid)
+        finally:
+            with self._snapshot_lock:
+                self._snapshot_active = False
+
+    def _snapshot_run(self, snapmod, sid: str) -> dict:
+        cfg = self.config
+        span = tracing.TRACER.start(
+            "snapshot.fleet", kind="snapshot", tags={"snapshot_id": sid}
+        )
+        t0 = time.monotonic()
+        with self._lock:
+            targets = list(self.replicas.values())
+        obs.EVENTS.emit(
+            "snapshot.start", snapshot_id=sid, node="router",
+            origin="router", peers=[st.id for st in targets],
+        )
+        docs: List[Optional[dict]] = [None] * len(targets)
+
+        def grab(i: int, st: ReplicaState) -> None:
+            docs[i] = self._snapshot_replica(st, sid,
+                                             cfg.snapshot_timeout_s)
+
+        threads = [
+            threading.Thread(target=grab, args=(i, st), daemon=True,
+                             name=f"snapshot-{st.id}")
+            for i, st in enumerate(targets)
+        ]
+        for th in threads:
+            th.start()
+        deadline = t0 + cfg.snapshot_timeout_s
+        for th in threads:
+            th.join(timeout=max(deadline - time.monotonic(), 0.0))
+        pending = []
+        node_docs: List[dict] = []
+        for st, doc in zip(targets, docs):
+            if doc is None:
+                pending.append(st.id)
+                node_docs.append({"snapshot_id": sid, "node": st.id,
+                                  "status": "incomplete"})
+            else:
+                doc.setdefault("node", st.id)
+                node_docs.append(
+                    snapmod.bound_doc(doc, cfg.snapshot_max_bytes)
+                )
+        cut = snapmod.assemble_cut(sid, node_docs)
+        violations = snapmod.audit_cut(cut)
+        snapmod.record_violations(sid, violations)
+        capture_ms = round((time.monotonic() - t0) * 1e3, 3)
+        cut["origin"] = "router"
+        cut["captured_at"] = time.time()
+        cut["capture_ms"] = capture_ms
+        cut["violations"] = [v.as_dict() for v in violations]
+        with self._snapshot_lock:
+            self._snapshots[sid] = cut
+            while len(self._snapshots) > snapmod.KEEP_CUTS:
+                self._snapshots.popitem(last=False)
+        obs.SNAPSHOT_CUTS.labels(cut["status"]).inc()
+        obs.SNAPSHOT_CAPTURE.observe(capture_ms / 1e3)
+        if cut["status"] == "complete":
+            obs.EVENTS.emit("snapshot.complete", snapshot_id=sid,
+                            node="router", capture_ms=capture_ms,
+                            violations=len(violations))
+        else:
+            obs.EVENTS.emit("snapshot.incomplete", snapshot_id=sid,
+                            node="router", capture_ms=capture_ms,
+                            pending=pending,
+                            timeout_s=cfg.snapshot_timeout_s)
+        span.tag(outcome=cut["status"], capture_ms=capture_ms)
+        span.end()
+        return cut
+
+    def _snapshot_replica(self, st: ReplicaState, sid: str,
+                          timeout_s: float) -> Optional[dict]:
+        body = json.dumps({"snapshot_id": sid, "node": st.id}).encode()
+        try:
+            conn = http.client.HTTPConnection(
+                st.host, st.port, timeout=max(timeout_s, 0.001)
+            )
+            try:
+                conn.request(
+                    "POST", "/v1/snapshot", body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                payload = resp.read()
+                if resp.status != 200:
+                    return None
+                doc = json.loads(payload)
+                return doc if isinstance(doc, dict) else None
+            finally:
+                conn.close()
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+
+    def snapshot_result(self, snapshot_id: str) -> Optional[dict]:
+        with self._snapshot_lock:
+            return self._snapshots.get(snapshot_id)
+
     # -- introspection -------------------------------------------------------
     def states(self) -> Dict[str, dict]:
         with self._lock:
@@ -762,6 +903,15 @@ class _Overloaded(ServeError):
 class _RouterInternal(ServeError):
     code = "internal"
     http_status = 500
+
+
+class _SnapshotBusy(ServeError):
+    """One consistent cut at a time: a concurrent initiation is a
+    client-visible, typed conflict — never a second marker wave."""
+
+    code = "snapshot_in_progress"
+    http_status = 409
+    retry_after_s = 1.0
 
 
 def _error_code(payload: bytes) -> Optional[str]:
@@ -845,6 +995,22 @@ class RouterServer:
                         self._reply(
                             200, (json.dumps(rt.stats()) + "\n").encode()
                         )
+                    elif path.startswith("/v1/snapshot/"):
+                        sid = path[len("/v1/snapshot/"):]
+                        cut = rt.snapshot_result(sid)
+                        if cut is None:
+                            r = _error_reply(NotFound(
+                                f"unknown snapshot_id {sid!r} (cuts are "
+                                f"retained bounded; re-initiate with "
+                                f"POST /v1/snapshot)"
+                            ))
+                            self._reply(404, r.body)
+                        else:
+                            self._reply(
+                                200,
+                                (json.dumps(cut, default=str)
+                                 + "\n").encode(),
+                            )
                     elif path == "/metrics":
                         # Fleet federation: replica registries summed
                         # under a replica label + the router's own
@@ -872,6 +1038,19 @@ class RouterServer:
                 path = urlparse(self.path).path
                 try:
                     body = self._read_body()
+                    if path == "/v1/snapshot":
+                        # Initiate one fleet-wide consistent cut; the
+                        # full audited document is at
+                        # GET /v1/snapshot/<id>.
+                        cut = rt.snapshot()
+                        self._reply(200, (json.dumps({
+                            "snapshot_id": cut["snapshot_id"],
+                            "status": cut["status"],
+                            "nodes": sorted(cut["nodes"]),
+                            "capture_ms": cut["capture_ms"],
+                            "violations": cut["violations"],
+                        }) + "\n").encode())
+                        return
                     if not path.startswith("/v1/"):
                         r = _error_reply(NotFound(f"no route {path!r}"))
                         self._reply(404, r.body)

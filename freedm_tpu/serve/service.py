@@ -1173,6 +1173,85 @@ def parse_request(workload: str, payload: dict):
 # ---------------------------------------------------------------------------
 
 
+class SnapshotLedger:
+    """Request-conservation ledger for consistent-cut snapshots
+    (:mod:`freedm_tpu.core.snapshot`).
+
+    Every transition happens under one leaf lock, and each submission
+    is classified atomically with its ``offered`` bump, so a
+    ``snapshot_state()`` read taken at ANY instant satisfies the
+    invariants the cut auditor checks:
+
+        offered  == admitted + shed + rejected
+        admitted == ok + error + inflight   (inflight derived, >= 0)
+
+    A torn scrape — two reads at different times stitched into one
+    "state" — breaks the first equation as soon as any request was
+    offered between the reads, which is exactly the negative proof
+    ``torn_serve_doc`` builds.  Settlement is idempotent per ticket
+    (``Ticket.ledger_state``), so the expire/error/abort paths may race
+    without double-counting.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.offered = 0
+        self.admitted = 0
+        self.shed = 0
+        self.rejected = 0
+        self.ok = 0
+        self.error = 0
+
+    def admit(self, ticket: Ticket) -> None:
+        with self._lock:
+            if ticket.ledger_state is not None:
+                return  # a racing settle already implied admission
+            ticket.ledger_state = "inflight"
+            self.offered += 1
+            self.admitted += 1
+
+    def shed_one(self) -> None:
+        with self._lock:
+            self.offered += 1
+            self.shed += 1
+
+    def reject(self) -> None:
+        with self._lock:
+            self.offered += 1
+            self.rejected += 1
+
+    def settle(self, ticket: Ticket, ok: bool) -> None:
+        with self._lock:
+            st = ticket.ledger_state
+            if st in ("ok", "error"):
+                return  # already settled (e.g. expire racing an error)
+            if st is None:
+                # Settled before submit() reached its admit() call (a
+                # cache-tier hit completes inline): imply the admission
+                # so the equations never see a settled-but-unadmitted
+                # ticket.
+                self.offered += 1
+                self.admitted += 1
+            if ok:
+                ticket.ledger_state = "ok"
+                self.ok += 1
+            else:
+                ticket.ledger_state = "error"
+                self.error += 1
+
+    def snapshot_state(self) -> dict:
+        with self._lock:
+            return {
+                "offered": self.offered,
+                "admitted": self.admitted,
+                "shed": self.shed,
+                "rejected": self.rejected,
+                "ok": self.ok,
+                "error": self.error,
+                "inflight": self.admitted - self.ok - self.error,
+            }
+
+
 def default_buckets(max_batch: int) -> Tuple[int, ...]:
     """Powers of two plus their 1.5x intermediates up to (and
     including) ``max_batch`` — the static shape set jit programs are
@@ -1354,6 +1433,11 @@ class Service:
                 precision=config.pf_precision,
                 verify_tol=config.cache_verify_tol,
             )
+        # Request-conservation ledger the snapshot auditor checks; all
+        # completion paths funnel through _complete_ok/_complete_error/
+        # _expire, so settle() there covers batched, cached, follower,
+        # expired, and drained tickets alike.
+        self.ledger = SnapshotLedger()
         self._engines: Dict[Tuple[str, str], _Engine] = {}
         # Global lock guards the maps only; SLOW engine construction
         # (XLA compiles in VVCEngine/N1Engine __init__) happens under a
@@ -1541,12 +1625,14 @@ class Service:
             timeout = float(getattr(request, "timeout_s", 0) or 0)
         except InvalidRequest:
             obs.SERVE_REQUESTS.labels(wl, "invalid").inc()
+            self.ledger.reject()
             raise
         except (TypeError, ValueError) as e:
             # Wrong-typed field VALUES (e.g. scale="1.1", outages=5) come
             # out of numpy/float coercion as raw TypeError/ValueError —
             # still the client's fault, still a typed 400.
             obs.SERVE_REQUESTS.labels(wl, "invalid").inc()
+            self.ledger.reject()
             raise InvalidRequest(f"malformed request field: {e}") from None
         if timeout <= 0:
             timeout = self.config.default_timeout_s
@@ -1580,22 +1666,29 @@ class Service:
                 ticket.span.tag(cache_error=repr(e))
                 fut = None
             if fut is not None:
+                # Cache-tier answer or joined flight: the ticket was
+                # (or will be) settled through _complete_ok/_error —
+                # admit() is a no-op if the settle already implied it.
+                self.ledger.admit(ticket)
                 return fut
         try:
             self.queue.put(ticket)
         except Overloaded as e:
             obs.SERVE_SHED.inc()
             obs.SERVE_REQUESTS.labels(workload, "overloaded").inc()
+            self.ledger.shed_one()
             span.tag(outcome="overloaded")
             span.end()
             self._abort_flight(ticket, e)
             raise
         except ShuttingDown as e:
             obs.SERVE_REQUESTS.labels(workload, "shutdown").inc()
+            self.ledger.reject()
             span.tag(outcome="shutdown")
             span.end()
             self._abort_flight(ticket, e)
             raise
+        self.ledger.admit(ticket)
         return ticket.future
 
     def request(self, workload: str, request,
@@ -1616,6 +1709,7 @@ class Service:
             except InvalidRequest:
                 wl = workload if workload in WORKLOADS else "unknown"
                 obs.SERVE_REQUESTS.labels(wl, "invalid").inc()
+                self.ledger.reject()
                 raise
         if timeout_s is not None and hasattr(request, "timeout_s"):
             request = dataclasses.replace(request, timeout_s=float(timeout_s))
@@ -1828,6 +1922,7 @@ class Service:
 
     # -- completion accounting (called by the batcher / queue) ---------------
     def _expire(self, ticket: Ticket) -> None:
+        self.ledger.settle(ticket, ok=False)
         obs.SERVE_REQUESTS.labels(ticket.key[0], "deadline").inc()
         obs.SERVE_REQUEST_LATENCY.observe(
             max(_time.monotonic() - ticket.enqueued_at, 0.0)
@@ -1839,6 +1934,7 @@ class Service:
         self._abort_flight(ticket, err)
 
     def _complete_ok(self, ticket: Ticket, info: BatchInfo) -> None:
+        self.ledger.settle(ticket, ok=True)
         self._ok_counters[ticket.key[0]].inc()
         # The exemplar links a latency bucket straight to its trace
         # (NOOP.trace_id is None = no exemplar recorded).
@@ -1853,6 +1949,7 @@ class Service:
             span.end()
 
     def _complete_error(self, ticket: Ticket, err: BaseException) -> None:
+        self.ledger.settle(ticket, ok=False)
         outcome = err.code if isinstance(err, ServeError) else "error"
         obs.SERVE_REQUESTS.labels(ticket.key[0], outcome).inc()
         obs.SERVE_REQUEST_LATENCY.observe(
@@ -1934,7 +2031,19 @@ class Service:
             "queue_wait_seconds": metric("serve_queue_wait_seconds"),
             "solve_seconds": metric("serve_solve_seconds"),
             "request_seconds": metric("serve_request_seconds"),
+            # Request-conservation ledger (the snapshot auditor's
+            # ticket-accounting input; docs/snapshots.md).
+            "ledger": self.ledger.snapshot_state(),
         }
+
+    def snapshot_state(self) -> dict:
+        """This replica's serve-side contribution to a consistent cut:
+        the conservation ledger plus the cache's byte accounting, each
+        read atomically under its own leaf lock."""
+        doc = {"ledger": self.ledger.snapshot_state()}
+        if self.cache is not None:
+            doc["cache"] = self.cache.snapshot_state()
+        return doc
 
     def start(self) -> "Service":
         self.batcher.start()

@@ -31,6 +31,14 @@ SIGTERM (stops admitting, finishes in-flight, exits 0).
 ``tools/soak.py --chaos`` folds this rig's artifact into the soak
 artifact.  Exit code 0 iff every check passed.
 
+The rig also proves the **consistent-cut snapshot** machinery
+(:mod:`freedm_tpu.core.snapshot`) adversarially: ≥3 marker-coordinated
+cuts taken *during* the fault schedule must audit clean (zero
+``snapshot_violations_total``), a deliberately uncoordinated torn
+scrape of the same fleet must flag ≥1 bogus ticket-accounting
+violation, and a cut taken after the kill must come back as a typed
+``incomplete`` within the snapshot deadline — never a hung initiator.
+
 Every replica also runs the **shadow verifier**
 (:mod:`freedm_tpu.core.provenance`) at rate 1.0 on the cache tiers, and
 the rig gates on **zero shadow mismatches**: a chaos run that passes
@@ -329,6 +337,58 @@ def _hit_ratio_probe(router_port: int, cases: List[str],
     return round(hits / lookups, 4) if lookups > 0 else None
 
 
+def _torn_scrape_proof(check: _Check, replica: _Replica,
+                       primed_case: str) -> int:
+    """The negative proof: an UNCOORDINATED scrape of a live replica —
+    admission counters from one instant glued to offer counters from a
+    later one, with traffic in between — must flag ticket-accounting
+    violations the marker-coordinated cut does not.  Returns the bogus
+    violation count."""
+    from freedm_tpu.core import snapshot as snap
+
+    early = _get_json(replica.port, "/stats").get("ledger") or {}
+    # Deterministic traffic between the two scrapes: every request
+    # moves `offered`, so the torn document cannot balance.
+    for _ in range(4):
+        _post_pf_replica(replica.port, primed_case)
+    late = _get_json(replica.port, "/stats").get("ledger") or {}
+    torn = snap.torn_serve_doc(early, late)
+    cut = snap.assemble_cut("torn-proof", [{
+        "snapshot_id": "torn-proof", "node": replica.id or "replica",
+        "status": "complete", "serve": torn,
+    }])
+    violations = snap.audit_cut(cut)
+    check.record(
+        "torn_scrape_flags_violation",
+        any(v.check == "ticket_accounting" for v in violations),
+        f"violations={[v.check for v in violations]} "
+        f"early_offered={early.get('offered')} "
+        f"late_offered={late.get('offered')}",
+    )
+    return len(violations)
+
+
+def _post_pf_replica(port: int, case: str, timeout_s: float = 90.0) -> bool:
+    """One pf request DIRECTLY to a replica (bypassing the router) —
+    the torn proof needs traffic that lands on one known ledger."""
+    import urllib.error
+    import urllib.request
+
+    body = json.dumps({"case": case, "timeout_s": timeout_s}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/pf", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s + 5) as r:
+            return r.status == 200
+    except urllib.error.HTTPError as e:
+        e.close()
+        return False
+    except Exception:
+        return False
+
+
 def run_chaos(n_replicas: int = 3, load_s: float = 6.0,
               post_kill_s: float = 8.0, clients: int = 4,
               kill_after: int = 80, out: Optional[str] = None,
@@ -368,6 +428,7 @@ def run_chaos(n_replicas: int = 3, load_s: float = 6.0,
     loader = None
     summary: Dict[str, object] = {}
     shadow: Dict[str, float] = {}
+    cuts: List[Dict] = []
     try:
         ports = [rep.wait_port(300.0) for rep in replicas]
         check.record("replicas_up", all(p is not None for p in ports),
@@ -381,6 +442,10 @@ def run_chaos(n_replicas: int = 3, load_s: float = 6.0,
                 breaker_failures=2,
                 breaker_cooldown_s=1.0,
                 default_timeout_s=60.0,
+                # A dead replica fails the snapshot POST fast; the
+                # bound only matters for a STALLED one, and 5 s keeps
+                # the post-kill incomplete-cut proof snappy.
+                snapshot_timeout_s=5.0,
             ),
         )
         router_server = RouterServer(router, port=0).start()
@@ -429,6 +494,38 @@ def run_chaos(n_replicas: int = 3, load_s: float = 6.0,
             router_server.port, n_threads=clients,
             cases=tuple(LOAD_CASES) + tuple(victim_cases),
         ).start()
+        # Consistent cuts DURING the fault schedule: marker-coordinated
+        # snapshots taken while the mixed load (and replica 1's
+        # exec.crash faults) are in flight must audit clean — every
+        # per-replica ledger/cache scrape is atomic under its own lock,
+        # so the assembled cut balances at any instant.
+        clean = 0
+        for _ in range(10):
+            if not victim.alive():
+                break
+            try:
+                cut = router.snapshot()
+            except Exception:
+                break
+            cuts.append(cut)
+            if cut["status"] == "complete" and not cut["violations"]:
+                clean += 1
+            if clean >= 3:
+                break
+            time.sleep(0.15)
+        check.record(
+            "three_consistent_cuts_under_load", clean >= 3,
+            f"cuts={len(cuts)} complete_clean={clean} "
+            f"violations={sum(len(c['violations']) for c in cuts)}",
+        )
+        check.record(
+            "zero_snapshot_violations",
+            all(not c["violations"] for c in cuts),
+            f"violations={[v for c in cuts for v in c['violations']]}",
+        )
+        # The torn-read negative proof on the SAME fleet, mid-load: the
+        # clean replica (no fault spec) takes the uncoordinated scrape.
+        _torn_scrape_proof(check, replicas[-1], LOAD_CASES[0])
         time.sleep(load_s)
         killed = not victim.alive()
         deadline = time.monotonic() + post_kill_s
@@ -441,6 +538,26 @@ def run_chaos(n_replicas: int = 3, load_s: float = 6.0,
             "replica_killed_by_schedule", killed,
             f"victim={victim.id} rc={victim.proc.poll()}",
         )
+        # Mid-fleet-death snapshot: a cut taken with the victim dead
+        # must come back as a TYPED incomplete (the dead replica a
+        # status=incomplete stub) within the snapshot deadline — a hung
+        # initiator here is exactly the failure mode the bound exists
+        # to kill.  The surviving nodes' docs still audit clean.
+        snap_t0 = time.monotonic()
+        try:
+            post_cut = router.snapshot()
+        except Exception as e:  # noqa: BLE001
+            post_cut = {"status": f"error:{e!r}", "violations": [None]}
+        snap_elapsed = time.monotonic() - snap_t0
+        check.record(
+            "post_kill_cut_typed_incomplete",
+            post_cut["status"] == "incomplete"
+            and not post_cut["violations"]
+            and snap_elapsed < 5.0 + 2.0,
+            f"status={post_cut['status']} elapsed_s={snap_elapsed:.2f} "
+            f"violations={post_cut['violations']}",
+        )
+        cuts.append(post_cut)
         check.record(
             "zero_untyped_client_failures", summary["untyped"] == 0,
             f"untyped={summary['untyped']} over {summary['requests']}",
@@ -524,6 +641,12 @@ def run_chaos(n_replicas: int = 3, load_s: float = 6.0,
         "load": summary,
         "router": router_stats,
         "shadow": shadow,
+        "snapshots": [
+            {"snapshot_id": c.get("snapshot_id"), "status": c.get("status"),
+             "capture_ms": c.get("capture_ms"),
+             "violations": c.get("violations")}
+            for c in cuts
+        ],
         "fault_specs": specs[:n_replicas],
         "workdir": wd,
     }

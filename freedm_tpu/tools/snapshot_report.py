@@ -1,0 +1,159 @@
+"""Audit a consistent-cut fleet snapshot: typed findings, typed exit.
+
+The offline half of the snapshot observatory (docs/snapshots.md): the
+capture machinery (:mod:`freedm_tpu.core.snapshot`) assembles cut
+documents at runtime; this tool re-runs the invariant auditor over a
+cut AFTER the fact — from a stored cut file, from the ``snapshot.node``
+events in one or more slice journals, or (the negative proof) from two
+uncoordinated ``/stats`` scrapes glued into a torn document.
+
+Modes (exactly one):
+
+``--cut cut.json``
+    An assembled cut document — the body of the router's
+    ``GET /v1/snapshot/<id>``, a coordinator node doc from the metrics
+    server's ``GET /snapshot?id=``, or anything :func:`assemble_cut`
+    produced.  A bare node doc (no ``nodes`` map) is wrapped into a
+    single-node cut first.
+
+``--events journal.jsonl [more.jsonl ...] [--snapshot-id SID]``
+    Assemble the cut from the ``snapshot.node`` records in the given
+    event journals (each slice journals its own doc when its cut
+    closes).  Without ``--snapshot-id`` the newest snapshot_id seen
+    across the journals is audited.
+
+``--torn early_stats.json late_stats.json``
+    The negative proof: glue the admission counters of the EARLY
+    ``/stats`` scrape to the offer/settle counters of the LATE one
+    (:func:`torn_serve_doc`) and audit that — under traffic between the
+    two scrapes this MUST flag ticket-accounting violations, which is
+    what demonstrates the marker coordination is load-bearing.
+
+Exit codes: **0** the cut audits clean, **1** the auditor returned one
+or more typed violations, **2** internal error (unreadable input, no
+nodes to audit).  The report itself is one JSON object on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from freedm_tpu.core.snapshot import (
+    Violation,
+    assemble_cut,
+    audit_cut,
+    torn_serve_doc,
+)
+
+
+def _load_json(path: str) -> Dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def cut_from_file(path: str) -> Dict:
+    """A stored cut document; a bare node doc becomes a one-node cut."""
+    doc = _load_json(path)
+    if "nodes" in doc:
+        return doc
+    sid = str(doc.get("snapshot_id", "cut"))
+    return assemble_cut(sid, [doc])
+
+
+def cut_from_journals(paths: List[str],
+                      snapshot_id: Optional[str] = None) -> Optional[Dict]:
+    """Assemble a cut from ``snapshot.node`` journal records.  Every
+    slice journals its own per-node doc; joining the journals joins the
+    fleet.  Newest snapshot wins when no id is pinned."""
+    node_events: List[Dict] = []
+    for path in paths:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line (a live journal)
+                if rec.get("event") == "snapshot.node" and "doc" in rec:
+                    node_events.append(rec)
+    if snapshot_id is None:
+        if not node_events:
+            return None
+        snapshot_id = node_events[-1].get("snapshot_id")
+    docs = [rec["doc"] for rec in node_events
+            if rec.get("snapshot_id") == snapshot_id]
+    if not docs:
+        return None
+    return assemble_cut(str(snapshot_id), docs)
+
+
+def torn_cut(early_path: str, late_path: str) -> Dict:
+    """The uncoordinated-scrape document, as a one-node cut."""
+    early = _load_json(early_path)
+    late = _load_json(late_path)
+    torn = torn_serve_doc(early.get("ledger", early),
+                          late.get("ledger", late))
+    return assemble_cut("torn-scrape", [{
+        "snapshot_id": "torn-scrape",
+        "node": str(early.get("node", "scrape")),
+        "status": "complete",
+        "serve": torn,
+    }])
+
+
+def report(cut: Dict) -> Dict:
+    violations: List[Violation] = audit_cut(cut)
+    return {
+        "snapshot_id": cut.get("snapshot_id"),
+        "status": cut.get("status"),
+        "nodes": sorted(cut.get("nodes", {})),
+        "violations": [v.as_dict() for v in violations],
+        "pass": not violations,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Audit a consistent-cut fleet snapshot "
+                    "(exit 0 clean / 1 violations / 2 internal error)"
+    )
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--cut", metavar="CUT_JSON",
+                      help="stored cut document (or bare node doc)")
+    mode.add_argument("--events", nargs="+", metavar="JOURNAL",
+                      help="assemble the cut from snapshot.node records "
+                           "in these event journals")
+    mode.add_argument("--torn", nargs=2,
+                      metavar=("EARLY_STATS", "LATE_STATS"),
+                      help="negative proof: audit the torn document two "
+                           "uncoordinated /stats scrapes produce")
+    ap.add_argument("--snapshot-id", default=None, metavar="SID",
+                    help="pin the snapshot to audit (--events mode; "
+                         "default: the newest one journaled)")
+    args = ap.parse_args(argv)
+    try:
+        if args.cut:
+            cut = cut_from_file(args.cut)
+        elif args.torn:
+            cut = torn_cut(args.torn[0], args.torn[1])
+        else:
+            cut = cut_from_journals(args.events, args.snapshot_id)
+        if cut is None or not cut.get("nodes"):
+            print(json.dumps({"error": "no node documents to audit",
+                              "snapshot_id": args.snapshot_id}))
+            return 2
+        rep = report(cut)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(json.dumps({"error": repr(e)}))
+        return 2
+    print(json.dumps(rep, indent=2))
+    return 0 if rep["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

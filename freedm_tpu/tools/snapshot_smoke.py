@@ -1,0 +1,170 @@
+"""Snapshot smoke: 3-replica fleet + router, one clean cut, one torn.
+
+The CI acceptance step for the consistent-cut observatory
+(docs/snapshots.md): spin up a small real fleet — three serve replica
+processes (reusing the chaos rig's ``--replica`` entry) fronted by the
+cache-affinity router in this process — then prove both directions:
+
+- **clean cut**: ``POST /v1/snapshot`` through the router assembles a
+  complete marker-coordinated cut with ZERO invariant violations, the
+  stored cut is served back at ``GET /v1/snapshot/<id>``, and
+  ``tools/snapshot_report.py --cut`` exits 0 on it;
+- **torn scrape**: two uncoordinated ``/stats`` scrapes of one replica
+  with traffic in between, glued by ``snapshot_report.py --torn``, MUST
+  exit 1 with a ``ticket_accounting`` finding — the same fleet, the
+  same counters, only the coordination missing.
+
+One command, one pass/fail JSON artifact::
+
+    python -m freedm_tpu.tools.snapshot_smoke --out snapshot_smoke.json
+
+Exit code 0 iff every check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+from freedm_tpu.tools.chaos import (
+    REPO,
+    _Check,
+    _Replica,
+    _get_json,
+    _post_pf,
+    _post_pf_replica,
+)
+
+CASE = "case14"
+
+
+def run_smoke(n_replicas: int = 3, out: Optional[str] = None,
+              workdir: Optional[str] = None) -> Dict:
+    import tempfile
+
+    from freedm_tpu.serve.router import Router, RouterConfig, RouterServer
+    from freedm_tpu.tools import snapshot_report
+
+    t0 = time.monotonic()
+    wd = workdir or tempfile.mkdtemp(prefix="freedm_snapsmoke_")
+    cache_dir = os.path.join(wd, "jax_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    env = dict(
+        os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+        JAX_COMPILATION_CACHE_DIR=cache_dir,
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="1",
+    )
+    check = _Check()
+    replicas = [_Replica(i, None, env) for i in range(n_replicas)]
+    router_server = None
+    cut: Dict = {}
+    try:
+        ports = [rep.wait_port(300.0) for rep in replicas]
+        check.record("replicas_up", all(p is not None for p in ports),
+                     f"ports={ports}")
+        if not all(p is not None for p in ports):
+            raise RuntimeError("replica spawn failed")
+        router = Router(
+            [rep.id for rep in replicas],
+            RouterConfig(probe_interval_s=0.5, default_timeout_s=60.0),
+        )
+        router_server = RouterServer(router, port=0).start()
+        primed = _post_pf(router_server.port, CASE, timeout_s=240.0)
+        check.record("fleet_primed", primed, f"case={CASE}")
+
+        # Clean cut: marker-coordinated capture over the whole fleet.
+        cut = router.snapshot()
+        check.record(
+            "clean_cut_complete",
+            cut["status"] == "complete"
+            and len(cut["nodes"]) == n_replicas,
+            f"status={cut['status']} nodes={sorted(cut['nodes'])}",
+        )
+        check.record(
+            "clean_cut_zero_violations", not cut["violations"],
+            f"violations={cut['violations']}",
+        )
+        served = _get_json(router_server.port,
+                           f"/v1/snapshot/{cut['snapshot_id']}")
+        check.record(
+            "cut_served_by_id",
+            served.get("snapshot_id") == cut["snapshot_id"],
+            f"GET /v1/snapshot/{cut['snapshot_id']}",
+        )
+        cut_path = os.path.join(wd, "cut.json")
+        with open(cut_path, "w") as fh:
+            json.dump(cut, fh)
+        rc = snapshot_report.main(["--cut", cut_path])
+        check.record("report_clean_cut_exit_0", rc == 0, f"rc={rc}")
+
+        # Torn scrape on the SAME fleet: counters from two instants,
+        # traffic in between — the report must exit 1.
+        victim = replicas[0]
+        early = _get_json(victim.port, "/stats")
+        for _ in range(4):
+            _post_pf_replica(victim.port, CASE)
+        late = _get_json(victim.port, "/stats")
+        early_path = os.path.join(wd, "early_stats.json")
+        late_path = os.path.join(wd, "late_stats.json")
+        with open(early_path, "w") as fh:
+            json.dump(early, fh)
+        with open(late_path, "w") as fh:
+            json.dump(late, fh)
+        rc = snapshot_report.main(["--torn", early_path, late_path])
+        check.record(
+            "report_torn_scrape_exit_1", rc == 1,
+            f"rc={rc} early_offered={(early.get('ledger') or {}).get('offered')} "
+            f"late_offered={(late.get('ledger') or {}).get('offered')}",
+        )
+    except Exception as e:  # noqa: BLE001 — the artifact must exist
+        check.record("rig_error", False, repr(e))
+    finally:
+        if router_server is not None:
+            router_server.stop()
+        for rep in replicas:
+            if rep.alive():
+                rep.proc.terminate()
+        deadline = time.monotonic() + 10.0
+        for rep in replicas:
+            while rep.alive() and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if rep.alive():
+                rep.proc.kill()
+    artifact = {
+        "pass": check.passed,
+        "replicas": n_replicas,
+        "duration_s": round(time.monotonic() - t0, 1),
+        "checks": check.results,
+        "snapshot_id": cut.get("snapshot_id"),
+        "capture_ms": cut.get("capture_ms"),
+        "workdir": wd,
+    }
+    if out:
+        with open(out, "w") as fh:
+            json.dump(artifact, fh, indent=2)
+    print(json.dumps({"snapshot_smoke_pass": artifact["pass"],
+                      "failed": [c["name"] for c in check.results
+                                 if not c["ok"]]}), flush=True)
+    return artifact
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Consistent-cut snapshot smoke "
+                    "(3-replica fleet + router)"
+    )
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--out", default=None, metavar="PATH")
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args(argv)
+    artifact = run_smoke(n_replicas=args.replicas, out=args.out,
+                         workdir=args.workdir)
+    return 0 if artifact["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -725,6 +725,78 @@ class AgentsProbe(QstsProbe):
         return run_study(StudySpec(**spec))
 
 
+class SnapshotProbe:
+    """One marker-coordinated fleet snapshot taken mid-fault-schedule
+    (docs/snapshots.md): POST ``/snapshot`` on one slice's metrics
+    server initiates the Chandy–Lamport cut over the live federation;
+    every slice's per-node cut document is then collected from its own
+    ``GET /snapshot?id=``, assembled, and audited in this process.  The
+    soak gates on the assembled cut being complete with ZERO invariant
+    violations — under 20% UDP loss and after two kill/rejoin cycles is
+    exactly when an inconsistent capture would show.
+    ``--no-snapshot-probe`` is the escape hatch."""
+
+    def __init__(self, slices: List[tuple]):
+        #: (uuid, metrics_port) per live slice; the first one initiates.
+        self.slices = list(slices)
+
+    @staticmethod
+    def _initiate(port: int, timeout_s: float = 10.0) -> Optional[str]:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/snapshot", data=b"", method="POST"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return json.loads(r.read()).get("snapshot_id")
+        except Exception:
+            return None
+
+    def run(self, timeout_s: float = 60.0) -> Optional[Dict]:
+        from freedm_tpu.core import snapshot as snap
+
+        if not self.slices:
+            return None
+        sid = self._initiate(self.slices[0][1])
+        if sid is None:
+            return None
+        # Each node's coordinator stores its own doc when its cut
+        # closes (all markers back); poll every slice until all report
+        # or the budget runs out — a missing doc is an incomplete node.
+        deadline = time.monotonic() + timeout_s
+        docs: Dict[str, Dict] = {}
+        while time.monotonic() < deadline and len(docs) < len(self.slices):
+            for uuid, port in self.slices:
+                if uuid in docs:
+                    continue
+                doc = scrape_json_route(port, f"/snapshot?id={sid}")
+                if doc.get("snapshot_id") == sid:
+                    docs[uuid] = doc
+            if len(docs) < len(self.slices):
+                time.sleep(0.25)
+        for uuid, _ in self.slices:
+            docs.setdefault(uuid, {
+                "snapshot_id": sid, "node": uuid, "status": "incomplete",
+            })
+        cut = snap.assemble_cut(sid, list(docs.values()))
+        violations = snap.audit_cut(cut)
+        capture = [
+            d.get("capture_ms") for d in docs.values()
+            if d.get("capture_ms") is not None
+        ]
+        return {
+            "snapshot_id": sid,
+            "status": cut["status"],
+            "nodes": len(cut["nodes"]),
+            "violations": [v.as_dict() for v in violations],
+            "capture_ms_max": max(capture) if capture else None,
+            "node_status": {
+                u: d.get("status") for u, d in sorted(docs.items())
+            },
+        }
+
+
 def wait_for(procs: List[Proc], cond, timeout_s: float) -> bool:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
@@ -910,6 +982,7 @@ def run_soak(
     qsts_probe: bool = False,
     topo_probe: bool = False,
     agents_probe: bool = False,
+    snapshot_probe: bool = True,
     chaos: bool = False,
 ) -> Dict:
     import tempfile
@@ -943,6 +1016,7 @@ def run_soak(
     loader: Optional[ServeLoader] = None
     serve_summary: Optional[Dict[str, float]] = None
     cache_summary: Optional[Dict[str, float]] = None
+    snapshot_summary: Optional[Dict] = None
     slo_pairs: List[Dict] = []
     pre_kill_pairs: List[Dict] = []
     slo_status: Dict = {}
@@ -1102,6 +1176,27 @@ def run_soak(
             check.record("agents_probe_resubmitted",
                          aprobe.submit(timeout_s=form_timeout),
                          "same job_key after restart")
+
+        # Consistent-cut snapshot MID-schedule: the fleet just re-merged
+        # after the member kill (every slice live again) and the leader
+        # kill is still ahead — a marker-coordinated cut over the lossy
+        # federation must assemble complete and audit clean.
+        if snapshot_probe:
+            live = [
+                (p.spec.uuid, p.spec.metrics_port)
+                for p in procs
+                if p.alive() and p.spec.metrics_port is not None
+            ]
+            snapshot_summary = SnapshotProbe(live).run(
+                timeout_s=max(60.0, form_timeout / 3.0)
+            )
+            check.record(
+                "snapshot_probe_clean",
+                snapshot_summary is not None
+                and snapshot_summary["status"] == "complete"
+                and not snapshot_summary["violations"],
+                f"summary={snapshot_summary}",
+            )
 
         # Kill the LEADER: re-election among survivors + slave VVC
         # fallback (members keep volt-var alive without their master).
@@ -1447,6 +1542,8 @@ def run_soak(
             },
         },
     }
+    if snapshot_summary is not None:
+        artifact["snapshot"] = snapshot_summary
     if chaos_artifact is not None:
         artifact["chaos"] = chaos_artifact
     if out:
@@ -1478,6 +1575,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the QSTS kill/resume determinism probe")
     ap.add_argument("--no-agents-probe", action="store_true",
                     help="skip the agent-population kill/resume probe")
+    ap.add_argument("--no-snapshot-probe", action="store_true",
+                    help="skip the mid-schedule consistent-cut fleet "
+                         "snapshot + invariant audit")
     ap.add_argument("--chaos", action="store_true",
                     help="also run the replicated-serving chaos phase "
                          "(3 replicas + router, deterministic kill "
@@ -1490,6 +1590,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         qsts_probe=not args.no_qsts_probe,
         topo_probe=not args.no_topo_probe,
         agents_probe=not args.no_agents_probe,
+        snapshot_probe=not args.no_snapshot_probe,
         chaos=args.chaos,
     )
     return 0 if artifact["pass"] else 1

@@ -526,6 +526,47 @@ def test_trace_propagation_survives_lossy_udp_channel():
     assert all(m.trace["span_id"] in recv_ids for m in got)
 
 
+def test_marker_frame_dropped_unacked_without_snapshot_handler():
+    # Forward-compat pin (core.snapshot): to a channel with no
+    # ``on_marker`` handler a MARKER is an unknown status — dropped
+    # unACKed, byte-for-byte what a pre-marker build does.  The sender's
+    # marker dies at its TTL and the snapshot initiator resolves the
+    # channel as a typed incomplete; nothing wedges, and ordinary
+    # traffic keeps flowing through the gap-skip afterwards.
+    a = SrChannel("b", src_uuid="a", ttl_s=0.3)
+    b = SrChannel("a", src_uuid="b")  # on_marker unset: pre-marker peer
+    a.send(msg(0), 0.0)
+    b.accept_frames(a.poll(0.0), 0.0)
+    a.accept_frames(b.poll(0.0), 0.0)
+    assert a.outstanding == 0  # pair SYNced, msg0 settled
+    a.send_marker({"snapshot_id": "s1"}, 0.1)
+    assert b.accept_frames(a.poll(0.1), 0.1) == []  # never delivered
+    assert b.poll(0.1) == []                        # never ACKed
+    assert not b.snap_done
+    assert a.outstanding == 1                       # marker still queued
+    # TTL expiry clears the sender's window — the marker is gone, and a
+    # later message arrives via the kill-number gap skip, exactly once.
+    delivered = []
+    a.send(msg(1), 0.6)
+    for t in (0.6, 0.7, 0.8):
+        delivered += b.accept_frames(a.poll(t), t)
+        a.accept_frames(b.poll(t), t)
+    assert [m.payload["i"] for m in delivered] == [1]
+    assert a.outstanding == 0
+    # The SAME frame sequence with a handler attached delivers the
+    # marker: the pin is about the handler's absence, not the frame.
+    c = SrChannel("a", src_uuid="c")
+    seen = []
+    c.on_marker = lambda peer, payload: seen.append(payload)
+    a2 = SrChannel("c", src_uuid="a", ttl_s=0.3)
+    a2.send(msg(0), 0.0)
+    c.accept_frames(a2.poll(0.0), 0.0)
+    a2.accept_frames(c.poll(0.0), 0.0)
+    a2.send_marker({"snapshot_id": "s1"}, 0.1)
+    c.accept_frames(a2.poll(0.1), 0.1)
+    assert c.snap_done and seen[0]["snapshot_id"] == "s1"
+
+
 def test_large_backlog_does_not_kill_pump():
     # Unreachable peer + deep backlog: the pump thread must chunk and
     # keep running, and delivery must complete once the peer appears.

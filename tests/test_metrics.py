@@ -265,6 +265,49 @@ def test_journal_file_append_and_rotation(tmp_path):
     assert older and older[-1]["i"] < 39
 
 
+def test_journal_since_cursor_resumes_and_observes_gaps():
+    # The /events?since= seam: every record carries a monotone seq,
+    # since(cursor) returns strictly-newer records oldest first, and a
+    # cursor that slept through ring eviction can SEE the gap (the first
+    # returned seq jumps past cursor+1) instead of silently losing data.
+    j = M.JsonlEventJournal(capacity=8)
+    for i in range(5):
+        j.emit("tick", i=i)
+    cursor = j.tail(1)[-1]["seq"]
+    assert j.since(cursor) == []
+    j.emit("tick", i=5)
+    j.emit("tick", i=6)
+    out = j.since(cursor)
+    assert [e["i"] for e in out] == [5, 6]
+    assert [e["seq"] for e in out] == [cursor + 1, cursor + 2]
+    # Overflow the capacity-8 ring: the stale cursor's next read starts
+    # past the eviction horizon, and the seq jump exposes the gap.
+    for i in range(20):
+        j.emit("tick", i=100 + i)
+    out = j.since(cursor)
+    assert len(out) == 8 and out[0]["seq"] > cursor + 1
+
+
+def test_events_since_route_serves_cursor_pagination():
+    j = M.JsonlEventJournal(capacity=64)
+    for i in range(6):
+        j.emit("cursor.tick", i=i)
+    cursor = j.tail(4)[0]["seq"]
+    srv = M.MetricsServer(journal=j, port=0).start()
+    try:
+        body = _scrape(srv.port, f"/events?since={cursor}")
+        recs = [json.loads(l) for l in body.splitlines()]
+        assert [r["i"] for r in recs] == [3, 4, 5]
+        assert all(r["seq"] > cursor for r in recs)
+        # Resuming from the last seen seq returns nothing new...
+        assert _scrape(srv.port, f"/events?since={recs[-1]['seq']}") == ""
+        # ...and ?n= tail mode is unchanged alongside the cursor mode.
+        tail = _scrape(srv.port, "/events?n=2")
+        assert [json.loads(l)["i"] for l in tail.splitlines()] == [4, 5]
+    finally:
+        srv.stop()
+
+
 # ---------------------------------------------------------------------------
 # exposition endpoint
 # ---------------------------------------------------------------------------

@@ -1,0 +1,592 @@
+"""Consistent-cut snapshot tests (``freedm_tpu.core.snapshot``): the
+Chandy–Lamport capture protocol over real UDP endpoints and the sans-IO
+SR channel, the invariant auditor's typed findings, the torn-read
+negative proof, the serve-side state seam, and the offline
+``snapshot_report`` tool's exit-code contract.
+
+Reference semantics: the DGI's StateCollection pillar
+(``Broker/src/sc/StateCollection.cpp``) — marker-based snapshots whose
+per-channel recorded messages + frozen counters form a consistent
+global cut (docs/snapshots.md).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from freedm_tpu.core import metrics as M
+from freedm_tpu.core import snapshot as snap
+from freedm_tpu.dcn import endpoint as ep_mod
+from freedm_tpu.dcn.protocol import SrChannel
+from freedm_tpu.runtime.messages import ModuleMessage
+
+
+def msg(i):
+    return ModuleMessage("lb", "draft_request", {"i": i}, source="A:1")
+
+
+def _pair(provider_a=None, provider_b=None, timeout_s=5.0):
+    """Two live UDP endpoints with snapshot coordinators attached."""
+    ea = ep_mod.UdpEndpoint("A:1", resend_time_s=0.02).start()
+    eb = ep_mod.UdpEndpoint("B:2", resend_time_s=0.02).start()
+    ea.connect("B:2", eb.address)
+    eb.connect("A:1", ea.address)
+    ca = snap.SnapshotCoordinator(ea, state_provider=provider_a,
+                                  timeout_s=timeout_s)
+    cb = snap.SnapshotCoordinator(eb, state_provider=provider_b,
+                                  timeout_s=timeout_s)
+    return ea, eb, ca, cb
+
+
+def _wait(cond, timeout_s=5.0, step=0.02):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# capture over live endpoints
+# ---------------------------------------------------------------------------
+
+
+def test_two_node_cut_completes_and_audits_clean():
+    got = []
+    ea, eb, ca, cb = _pair(
+        provider_a=lambda: {"gm": {"coordinators_per_group": [1]}},
+        provider_b=lambda: {"gm": {"coordinators_per_group": [1]}},
+    )
+    eb.sink = got.append
+    try:
+        for i in range(5):
+            ea.send("B:2", msg(i))
+        assert _wait(lambda: len(got) == 5)
+        sid = ca.initiate()
+        assert _wait(lambda: ca.result(sid) is not None
+                     and cb.result(sid) is not None)
+        doc_a, doc_b = ca.result(sid), cb.result(sid)
+        assert doc_a["status"] == doc_b["status"] == "complete"
+        # B's inbound channel from A froze at the 5 delivered messages,
+        # agreeing with both the marker and A's captured send counter.
+        cin = doc_b["channels_in"]["A:1"]
+        assert cin["done"] and cin["accepted_at_marker"] == 5
+        assert cin["marker"]["sent_at_marker"] == 5
+        assert doc_a["channels_out"]["B:2"]["sent_at_capture"] == 5
+        cut = snap.assemble_cut(sid, [doc_a, doc_b])
+        assert cut["status"] == "complete"
+        assert snap.audit_cut(cut) == []
+    finally:
+        ea.stop(); eb.stop()
+
+
+def test_concurrent_initiation_raises_typed_in_progress():
+    # The peer address points at a dead port: the marker is never ACKed,
+    # the cut stays active, and a second initiation is the typed 409.
+    ep = ep_mod.UdpEndpoint("A:1", resend_time_s=0.02).start()
+    ep.connect("dead:9", ("127.0.0.1", 1))
+    coord = snap.SnapshotCoordinator(ep, timeout_s=30.0)
+    rejected0 = M.SNAPSHOT_CUTS.labels("rejected").value
+    try:
+        sid = coord.initiate()
+        assert coord.status()["active"] == sid
+        with pytest.raises(snap.SnapshotInProgress):
+            coord.initiate()
+        assert M.SNAPSHOT_CUTS.labels("rejected").value == rejected0 + 1
+    finally:
+        ep.stop()
+
+
+def test_dead_peer_times_out_typed_incomplete_never_a_wedge():
+    ep = ep_mod.UdpEndpoint("A:1", resend_time_s=0.02).start()
+    ep.connect("dead:9", ("127.0.0.1", 1))
+    coord = snap.SnapshotCoordinator(ep, timeout_s=0.2)
+    try:
+        sid = coord.initiate()
+        # The endpoint pump ticks the coordinator: the deadline fires
+        # without any explicit poke from the initiator.
+        assert _wait(lambda: coord.result(sid) is not None, timeout_s=3.0)
+        doc = coord.result(sid)
+        assert doc["status"] == "incomplete"
+        assert doc["pending"] == ["dead:9"]
+        incompletes = [e for e in M.EVENTS.tail(100)
+                       if e["event"] == "snapshot.incomplete"
+                       and e["snapshot_id"] == sid]
+        assert incompletes and incompletes[-1]["node"] == "A:1"
+        # Not a wedge: the next initiation starts cleanly.
+        sid2 = coord.initiate()
+        assert sid2 != sid and coord.status()["active"] == sid2
+    finally:
+        ep.stop()
+
+
+def test_mid_snapshot_peer_kill_finishes_incomplete():
+    ea, eb, ca, _cb = _pair(timeout_s=0.5)
+    try:
+        eb.stop()  # the peer dies BEFORE the marker can round-trip
+        sid = ca.initiate()
+        assert _wait(lambda: ca.result(sid) is not None, timeout_s=3.0)
+        doc = ca.result(sid)
+        assert doc["status"] == "incomplete" and doc["pending"] == ["B:2"]
+        # The incomplete node doc still poisons any fleet assembly.
+        cut = snap.assemble_cut(sid, [doc])
+        assert cut["status"] == "incomplete"
+    finally:
+        ea.stop()
+
+
+# ---------------------------------------------------------------------------
+# sans-IO: in-flight recording on the SR channel
+# ---------------------------------------------------------------------------
+
+
+def test_in_flight_message_captured_exactly_once():
+    a = SrChannel("B:2", src_uuid="A:1", ttl_s=60.0)
+    b = SrChannel("A:1", src_uuid="B:2", ttl_s=60.0)
+    markers = []
+    b.on_marker = lambda peer, payload: markers.append((peer, payload))
+    # Settle one message so the pair is SYNced with nonzero counters.
+    a.send(msg(0), 0.0)
+    b.accept_frames(a.poll(0.0), 0.0)
+    a.accept_frames(b.poll(0.0), 0.0)
+    # Receiver captures local state FIRST (snap_begin), then a message
+    # and the sender's marker are in flight concurrently: the message
+    # predates the marker on the FIFO channel, so it is exactly the
+    # in-flight state the cut must record.
+    base = b.snap_begin()
+    assert base["accepted_at_capture"] == 1
+    a.send(msg(1), 0.1)
+    a.send_marker({"snapshot_id": "s1", "origin": "A:1"}, 0.1)
+    frames = a.poll(0.1)
+    delivered = b.accept_frames(frames, 0.1)
+    # Duplicate datagram: the dup-drop path must not double-record.
+    b.accept_frames(frames, 0.1)
+    assert [m.payload["i"] for m in delivered] == [1]
+    st = b.snap_state()
+    assert st["done"] and st["recorded_n"] == 1
+    assert st["accepted_at_marker"] - st["accepted_at_capture"] == 1
+    assert st["recorded"][0]["type"] == "draft_request"
+    assert markers == [("A:1", {"snapshot_id": "s1", "origin": "A:1",
+                                "sent_at_marker": 2})]
+    # The assembled two-node view audits clean, including the sender's
+    # independently captured counter cross-check.
+    cut = snap.assemble_cut("s1", [
+        {"snapshot_id": "s1", "node": "B:2", "status": "complete",
+         "channels_in": {"A:1": st}, "channels_out": {}},
+        {"snapshot_id": "s1", "node": "A:1", "status": "complete",
+         "channels_in": {},
+         "channels_out": {"B:2": {"sent_at_capture": a.sent}}},
+    ])
+    assert snap.audit_cut(cut) == []
+
+
+def test_sender_restart_opens_new_channel_epoch_no_bogus_violation():
+    # A killed-and-restarted sender (soak/chaos rejoin) re-SYNs with a
+    # fresh sync stamp and a sent counter restarted from zero.  The
+    # receiver must open a new conservation epoch — a lifetime accept
+    # count would exceed the new incarnation's sent_at_marker and read
+    # as a bogus channel_conservation violation in the next cut.
+    a = SrChannel("B:2", src_uuid="A:1", ttl_s=60.0)
+    b = SrChannel("A:1", src_uuid="B:2", ttl_s=60.0)
+    b.on_marker = lambda peer, payload: None
+    for i in range(5):
+        a.send(msg(i), 0.0)
+    b.accept_frames(a.poll(0.0), 0.0)
+    a.accept_frames(b.poll(0.0), 0.0)
+    assert b.accepted == 5
+    # The sender process restarts: a brand-new channel, same uuid.
+    a2 = SrChannel("B:2", src_uuid="A:1", ttl_s=60.0)
+    a2.send(msg(0), 1.0)  # SYN-first with a NEW sync stamp
+    delivered = b.accept_frames(a2.poll(1.0), 1.0)
+    a2.accept_frames(b.poll(1.0), 1.0)
+    assert [m.payload["i"] for m in delivered] == [0]
+    assert b.accepted == 1  # epoch reset: counts the new incarnation only
+    # A cut taken AFTER the restart audits clean.
+    b.snap_begin()
+    a2.send_marker({"snapshot_id": "s9", "origin": "A:1"}, 1.1)
+    b.accept_frames(a2.poll(1.1), 1.1)
+    st = b.snap_state()
+    assert st["done"] and not st["resynced"]
+    assert st["accepted_at_marker"] == 1
+    assert st["marker"]["sent_at_marker"] == 1
+    cut = snap.assemble_cut("s9", [
+        {"snapshot_id": "s9", "node": "B:2", "status": "complete",
+         "channels_in": {"A:1": st}, "channels_out": {}},
+        {"snapshot_id": "s9", "node": "A:1", "status": "complete",
+         "channels_in": {},
+         "channels_out": {"B:2": {"sent_at_capture": a2.sent}}},
+    ])
+    assert snap.audit_cut(cut) == []
+
+
+def test_resync_mid_recording_marks_channel_and_auditor_skips():
+    # A restart WHILE a cut is recording straddles two channel epochs:
+    # the channel is marked resynced and the auditor skips its
+    # per-channel equations instead of reporting epoch-torn garbage.
+    a = SrChannel("B:2", src_uuid="A:1", ttl_s=60.0)
+    b = SrChannel("A:1", src_uuid="B:2", ttl_s=60.0)
+    b.on_marker = lambda peer, payload: None
+    for i in range(3):
+        a.send(msg(i), 0.0)
+    b.accept_frames(a.poll(0.0), 0.0)
+    a.accept_frames(b.poll(0.0), 0.0)
+    b.snap_begin()
+    a2 = SrChannel("B:2", src_uuid="A:1", ttl_s=60.0)
+    a2.send(msg(0), 1.0)
+    b.accept_frames(a2.poll(1.0), 1.0)
+    assert b.snap_state()["resynced"]
+    # The new incarnation knows nothing of the old cut; if a marker of
+    # ITS OWN ever lands here the frozen doc must still be skipped.
+    a2.send_marker({"snapshot_id": "other", "origin": "A:1"}, 1.1)
+    a2.accept_frames(b.poll(1.0), 1.1)
+    b.accept_frames(a2.poll(1.1), 1.1)
+    st = b.snap_state()
+    assert st["done"] and st["resynced"]
+    doc = {"snapshot_id": "s", "node": "B:2", "status": "complete",
+           "channels_in": {"A:1": st}, "channels_out": {}}
+    cut = snap.assemble_cut("s", [doc])
+    assert snap.audit_cut(cut) == []
+
+
+def test_marker_before_capture_joins_with_empty_recording():
+    # Chandy–Lamport join path: a node that first LEARNS of the cut
+    # from an inbound marker records the delivering channel empty.
+    a = SrChannel("B:2", src_uuid="A:1", ttl_s=60.0)
+    b = SrChannel("A:1", src_uuid="B:2", ttl_s=60.0)
+    b.on_marker = lambda peer, payload: None
+    a.send(msg(0), 0.0)
+    b.accept_frames(a.poll(0.0), 0.0)
+    a.accept_frames(b.poll(0.0), 0.0)
+    a.send_marker({"snapshot_id": "s2", "origin": "A:1"}, 0.1)
+    b.accept_frames(a.poll(0.1), 0.1)  # marker with NO prior snap_begin
+    st = b.snap_state()
+    assert st["done"] and st["recorded_n"] == 0
+    assert st["accepted_at_capture"] == st["accepted_at_marker"] == 1
+
+
+# ---------------------------------------------------------------------------
+# auditor: typed findings per invariant
+# ---------------------------------------------------------------------------
+
+
+def _node(name, **extra):
+    doc = {"snapshot_id": "s", "node": name, "status": "complete",
+           "local": {}, "channels_in": {}, "channels_out": {}}
+    doc.update(extra)
+    return doc
+
+
+def test_audit_channel_conservation_and_recording():
+    # More accepts than the marker says were ever sent = duplicate
+    # delivery; a recording that disagrees with the counter delta means
+    # an in-flight message was missed or double-recorded.
+    cut = snap.assemble_cut("s", [
+        _node("B", channels_in={"A": {
+            "done": True, "marker": {"sent_at_marker": 3},
+            "accepted_at_marker": 5, "accepted_at_capture": 2,
+            "recorded_n": 1,
+        }}),
+        _node("A"),
+    ])
+    checks = sorted(v.check for v in snap.audit_cut(cut))
+    assert checks == ["channel_conservation", "channel_recording"]
+    # Losses are LEGAL on an SR channel (TTL expiry): a deficit is not
+    # a conservation violation.
+    cut = snap.assemble_cut("s", [
+        _node("B", channels_in={"A": {
+            "done": True, "marker": {"sent_at_marker": 9},
+            "accepted_at_marker": 5, "accepted_at_capture": 2,
+            "recorded_n": 3,
+        }}),
+    ])
+    assert snap.audit_cut(cut) == []
+
+
+def test_audit_counter_mismatch_against_sender_capture():
+    cut = snap.assemble_cut("s", [
+        _node("B", channels_in={"A": {
+            "done": True, "marker": {"sent_at_marker": 4},
+            "accepted_at_marker": 4, "accepted_at_capture": 4,
+            "recorded_n": 0,
+        }}),
+        _node("A", channels_out={"B": {"sent_at_capture": 7}}),
+    ])
+    vs = snap.audit_cut(cut)
+    assert [v.check for v in vs] == ["channel_counter_mismatch"]
+    assert "sent_at_capture=7" in vs[0].detail
+
+
+def test_audit_single_leader_in_process_and_federated():
+    cut = snap.assemble_cut("s", [
+        _node("A", local={
+            "gm": {"coordinators_per_group": [1, 2]},
+            "fed": {"is_coordinator": True, "members": ["A", "B"]},
+        }),
+        _node("B", local={
+            "fed": {"is_coordinator": True, "members": ["A", "B"]},
+        }),
+    ])
+    vs = snap.audit_cut(cut)
+    assert sorted(v.check for v in vs) == ["single_leader", "single_leader"]
+    details = " ".join(v.detail for v in vs)
+    assert "group 1 has 2 coordinators" in details
+    assert "2 nodes claim federation leadership" in details
+    # One leader per member set is the legal shape.
+    cut = snap.assemble_cut("s", [
+        _node("A", local={"fed": {"is_coordinator": True,
+                                  "members": ["A", "B"]}}),
+        _node("B", local={"fed": {"is_coordinator": False,
+                                  "members": ["A", "B"]}}),
+    ])
+    assert snap.audit_cut(cut) == []
+
+
+def test_audit_ticket_job_and_cache_accounting():
+    ok_ledger = {"offered": 10, "admitted": 8, "shed": 1, "rejected": 1,
+                 "ok": 6, "error": 1, "inflight": 1}
+    cut = snap.assemble_cut("s", [_node(
+        "R",
+        serve={"ledger": ok_ledger},
+        jobs={"total": 3, "by_state": {"running": 1, "completed": 2}},
+        cache={"bytes": 100, "accounted_bytes": 100},
+    )])
+    assert snap.audit_cut(cut) == []
+    cut = snap.assemble_cut("s", [_node(
+        "R",
+        serve={"ledger": dict(ok_ledger, offered=11, ok=9)},
+        jobs={"total": 4, "by_state": {"running": 1, "completed": 2}},
+        cache={"bytes": 100, "accounted_bytes": 64},
+    )])
+    checks = sorted(v.check for v in snap.audit_cut(cut))
+    assert checks == ["cache_bytes", "job_accounting",
+                      "ticket_accounting", "ticket_accounting"]
+    # A malformed ledger is itself a typed violation, not a skip.
+    cut = snap.assemble_cut("s", [_node("R", serve={"ledger": {"x": 1}})])
+    vs = snap.audit_cut(cut)
+    assert [v.check for v in vs] == ["ticket_accounting"]
+    assert "malformed" in vs[0].detail
+
+
+def test_torn_scrape_flags_bogus_violation():
+    # Each instant's ledger audits clean on its own; the torn glue of
+    # the two MUST fail — the negative proof that the marker
+    # coordination is load-bearing.
+    early = {"offered": 10, "admitted": 8, "shed": 1, "rejected": 1,
+             "ok": 8, "error": 0, "inflight": 0}
+    late = {"offered": 14, "admitted": 12, "shed": 1, "rejected": 1,
+            "ok": 12, "error": 0, "inflight": 0}
+    for ledger in (early, late):
+        clean = snap.assemble_cut("s", [_node("R", serve={"ledger": ledger})])
+        assert snap.audit_cut(clean) == []
+    torn = snap.torn_serve_doc({"ledger": early}, {"ledger": late})
+    assert torn["torn"] is True
+    cut = snap.assemble_cut("torn", [_node("R", snapshot_id="torn",
+                                           serve=torn)])
+    vs = snap.audit_cut(cut)
+    assert vs and all(v.check == "ticket_accounting" for v in vs)
+
+
+def test_assemble_cut_drops_foreign_sid_and_propagates_incomplete():
+    cut = snap.assemble_cut("s", [
+        _node("A"),
+        dict(_node("B"), snapshot_id="s0"),  # stale cut: dropped
+        dict(_node("C"), status="incomplete"),
+    ])
+    assert sorted(cut["nodes"]) == ["A", "C"]
+    assert cut["status"] == "incomplete"
+
+
+def test_bound_doc_trims_recordings_then_stubs():
+    doc = _node("A", channels_in={"B": {
+        "done": True, "recorded_n": 200,
+        "recorded": [{"seq": i, "hash": "h" * 40} for i in range(200)],
+    }})
+    trimmed = snap.bound_doc(dict(doc), 2000)
+    assert trimmed["trimmed"] is True
+    assert trimmed["channels_in"]["B"]["recorded"] == "trimmed:200"
+    assert trimmed["channels_in"]["B"]["recorded_n"] == 200
+    stub = snap.bound_doc(dict(doc), 64)
+    assert stub["status"] == "oversize" and stub["node"] == "A"
+    # Small docs pass through untouched (same object, no copies).
+    small = _node("A")
+    assert snap.bound_doc(small, 4_000_000) is small
+
+
+def test_record_violations_bumps_counter_and_journals():
+    base = {}
+    for v in M.SNAPSHOT_VIOLATIONS.children():
+        base[v[0]] = v[1].value
+    snap.record_violations("sX", [
+        snap.Violation("ticket_accounting", "R", "broken"),
+    ])
+    assert (M.SNAPSHOT_VIOLATIONS.labels("ticket_accounting").value
+            == base.get(("ticket_accounting",), 0) + 1)
+    recs = [e for e in M.EVENTS.tail(50)
+            if e["event"] == "snapshot.violation"
+            and e["snapshot_id"] == "sX"]
+    assert recs and recs[-1]["check"] == "ticket_accounting"
+
+
+# ---------------------------------------------------------------------------
+# serve-side state seam
+# ---------------------------------------------------------------------------
+
+
+def test_service_snapshot_state_ledger_balances():
+    from freedm_tpu.serve import ServeConfig, Service
+    from freedm_tpu.serve.service import PowerFlowRequest
+
+    svc = Service(ServeConfig(max_batch=4, max_wait_ms=1.0,
+                              queue_depth=32, buckets=(1, 4)))
+    try:
+        req = PowerFlowRequest(case="case14", scale=1.0)
+        for _ in range(3):
+            svc.submit("pf", req).result(timeout=120)
+        st = svc.snapshot_state()
+        ledger = st["ledger"]
+        assert ledger["offered"] >= 3
+        assert (ledger["offered"]
+                == ledger["admitted"] + ledger["shed"] + ledger["rejected"])
+        assert (ledger["admitted"]
+                == ledger["ok"] + ledger["error"] + ledger["inflight"])
+        # The seam IS the audit input: a one-node cut over it is clean.
+        cut = snap.assemble_cut("svc", [_node("R", serve=st)])
+        assert snap.audit_cut(cut) == []
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# metrics server routes
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+def _post(port, path):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 data=b"", method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_metrics_server_snapshot_routes():
+    srv = M.MetricsServer(port=0).start()
+    ep = None
+    try:
+        # No coordinator installed: GET is typed-disabled, POST is 503.
+        assert _get(srv.port, "/snapshot") == {"enabled": False}
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(srv.port, "/snapshot")
+        assert err.value.code == 503
+        # Installed, peerless: initiation completes instantly.
+        ep = ep_mod.UdpEndpoint("A:1", resend_time_s=0.02).start()
+        coord = snap.SnapshotCoordinator(ep, timeout_s=2.0)
+        snap.install(coord)
+        status, body = _post(srv.port, "/snapshot")
+        assert status == 200
+        sid = body["snapshot_id"]
+        doc = _get(srv.port, f"/snapshot?id={sid}")
+        assert doc["snapshot_id"] == sid and doc["status"] == "complete"
+        assert _get(srv.port, "/snapshot")["node"] == "A:1"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(srv.port, "/snapshot?id=nope")
+        assert err.value.code == 404
+    finally:
+        snap.install(None)
+        if ep is not None:
+            ep.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# snapshot_report exit-code contract
+# ---------------------------------------------------------------------------
+
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def test_snapshot_report_clean_cut_exits_0(tmp_path, capsys):
+    from freedm_tpu.tools import snapshot_report
+
+    cut = snap.assemble_cut("s", [_node("R", serve={"ledger": {
+        "offered": 2, "admitted": 2, "shed": 0, "rejected": 0,
+        "ok": 2, "error": 0, "inflight": 0}})])
+    rc = snapshot_report.main(["--cut", _write(tmp_path, "cut.json", cut)])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["pass"] and rep["nodes"] == ["R"]
+    # A bare node doc (no nodes map) is wrapped into a one-node cut.
+    rc = snapshot_report.main(
+        ["--cut", _write(tmp_path, "node.json", _node("R"))])
+    assert rc == 0
+
+
+def test_snapshot_report_violations_exit_1(tmp_path, capsys):
+    from freedm_tpu.tools import snapshot_report
+
+    early = _write(tmp_path, "early.json", {"node": "R", "ledger": {
+        "offered": 5, "admitted": 5, "shed": 0, "rejected": 0,
+        "ok": 5, "error": 0, "inflight": 0}})
+    late = _write(tmp_path, "late.json", {"node": "R", "ledger": {
+        "offered": 9, "admitted": 9, "shed": 0, "rejected": 0,
+        "ok": 9, "error": 0, "inflight": 0}})
+    rc = snapshot_report.main(["--torn", early, late])
+    assert rc == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert not rep["pass"]
+    assert rep["violations"][0]["check"] == "ticket_accounting"
+
+
+def test_snapshot_report_internal_errors_exit_2(tmp_path, capsys):
+    from freedm_tpu.tools import snapshot_report
+
+    assert snapshot_report.main(
+        ["--cut", str(tmp_path / "missing.json")]) == 2
+    # A journal with no snapshot.node records has nothing to audit.
+    jp = tmp_path / "events.jsonl"
+    jp.write_text(json.dumps({"event": "broker.round", "seq": 1}) + "\n")
+    assert snapshot_report.main(["--events", str(jp)]) == 2
+    capsys.readouterr()
+
+
+def test_snapshot_report_assembles_cut_from_journals(tmp_path, capsys):
+    from freedm_tpu.tools import snapshot_report
+
+    lines_a = [
+        {"event": "snapshot.node", "snapshot_id": "old",
+         "doc": dict(_node("A"), snapshot_id="old")},
+        {"event": "snapshot.node", "snapshot_id": "new",
+         "doc": dict(_node("A"), snapshot_id="new")},
+    ]
+    lines_b = [
+        {"event": "snapshot.node", "snapshot_id": "new",
+         "doc": dict(_node("B"), snapshot_id="new")},
+    ]
+    ja = tmp_path / "a.jsonl"
+    ja.write_text("\n".join(json.dumps(r) for r in lines_a) + "\n")
+    jb = tmp_path / "b.jsonl"
+    jb.write_text("\n".join(json.dumps(r) for r in lines_b) + "\n"
+                  + "{torn-tail")  # a live journal's partial last line
+    rc = snapshot_report.main(["--events", str(ja), str(jb)])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    # Without --snapshot-id the NEWEST journaled cut is audited, joined
+    # across both journals.
+    assert rep["snapshot_id"] == "new"
+    assert rep["nodes"] == ["A", "B"]
+    rc = snapshot_report.main(["--events", str(ja), "--snapshot-id", "old"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["nodes"] == ["A"]
